@@ -1,0 +1,242 @@
+"""Columnar device batches — the unit of data flow between operators.
+
+Reference parity: ``com.facebook.presto.common.Page`` + ``common.block.*``
+(``Block``, ``IntArrayBlock``, ``LongArrayBlock``, ``DictionaryBlock``,
+null masks) [SURVEY §2.1; reference tree unavailable, paths reconstructed].
+
+TPU-first design (NOT a Block translation):
+
+- A ``Batch`` is a **pytree** of fixed-capacity struct-of-arrays device
+  tensors — one ``Column`` (data + validity bitmask) per field plus a
+  per-batch ``live`` row mask. Static shapes keep XLA happy; the live
+  mask carries dynamic cardinality.
+- Filtering is *free*: it only ANDs the live mask (a selection vector),
+  no data movement. Compaction happens only at shuffle/output
+  boundaries, where rows must physically move anyway.
+- Strings are order-preserving dictionary codes (``Dictionary``), so
+  comparisons/sorts on codes are lexicographically correct — the
+  reference's ``DictionaryBlock`` made total-ordered.
+
+Because a Batch is a pytree, whole operator chains trace through ``jax.jit``
+as one fused XLA computation — the analog of the reference's per-query
+bytecode generation (``sql.gen.PageFunctionCompiler``), done by the XLA
+compiler instead.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Mapping, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from presto_tpu.types import DataType, TypeKind
+
+
+class Dictionary:
+    """An ordered, host-resident string dictionary.
+
+    ``values`` is a sorted numpy object array of Python strings; codes are
+    indices into it, so ``code_a < code_b  <=>  str_a < str_b``. Identity
+    hashing keeps jit caches stable when the same dictionary object is
+    reused across batches (the common case: one dictionary per column per
+    table).
+    """
+
+    __slots__ = ("values", "_index")
+
+    def __init__(self, values: Sequence[str]):
+        vals = sorted(set(values))
+        self.values = np.array(vals, dtype=object)
+        self._index = {v: i for i, v in enumerate(vals)}
+
+    def __len__(self) -> int:
+        return len(self.values)
+
+    def encode(self, strings) -> np.ndarray:
+        idx = self._index
+        return np.fromiter((idx[s] for s in strings), dtype=np.int32, count=len(strings))
+
+    def code_of(self, s: str) -> int:
+        """Exact code of ``s``; raises KeyError if absent."""
+        return self._index[s]
+
+    def lower_bound(self, s: str) -> int:
+        """First code whose string >= s (for range predicates on codes)."""
+        return int(np.searchsorted(self.values.astype(str), s, side="left"))
+
+    def decode(self, codes: np.ndarray) -> np.ndarray:
+        return self.values[np.asarray(codes)]
+
+    def __repr__(self) -> str:
+        return f"Dictionary({len(self)} values)"
+
+
+class Column:
+    """One column: device data + validity mask + static type metadata."""
+
+    __slots__ = ("data", "valid", "dtype", "dictionary")
+
+    def __init__(self, data, valid, dtype: DataType, dictionary: Dictionary | None = None):
+        self.data = data
+        self.valid = valid
+        self.dtype = dtype
+        self.dictionary = dictionary
+
+    @property
+    def capacity(self) -> int:
+        return self.data.shape[0]
+
+    def tree_flatten(self):
+        return (self.data, self.valid), (self.dtype, self.dictionary)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        dtype, dictionary = aux
+        data, valid = children
+        return cls(data, valid, dtype, dictionary)
+
+    def __repr__(self) -> str:
+        return f"Column({self.dtype}, cap={self.data.shape[0]})"
+
+
+jax.tree_util.register_pytree_node(
+    Column, Column.tree_flatten, Column.tree_unflatten
+)
+
+
+class Batch:
+    """A fixed-capacity batch of rows: named columns + a live-row mask."""
+
+    __slots__ = ("columns", "live")
+
+    def __init__(self, columns: Mapping[str, Column], live):
+        self.columns = dict(columns)
+        self.live = live
+
+    # ---- static shape ---------------------------------------------------
+    @property
+    def capacity(self) -> int:
+        return self.live.shape[0]
+
+    @property
+    def names(self) -> tuple[str, ...]:
+        return tuple(self.columns)
+
+    def count(self):
+        """Dynamic number of live rows (traced scalar)."""
+        return jnp.sum(self.live.astype(jnp.int32))
+
+    def __getitem__(self, name: str) -> Column:
+        return self.columns[name]
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.columns
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self.columns)
+
+    # ---- structural ops (host-side; all trace cleanly) ------------------
+    def select(self, names: Sequence[str]) -> "Batch":
+        return Batch({n: self.columns[n] for n in names}, self.live)
+
+    def with_column(self, name: str, column: Column) -> "Batch":
+        cols = dict(self.columns)
+        cols[name] = column
+        return Batch(cols, self.live)
+
+    def with_live(self, live) -> "Batch":
+        return Batch(self.columns, live)
+
+    def rename(self, mapping: Mapping[str, str]) -> "Batch":
+        return Batch({mapping.get(n, n): c for n, c in self.columns.items()}, self.live)
+
+    # ---- pytree ---------------------------------------------------------
+    def tree_flatten(self):
+        names = tuple(self.columns)
+        children = tuple(self.columns[n] for n in names) + (self.live,)
+        return children, names
+
+    @classmethod
+    def tree_unflatten(cls, names, children):
+        return cls(dict(zip(names, children[:-1])), children[-1])
+
+    # ---- host conversion ------------------------------------------------
+    @classmethod
+    def from_numpy(
+        cls,
+        arrays: Mapping[str, np.ndarray],
+        types: Mapping[str, DataType],
+        count: int | None = None,
+        valids: Mapping[str, np.ndarray] | None = None,
+        dictionaries: Mapping[str, Dictionary] | None = None,
+        capacity: int | None = None,
+    ) -> "Batch":
+        """Build a device Batch from host arrays, padding to ``capacity``."""
+        n = len(next(iter(arrays.values())))
+        count = n if count is None else count
+        cap = capacity or n
+        cols = {}
+        for name, arr in arrays.items():
+            t = types[name]
+            arr = np.asarray(arr)
+            if t.kind is TypeKind.BYTES:
+                padded = np.zeros((cap, t.width), dtype=np.uint8)
+                padded[: arr.shape[0], : arr.shape[1]] = arr[:cap]
+            else:
+                padded = np.zeros(cap, dtype=t.np_dtype)
+                padded[:n] = arr.astype(t.np_dtype, copy=False)[:cap]
+            v = np.zeros(cap, dtype=np.bool_)
+            if valids is not None and name in valids and valids[name] is not None:
+                v[:n] = valids[name][:cap]
+            else:
+                v[:n] = True
+            d = dictionaries.get(name) if dictionaries else None
+            cols[name] = Column(jnp.asarray(padded), jnp.asarray(v), t, d)
+        live = np.zeros(cap, dtype=np.bool_)
+        live[:count] = True
+        return cls(cols, jnp.asarray(live))
+
+    def to_pandas(self, decode_strings: bool = True, logical: bool = True):
+        """Materialize live rows as a pandas DataFrame (tests / client)."""
+        import pandas as pd
+
+        live = np.asarray(self.live)
+        out = {}
+        for name, col in self.columns.items():
+            data = np.asarray(col.data)[live]
+            valid = np.asarray(col.valid)[live]
+            t = col.dtype
+            if t.kind is TypeKind.VARCHAR and decode_strings and col.dictionary is not None:
+                vals = col.dictionary.decode(data).astype(object)
+            elif t.kind is TypeKind.BYTES and decode_strings:
+                vals = np.array(
+                    [bytes(row).rstrip(b"\x00").decode("latin1") for row in data],
+                    dtype=object,
+                )
+            elif t.kind is TypeKind.DECIMAL and logical:
+                vals = data.astype(np.float64) / 10**t.scale
+            elif t.kind is TypeKind.DATE and logical:
+                vals = np.datetime64("1970-01-01", "D") + data.astype(np.int64)
+            else:
+                vals = data
+            if not valid.all():
+                vals = np.asarray(vals, dtype=object)
+                vals[~valid] = None
+            out[name] = vals
+        return pd.DataFrame(out)
+
+    def __repr__(self) -> str:
+        cols = ", ".join(f"{n}:{c.dtype}" for n, c in self.columns.items())
+        return f"Batch(cap={self.capacity}, [{cols}])"
+
+
+jax.tree_util.register_pytree_node(
+    Batch, Batch.tree_flatten, Batch.tree_unflatten
+)
+
+
+def live_count(batch: Batch) -> int:
+    """Host-side concrete live-row count."""
+    return int(batch.count())

@@ -1,0 +1,630 @@
+"""Row expression IR + vectorized evaluator.
+
+Reference parity: ``com.facebook.presto.spi.relation.RowExpression``
+(``CallExpression``, ``ConstantExpression``, ``InputReferenceExpression``,
+``SpecialFormExpression``) and ``sql.gen.PageFunctionCompiler`` /
+``ExpressionCompiler`` which bytecode-compile them per query
+[SURVEY §2.1; reference tree unavailable, paths reconstructed].
+
+TPU-first replacement: expressions are a tiny immutable IR evaluated by
+tracing over ``Batch`` columns — ``jax.jit`` of the enclosing operator
+chain *is* the per-query compiler. Two idioms matter:
+
+- **Null semantics without branches**: every evaluation returns
+  ``Val(data, valid)``; functions combine validity masks (Kleene logic
+  for AND/OR) so NULL handling is branch-free vector math.
+- **String predicates via the dictionary**: LIKE / substr / prefix tests
+  on dictionary-encoded columns are computed once on the (small) host
+  dictionary into a lookup table, then applied on-device as a gather by
+  code — a scan over *distinct values*, not rows. Raw ``BYTES`` columns
+  fall back to device byte-tensor kernels (Pallas for the hot ones).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from presto_tpu.batch import Batch, Column, Dictionary
+from presto_tpu.types import (
+    BIGINT,
+    BOOLEAN,
+    DATE,
+    DOUBLE,
+    INTEGER,
+    DataType,
+    TypeKind,
+    common_super_type,
+    decimal,
+)
+
+# ---------------------------------------------------------------------------
+# IR nodes
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Expr:
+    dtype: DataType
+
+    def __and__(self, other: "Expr") -> "Expr":
+        return Call(BOOLEAN, "and", (self, other))
+
+    def __or__(self, other: "Expr") -> "Expr":
+        return Call(BOOLEAN, "or", (self, other))
+
+
+@dataclass(frozen=True)
+class InputRef(Expr):
+    """Reference to a named column of the input batch."""
+
+    name: str = ""
+
+    def __str__(self) -> str:
+        return self.name
+
+
+@dataclass(frozen=True)
+class Literal(Expr):
+    """A constant. ``value`` is the *logical* Python value."""
+
+    value: Any = None
+
+    def __str__(self) -> str:
+        return repr(self.value)
+
+
+@dataclass(frozen=True)
+class Call(Expr):
+    """Function call (covers operators, special forms, casts)."""
+
+    fn: str = ""
+    args: tuple[Expr, ...] = ()
+
+    def __str__(self) -> str:
+        return f"{self.fn}({', '.join(map(str, self.args))})"
+
+
+def col(name: str, dtype: DataType) -> InputRef:
+    return InputRef(dtype, name)
+
+
+def lit(value: Any, dtype: DataType) -> Literal:
+    return Literal(dtype, value)
+
+
+# ---------------------------------------------------------------------------
+# Evaluation values
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Val:
+    """An evaluated vector: device data + validity + metadata."""
+
+    data: Any
+    valid: Any
+    dtype: DataType
+    dictionary: Dictionary | None = None
+
+
+def _all_valid(template) -> Any:
+    return jnp.ones(template.shape[0], dtype=jnp.bool_)
+
+
+# ---------------------------------------------------------------------------
+# Scalar function registry
+# ---------------------------------------------------------------------------
+# impl(args: list[Val], out_type) -> (data, valid_override|None)
+# type_rule(arg_types) -> DataType
+
+_REGISTRY: dict[str, tuple[Callable, Callable]] = {}
+
+
+def register(name: str, type_rule: Callable):
+    def deco(impl):
+        _REGISTRY[name] = (impl, type_rule)
+        return impl
+
+    return deco
+
+
+def result_type(fn: str, arg_types: Sequence[DataType]) -> DataType:
+    if fn not in _REGISTRY:
+        raise KeyError(f"unknown function {fn!r}")
+    return _REGISTRY[fn][1](list(arg_types))
+
+
+# ---- type rules -----------------------------------------------------------
+
+
+def _t_bool(_):
+    return BOOLEAN
+
+
+def _t_same(args):
+    t = args[0]
+    for u in args[1:]:
+        t = common_super_type(t, u)
+    return t
+
+
+def _t_add(args):
+    return _t_same(args)
+
+
+def _t_mul(args):
+    a, b = args
+    if a.kind is TypeKind.DECIMAL or b.kind is TypeKind.DECIMAL:
+        sa = a.scale if a.kind is TypeKind.DECIMAL else 0
+        sb = b.scale if b.kind is TypeKind.DECIMAL else 0
+        if a.kind is TypeKind.DOUBLE or b.kind is TypeKind.DOUBLE:
+            return DOUBLE
+        # Engine-defined: product scale capped at 4 (documented divergence
+        # from ANSI sa+sb; keeps SF1000 64-bit sums exact — see SURVEY §7.4).
+        return decimal(38, min(sa + sb, 4))
+    return _t_same(args)
+
+
+def _t_div(args):
+    a, b = args
+    if a.kind is TypeKind.DECIMAL or b.kind is TypeKind.DECIMAL:
+        return DOUBLE
+    if a.kind is TypeKind.DOUBLE or b.kind is TypeKind.DOUBLE:
+        return DOUBLE
+    return DOUBLE
+
+
+def _t_first(args):
+    return args[0]
+
+
+def _t_double(_):
+    return DOUBLE
+
+
+def _t_int(_):
+    return INTEGER
+
+
+def _t_bigint(_):
+    return BIGINT
+
+
+# ---- numeric helpers ------------------------------------------------------
+
+
+def _to_physical(v: Val, target: DataType):
+    """Rescale/convert v.data to target's physical representation."""
+    src = v.dtype
+    data = v.data
+    if src == target:
+        return data
+    if target.kind is TypeKind.DOUBLE:
+        if src.kind is TypeKind.DECIMAL:
+            return data.astype(jnp.float32) / np.float32(10**src.scale)
+        return data.astype(jnp.float32)
+    if target.kind is TypeKind.DECIMAL:
+        if src.kind is TypeKind.DECIMAL:
+            if src.scale == target.scale:
+                return data.astype(jnp.int64)
+            if src.scale < target.scale:
+                return data.astype(jnp.int64) * np.int64(10 ** (target.scale - src.scale))
+            f = np.int64(10 ** (src.scale - target.scale))
+            # round-half-away-from-zero
+            d = data.astype(jnp.int64)
+            return (d + jnp.sign(d) * (f // 2)) // f
+        return data.astype(jnp.int64) * np.int64(10**target.scale)
+    if target.kind in (TypeKind.BIGINT, TypeKind.INTEGER, TypeKind.DATE):
+        return data.astype(target.jnp_dtype)
+    if target.kind is TypeKind.BOOLEAN:
+        return data.astype(jnp.bool_)
+    raise TypeError(f"cannot convert {src} -> {target}")
+
+
+def _binary_numeric(op):
+    def impl(args: list[Val], out: DataType):
+        a, b = args
+        if out.kind is TypeKind.DECIMAL:
+            x = _to_physical(a, decimal(38, out.scale))
+            y = _to_physical(b, decimal(38, out.scale))
+        else:
+            x = _to_physical(a, out)
+            y = _to_physical(b, out)
+        return op(x, y), None
+
+    return impl
+
+
+def _mul_impl(args: list[Val], out: DataType):
+    a, b = args
+    if out.kind is TypeKind.DECIMAL:
+        sa = a.dtype.scale if a.dtype.kind is TypeKind.DECIMAL else 0
+        sb = b.dtype.scale if b.dtype.kind is TypeKind.DECIMAL else 0
+        x = a.data.astype(jnp.int64) if a.dtype.kind is TypeKind.DECIMAL else _to_physical(a, decimal(38, 0))
+        y = b.data.astype(jnp.int64) if b.dtype.kind is TypeKind.DECIMAL else _to_physical(b, decimal(38, 0))
+        prod = x * y  # scale sa+sb
+        excess = sa + sb - out.scale
+        if excess > 0:
+            f = np.int64(10**excess)
+            prod = (prod + jnp.sign(prod) * (f // 2)) // f
+        return prod, None
+    x = _to_physical(a, out)
+    y = _to_physical(b, out)
+    return x * y, None
+
+
+def _div_impl(args: list[Val], out: DataType):
+    a, b = args
+    x = _to_physical(a, DOUBLE)
+    y = _to_physical(b, DOUBLE)
+    bad = y == 0
+    res = x / jnp.where(bad, jnp.float32(1), y)
+    return res, ~bad & a.valid & b.valid
+
+
+register("add", _t_add)(_binary_numeric(lambda x, y: x + y))
+register("sub", _t_add)(_binary_numeric(lambda x, y: x - y))
+register("mul", _t_mul)(_mul_impl)
+register("div", _t_div)(_div_impl)
+
+
+@register("mod", _t_same)
+def _mod_impl(args, out):
+    x = _to_physical(args[0], out)
+    y = _to_physical(args[1], out)
+    bad = y == 0
+    return jnp.where(bad, 0, x % jnp.where(bad, 1, y)), ~bad & args[0].valid & args[1].valid
+
+
+@register("neg", _t_first)
+def _neg(args, out):
+    return -args[0].data, None
+
+
+# ---- comparisons ----------------------------------------------------------
+
+
+def _cmp_physicals(a: Val, b: Val):
+    """Bring two comparable Vals to a common physical domain."""
+    ta, tb = a.dtype, b.dtype
+    if ta.kind is TypeKind.VARCHAR or tb.kind is TypeKind.VARCHAR:
+        # codes compare lexicographically within one ordered dictionary;
+        # literals are encoded against the column's dictionary upstream.
+        return a.data, b.data
+    t = common_super_type(ta, tb) if ta != tb else ta
+    if t.kind is TypeKind.DECIMAL:
+        s = max(ta.scale if ta.kind is TypeKind.DECIMAL else 0,
+                tb.scale if tb.kind is TypeKind.DECIMAL else 0)
+        t = decimal(38, s)
+    return _to_physical(a, t), _to_physical(b, t)
+
+
+def _cmp(op):
+    def impl(args: list[Val], out: DataType):
+        x, y = _cmp_physicals(args[0], args[1])
+        return op(x, y), None
+
+    return impl
+
+
+register("eq", _t_bool)(_cmp(lambda x, y: x == y))
+register("ne", _t_bool)(_cmp(lambda x, y: x != y))
+register("lt", _t_bool)(_cmp(lambda x, y: x < y))
+register("le", _t_bool)(_cmp(lambda x, y: x <= y))
+register("gt", _t_bool)(_cmp(lambda x, y: x > y))
+register("ge", _t_bool)(_cmp(lambda x, y: x >= y))
+
+
+@register("between", _t_bool)
+def _between(args, out):
+    lo = _cmp(lambda x, y: x >= y)([args[0], args[1]], out)[0]
+    hi = _cmp(lambda x, y: x <= y)([args[0], args[2]], out)[0]
+    return lo & hi, None
+
+
+# ---- boolean special forms (Kleene) --------------------------------------
+
+
+@register("and", _t_bool)
+def _and(args, out):
+    a, b = args
+    # Kleene: FALSE dominates NULL; data is "definitely true"
+    true_a = a.valid & a.data
+    true_b = b.valid & b.data
+    false_a = a.valid & ~a.data
+    false_b = b.valid & ~b.data
+    valid = (a.valid & b.valid) | false_a | false_b
+    return true_a & true_b, valid
+
+
+@register("or", _t_bool)
+def _or(args, out):
+    a, b = args
+    true_a = a.valid & a.data
+    true_b = b.valid & b.data
+    data = true_a | true_b
+    valid = (a.valid & b.valid) | true_a | true_b
+    return data, valid
+
+
+@register("not", _t_bool)
+def _not(args, out):
+    return ~args[0].data, None
+
+
+@register("is_null", _t_bool)
+def _is_null(args, out):
+    return ~args[0].valid, _all_valid(args[0].valid)
+
+
+@register("is_not_null", _t_bool)
+def _is_not_null(args, out):
+    return args[0].valid, _all_valid(args[0].valid)
+
+
+@register("coalesce", _t_same)
+def _coalesce(args, out):
+    data = _to_physical(args[-1], out)
+    valid = args[-1].valid
+    for v in reversed(args[:-1]):
+        d = _to_physical(v, out)
+        data = jnp.where(v.valid, d, data)
+        valid = v.valid | valid
+    return data, valid
+
+
+@register("if", lambda args: _t_same(args[1:]))
+def _if(args, out):
+    c, t, f = args
+    cond = c.data & c.valid
+    data = jnp.where(cond, _to_physical(t, out), _to_physical(f, out))
+    valid = jnp.where(cond, t.valid, f.valid)
+    return data, valid
+
+
+def _t_case(args):
+    return _t_same([args[i] for i in range(1, len(args), 2)] + ([args[-1]] if len(args) % 2 else []))
+
+
+@register("case", _t_case)
+def _case(args, out):
+    """case(when1, then1, when2, then2, ..., [else])."""
+    pairs = list(zip(args[0::2], args[1::2]))
+    has_else = len(args) % 2 == 1
+    if has_else:
+        data = _to_physical(args[-1], out)
+        valid = args[-1].valid
+    else:
+        data = jnp.zeros_like(_to_physical(pairs[0][1], out))
+        valid = jnp.zeros_like(pairs[0][0].valid)
+    for c, t in reversed(pairs):
+        cond = c.data & c.valid
+        data = jnp.where(cond, _to_physical(t, out), data)
+        valid = jnp.where(cond, t.valid, valid)
+    return data, valid
+
+
+@register("in", _t_bool)
+def _in(args, out):
+    """in(needle, v1, v2, ...) — small literal lists."""
+    needle = args[0]
+    hit = jnp.zeros_like(needle.valid)
+    for v in args[1:]:
+        x, y = _cmp_physicals(needle, v)
+        hit = hit | (x == y)
+    return hit, None
+
+
+# ---- dates ----------------------------------------------------------------
+
+
+def civil_from_days(days):
+    """days since 1970-01-01 -> (year, month, day); branch-free int32 math.
+
+    Standard civil-calendar algorithm (Hinnant); vectorizes onto the VPU.
+    """
+    z = days.astype(jnp.int32) + 719468
+    era = jnp.where(z >= 0, z, z - 146096) // 146097
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = jnp.where(mp < 10, mp + 3, mp - 9)
+    y = jnp.where(m <= 2, y + 1, y)
+    return y, m, d
+
+
+@register("year", _t_int)
+def _year(args, out):
+    y, _, _ = civil_from_days(args[0].data)
+    return y, None
+
+
+@register("month", _t_int)
+def _month(args, out):
+    _, m, _ = civil_from_days(args[0].data)
+    return m, None
+
+
+@register("day", _t_int)
+def _day(args, out):
+    _, _, d = civil_from_days(args[0].data)
+    return d, None
+
+
+# ---- casts ----------------------------------------------------------------
+
+
+@register("cast_double", _t_double)
+def _cast_double(args, out):
+    return _to_physical(args[0], DOUBLE), None
+
+
+@register("cast_bigint", _t_bigint)
+def _cast_bigint(args, out):
+    v = args[0]
+    if v.dtype.kind is TypeKind.DECIMAL:
+        f = np.int64(10**v.dtype.scale)
+        return v.data.astype(jnp.int64) // f, None
+    return v.data.astype(jnp.int64), None
+
+
+def rescale_decimal(target_scale: int):
+    name = f"rescale_{target_scale}"
+    if name not in _REGISTRY:
+        def rule(args, _s=target_scale):
+            return decimal(38, _s)
+
+        @register(name, rule)
+        def impl(args, out, _s=target_scale):
+            return _to_physical(args[0], decimal(38, _s)), None
+
+    return name
+
+
+# ---- string predicates on dictionary / bytes columns ----------------------
+
+
+def _like_to_regex(pattern: str) -> str:
+    import re as _re
+
+    out = []
+    for ch in pattern:
+        if ch == "%":
+            out.append(".*")
+        elif ch == "_":
+            out.append(".")
+        else:
+            out.append(_re.escape(ch))
+    return "^" + "".join(out) + "$"
+
+
+def _dict_predicate_table(dictionary: Dictionary, pred) -> np.ndarray:
+    return np.fromiter(
+        (pred(v) for v in dictionary.values), dtype=np.bool_, count=len(dictionary)
+    )
+
+
+@register("like", _t_bool)
+def _like(args, out):
+    """like(col, pattern_literal). Dictionary path: host regex over the
+    dictionary -> device gather. BYTES path handled in ops.strings."""
+    import re
+
+    target, pat = args
+    if target.dictionary is None:
+        raise NotImplementedError("LIKE on non-dictionary column: use ops.strings")
+    rx = re.compile(_like_to_regex(pat.data))
+    table = _dict_predicate_table(target.dictionary, lambda v: rx.match(v) is not None)
+    return jnp.asarray(table)[target.data], None
+
+
+@register("starts_with", _t_bool)
+def _starts_with(args, out):
+    target, pref = args
+    if target.dictionary is None:
+        raise NotImplementedError("starts_with on non-dictionary column")
+    table = _dict_predicate_table(target.dictionary, lambda v: v.startswith(pref.data))
+    return jnp.asarray(table)[target.data], None
+
+
+# ---------------------------------------------------------------------------
+# Evaluator
+# ---------------------------------------------------------------------------
+
+
+def evaluate(expr: Expr, batch: Batch) -> Val:
+    """Evaluate ``expr`` over a batch; returns a full-capacity ``Val``.
+
+    Dead rows (``~batch.live``) produce garbage-but-well-defined values;
+    consumers mask with ``batch.live``.
+    """
+    if isinstance(expr, InputRef):
+        c = batch[expr.name]
+        return Val(c.data, c.valid, c.dtype, c.dictionary)
+    if isinstance(expr, Literal):
+        cap = batch.capacity
+        if expr.value is None:
+            t = expr.dtype
+            return Val(
+                jnp.zeros(cap, dtype=t.jnp_dtype),
+                jnp.zeros(cap, dtype=jnp.bool_),
+                t,
+            )
+        if expr.dtype.kind is TypeKind.VARCHAR:
+            # stays host-side; encoded lazily against the peer dictionary
+            return Val(expr.value, None, expr.dtype, None)
+        phys = expr.dtype.to_physical(expr.value)
+        data = jnp.full(cap, phys, dtype=expr.dtype.jnp_dtype)
+        return Val(data, jnp.ones(cap, dtype=jnp.bool_), expr.dtype)
+    if isinstance(expr, Call):
+        args = [evaluate(a, batch) for a in expr.args]
+        args = _encode_string_literals(expr.fn, args)
+        impl, _rule = _REGISTRY[expr.fn]
+        data, valid = impl(args, expr.dtype)
+        if valid is None:
+            valid = None
+            for a in args:
+                if a.valid is not None:
+                    valid = a.valid if valid is None else (valid & a.valid)
+            if valid is None:
+                valid = jnp.ones(batch.capacity, dtype=jnp.bool_)
+        dictionary = None
+        if expr.dtype.kind is TypeKind.VARCHAR:
+            for a in args:
+                if a.dictionary is not None:
+                    dictionary = a.dictionary
+                    break
+        return Val(data, valid, expr.dtype, dictionary)
+    raise TypeError(f"unknown expr node {type(expr)}")
+
+
+def _encode_string_literals(fn: str, args: list[Val]) -> list[Val]:
+    """Encode host-side VARCHAR literals against a sibling dictionary."""
+    if fn in ("like", "starts_with"):
+        return args  # patterns stay as raw strings
+    dictionary = next((a.dictionary for a in args if a.dictionary is not None), None)
+    if dictionary is None:
+        return args
+    out = []
+    for a in args:
+        if a.dtype.kind is TypeKind.VARCHAR and isinstance(a.data, str):
+            s = a.data
+            if fn == "eq" and s not in dictionary._index:
+                # equality with an absent value is constant-false: encode
+                # as an impossible code
+                code = len(dictionary)
+            elif fn in ("lt", "le", "gt", "ge", "between"):
+                # range compare: lower_bound gives the order-preserving code
+                code = dictionary.lower_bound(s)
+                if fn in ("le", "gt") and (
+                    code < len(dictionary) and str(dictionary.values[code]) != s
+                ):
+                    # x <= s with s absent  ==  x < lb(s)  ==  x <= lb(s)-1
+                    code -= 1
+            else:
+                code = dictionary._index.get(s, len(dictionary))
+            cap = next(x.data.shape[0] for x in args if x.dictionary is not None)
+            out.append(
+                Val(
+                    jnp.full(cap, np.int32(code), dtype=jnp.int32),
+                    jnp.ones(cap, dtype=jnp.bool_),
+                    a.dtype,
+                    dictionary,
+                )
+            )
+        else:
+            out.append(a)
+    return out
+
+
+def evaluate_predicate(expr: Expr, batch: Batch):
+    """Evaluate a boolean expr to a device mask (NULL -> False)."""
+    v = evaluate(expr, batch)
+    return v.data & v.valid

@@ -1,0 +1,185 @@
+"""Logical type system.
+
+Reference parity: presto-common ``com.facebook.presto.common.type``
+(``BigintType``, ``IntegerType``, ``DoubleType``, ``DecimalType``,
+``VarcharType``, ``DateType``, ``BooleanType`` ... [SURVEY §2.1; reference
+tree unavailable, paths reconstructed from the upstream prestodb layout]).
+
+TPU-first physical mapping — every logical type maps onto a fixed-width
+device representation so batches are struct-of-arrays `jnp` tensors:
+
+=============  =========================================================
+Logical        Physical (device)
+=============  =========================================================
+BOOLEAN        bool_
+INTEGER        int32
+BIGINT         int64  (XLA:TPU emulates s64; hot paths downcast when safe)
+DOUBLE         float32 (TPU-native; exactness lives in DECIMAL, not FP)
+DECIMAL(p,s)   int64 scaled by 10**s — exact arithmetic, exact sums
+DATE           int32 days since 1970-01-01
+VARCHAR        int32 codes into an *ordered* host-side dictionary, so
+               code comparison == lexicographic comparison (analog of
+               the reference's DictionaryBlock, made order-preserving)
+BYTES(w)       uint8[cap, w] fixed-width padded bytes — the raw-string
+               representation for Pallas LIKE/substr kernels
+=============  =========================================================
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class TypeKind(enum.Enum):
+    BOOLEAN = "boolean"
+    INTEGER = "integer"
+    BIGINT = "bigint"
+    DOUBLE = "double"
+    DECIMAL = "decimal"
+    DATE = "date"
+    VARCHAR = "varchar"  # ordered-dictionary-encoded string
+    BYTES = "bytes"  # fixed-width raw bytes
+
+
+@dataclass(frozen=True)
+class DataType:
+    """A logical SQL type plus the parameters that pin its physical layout."""
+
+    kind: TypeKind
+    precision: int = 0  # DECIMAL precision
+    scale: int = 0  # DECIMAL scale
+    width: int = 0  # BYTES fixed width
+
+    # ---- physical layout ------------------------------------------------
+    @property
+    def np_dtype(self) -> np.dtype:
+        return np.dtype(_PHYSICAL[self.kind])
+
+    @property
+    def jnp_dtype(self):
+        return jnp.dtype(_PHYSICAL[self.kind])
+
+    @property
+    def is_string(self) -> bool:
+        return self.kind in (TypeKind.VARCHAR, TypeKind.BYTES)
+
+    @property
+    def is_numeric(self) -> bool:
+        return self.kind in (
+            TypeKind.INTEGER,
+            TypeKind.BIGINT,
+            TypeKind.DOUBLE,
+            TypeKind.DECIMAL,
+        )
+
+    @property
+    def is_orderable(self) -> bool:
+        return self.kind is not TypeKind.BYTES or self.width > 0
+
+    # ---- value conversion ----------------------------------------------
+    def to_physical(self, value):
+        """Convert one Python-level value to its physical scalar."""
+        if value is None:
+            return self.null_value()
+        if self.kind is TypeKind.DECIMAL:
+            return int(round(float(value) * 10**self.scale))
+        if self.kind is TypeKind.DATE:
+            if isinstance(value, str):
+                return (np.datetime64(value, "D") - np.datetime64("1970-01-01", "D")).astype(
+                    np.int32
+                )
+            return int(value)
+        if self.kind is TypeKind.BOOLEAN:
+            return bool(value)
+        if self.kind in (TypeKind.INTEGER, TypeKind.BIGINT):
+            return int(value)
+        if self.kind is TypeKind.DOUBLE:
+            return float(value)
+        raise TypeError(f"cannot convert scalar for {self}")
+
+    def from_physical(self, value):
+        """Convert one physical scalar back to a Python-level value."""
+        if self.kind is TypeKind.DECIMAL:
+            return int(value) / 10**self.scale
+        if self.kind is TypeKind.BOOLEAN:
+            return bool(value)
+        if self.kind is TypeKind.DOUBLE:
+            return float(value)
+        if self.kind is TypeKind.DATE:
+            return str(np.datetime64("1970-01-01", "D") + np.int64(value))
+        return int(value)
+
+    def null_value(self):
+        """Physical fill value used in NULL slots (masked by validity)."""
+        if self.kind is TypeKind.DOUBLE:
+            return 0.0
+        if self.kind is TypeKind.BOOLEAN:
+            return False
+        return 0
+
+    def __str__(self) -> str:
+        if self.kind is TypeKind.DECIMAL:
+            return f"decimal({self.precision},{self.scale})"
+        if self.kind is TypeKind.BYTES:
+            return f"bytes({self.width})"
+        return self.kind.value
+
+
+_PHYSICAL = {
+    TypeKind.BOOLEAN: np.bool_,
+    TypeKind.INTEGER: np.int32,
+    TypeKind.BIGINT: np.int64,
+    TypeKind.DOUBLE: np.float32,
+    TypeKind.DECIMAL: np.int64,
+    TypeKind.DATE: np.int32,
+    TypeKind.VARCHAR: np.int32,  # dictionary codes
+    TypeKind.BYTES: np.uint8,
+}
+
+BOOLEAN = DataType(TypeKind.BOOLEAN)
+INTEGER = DataType(TypeKind.INTEGER)
+BIGINT = DataType(TypeKind.BIGINT)
+DOUBLE = DataType(TypeKind.DOUBLE)
+DATE = DataType(TypeKind.DATE)
+
+
+def decimal(precision: int, scale: int) -> DataType:
+    return DataType(TypeKind.DECIMAL, precision=precision, scale=scale)
+
+
+def varchar() -> DataType:
+    return DataType(TypeKind.VARCHAR)
+
+
+VARCHAR = varchar()
+
+
+def fixed_bytes(width: int) -> DataType:
+    return DataType(TypeKind.BYTES, width=width)
+
+
+def common_super_type(a: DataType, b: DataType) -> DataType:
+    """Implicit-coercion lattice (reference: TypeCoercion in sql.analyzer)."""
+    if a == b:
+        return a
+    order = {
+        TypeKind.INTEGER: 0,
+        TypeKind.BIGINT: 1,
+        TypeKind.DECIMAL: 2,
+        TypeKind.DOUBLE: 3,
+    }
+    if a.kind in order and b.kind in order:
+        hi = a if order[a.kind] >= order[b.kind] else b
+        lo = b if hi is a else a
+        if hi.kind is TypeKind.DECIMAL and lo.kind is TypeKind.DECIMAL:
+            scale = max(a.scale, b.scale)
+            prec = max(a.precision - a.scale, b.precision - b.scale) + scale
+            return decimal(min(prec, 38), scale)
+        return hi
+    if a.kind is TypeKind.DATE and b.kind is TypeKind.DATE:
+        return a
+    raise TypeError(f"no common super type for {a} and {b}")

@@ -1,0 +1,35 @@
+"""Test harness config.
+
+Per SURVEY §4: tests run on a *virtual multi-device CPU mesh* so the real
+`all_to_all` / `all_gather` collective paths execute without TPU hardware
+(the analog of the reference's in-process DistributedQueryRunner, which
+boots coordinator+workers in one JVM with real HTTP exchanges).
+
+Env vars must be set before jax is imported anywhere.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import jax  # noqa: E402
+
+# A site hook may pre-register an accelerator plugin and force
+# jax_platforms via config (overriding the env var), which then blocks
+# on hardware init. Pin the config value itself before any backend
+# initializes: tests always run on the virtual CPU mesh.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(7)

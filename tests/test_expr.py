@@ -1,0 +1,143 @@
+"""Expression IR / evaluator tests, differentially against NumPy
+(reference parity: operator.scalar.* per-function tests [SURVEY §4])."""
+
+import numpy as np
+import pytest
+
+from presto_tpu import BIGINT, BOOLEAN, DOUBLE, Batch, Dictionary, decimal, varchar
+from presto_tpu.expr import Call, Literal, col, evaluate, evaluate_predicate, lit
+from presto_tpu.types import DATE, INTEGER, TypeKind
+
+
+def simple_batch():
+    types = {
+        "a": BIGINT,
+        "b": BIGINT,
+        "price": decimal(12, 2),
+        "disc": decimal(12, 2),
+        "ship": DATE,
+        "flag": varchar(),
+    }
+    d = Dictionary(["A", "N", "R"])
+    arrays = {
+        "a": np.array([1, 2, 3, 4], dtype=np.int64),
+        "b": np.array([10, 20, 30, 40], dtype=np.int64),
+        "price": np.array([10050, 20000, 123, 99999]),  # 100.50, 200.00, 1.23, 999.99
+        "disc": np.array([5, 10, 0, 6]),  # 0.05, 0.10, 0.00, 0.06
+        "ship": np.array([8766, 9000, 10000, 10591], dtype=np.int32),
+        "flag": d.encode(["A", "R", "N", "R"]),
+    }
+    return Batch.from_numpy(arrays, types, dictionaries={"flag": d})
+
+
+def test_arith_add():
+    b = simple_batch()
+    e = Call(BIGINT, "add", (col("a", BIGINT), col("b", BIGINT)))
+    v = evaluate(e, b)
+    np.testing.assert_array_equal(np.asarray(v.data), [11, 22, 33, 44])
+
+
+def test_decimal_mul_scale_cap():
+    b = simple_batch()
+    # price * (1 - disc): decimal(,2) * decimal(,2) -> scale 4
+    one = lit(1, decimal(12, 2))
+    e = Call(
+        decimal(38, 4),
+        "mul",
+        (col("price", decimal(12, 2)), Call(decimal(12, 2), "sub", (one, col("disc", decimal(12, 2))))),
+    )
+    v = evaluate(e, b)
+    got = np.asarray(v.data) / 1e4
+    want = np.array([100.50 * 0.95, 200.00 * 0.90, 1.23 * 1.00, 999.99 * 0.94])
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_comparison_and_between():
+    b = simple_batch()
+    e = Call(BOOLEAN, "between", (col("a", BIGINT), lit(2, BIGINT), lit(3, BIGINT)))
+    mask = evaluate_predicate(e, b)
+    np.testing.assert_array_equal(np.asarray(mask)[:4], [False, True, True, False])
+
+
+def test_varchar_eq_literal():
+    b = simple_batch()
+    e = Call(BOOLEAN, "eq", (col("flag", varchar()), lit("R", varchar())))
+    mask = evaluate_predicate(e, b)
+    np.testing.assert_array_equal(np.asarray(mask)[:4], [False, True, False, True])
+
+
+def test_varchar_eq_absent_literal_is_false():
+    b = simple_batch()
+    e = Call(BOOLEAN, "eq", (col("flag", varchar()), lit("Z", varchar())))
+    mask = evaluate_predicate(e, b)
+    assert not np.asarray(mask)[:4].any()
+
+
+def test_kleene_null_semantics():
+    types = {"x": BOOLEAN, "y": BOOLEAN}
+    arrays = {
+        "x": np.array([True, False, True, False]),
+        "y": np.array([True, True, True, False]),
+    }
+    valids = {
+        "x": np.array([True, True, False, False]),  # rows 2,3: x is NULL
+        "y": np.array([True, True, True, True]),
+    }
+    b = Batch.from_numpy(arrays, types, valids=valids)
+    v_and = evaluate(Call(BOOLEAN, "and", (col("x", BOOLEAN), col("y", BOOLEAN))), b)
+    # row2: NULL AND FALSE -> FALSE (valid); row3: NULL AND FALSE -> FALSE
+    assert bool(v_and.valid[3]) and not bool(v_and.data[3])
+    # NULL AND TRUE -> NULL
+    assert not bool(v_and.valid[2])
+    v_or = evaluate(Call(BOOLEAN, "or", (col("x", BOOLEAN), col("y", BOOLEAN))), b)
+    # NULL OR TRUE -> TRUE
+    assert bool(v_or.valid[2]) and bool(v_or.data[2])
+    # NULL OR FALSE -> NULL
+    assert not bool(v_or.valid[3])
+
+
+def test_date_extract_year():
+    b = simple_batch()
+    e = Call(INTEGER, "year", (col("ship", DATE),))
+    v = evaluate(e, b)
+    # 8766 days = 1994-01-01; 10591 = 1998-12-31
+    got = np.asarray(v.data)[:4]
+    assert got[0] == 1994
+    assert got[3] == 1998
+
+
+def test_like_on_dictionary():
+    types = {"s": varchar()}
+    d = Dictionary(["PROMO ANODIZED", "STANDARD BRUSHED", "PROMO PLATED", "ECONOMY"])
+    arrays = {"s": d.encode(["PROMO PLATED", "ECONOMY", "PROMO ANODIZED", "STANDARD BRUSHED"])}
+    b = Batch.from_numpy(arrays, types, dictionaries={"s": d})
+    e = Call(BOOLEAN, "like", (col("s", varchar()), lit("PROMO%", varchar())))
+    mask = evaluate_predicate(e, b)
+    np.testing.assert_array_equal(np.asarray(mask)[:4], [True, False, True, False])
+
+
+def test_case_expression():
+    b = simple_batch()
+    e = Call(
+        BIGINT,
+        "case",
+        (
+            Call(BOOLEAN, "gt", (col("a", BIGINT), lit(2, BIGINT))),
+            lit(100, BIGINT),
+            Call(BOOLEAN, "eq", (col("a", BIGINT), lit(1, BIGINT))),
+            lit(7, BIGINT),
+            lit(0, BIGINT),
+        ),
+    )
+    v = evaluate(e, b)
+    np.testing.assert_array_equal(np.asarray(v.data)[:4], [7, 0, 100, 100])
+
+
+def test_div_by_zero_is_null():
+    types = {"x": BIGINT, "y": BIGINT}
+    b = Batch.from_numpy(
+        {"x": np.array([10, 20]), "y": np.array([2, 0])}, types
+    )
+    v = evaluate(Call(DOUBLE, "div", (col("x", BIGINT), col("y", BIGINT))), b)
+    assert bool(v.valid[0]) and not bool(v.valid[1])
+    assert float(v.data[0]) == 5.0
