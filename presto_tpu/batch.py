@@ -44,11 +44,12 @@ class Dictionary:
     table).
     """
 
-    __slots__ = ("values", "_index")
+    __slots__ = ("values", "_index", "_values_str")
 
     def __init__(self, values: Sequence[str]):
         vals = sorted(set(values))
         self.values = np.array(vals, dtype=object)
+        self._values_str = np.array(vals, dtype=str)
         self._index = {v: i for i, v in enumerate(vals)}
 
     def __len__(self) -> int:
@@ -64,7 +65,7 @@ class Dictionary:
 
     def lower_bound(self, s: str) -> int:
         """First code whose string >= s (for range predicates on codes)."""
-        return int(np.searchsorted(self.values.astype(str), s, side="left"))
+        return int(np.searchsorted(self._values_str, s, side="left"))
 
     def decode(self, codes: np.ndarray) -> np.ndarray:
         return self.values[np.asarray(codes)]
@@ -177,6 +178,11 @@ class Batch:
         n = len(next(iter(arrays.values())))
         count = n if count is None else count
         cap = capacity or n
+        if cap < n:
+            raise ValueError(
+                f"capacity {cap} < {n} input rows: batches never silently "
+                "truncate; pick a larger capacity bucket"
+            )
         cols = {}
         for name, arr in arrays.items():
             t = types[name]
@@ -207,24 +213,10 @@ class Batch:
         for name, col in self.columns.items():
             data = np.asarray(col.data)[live]
             valid = np.asarray(col.valid)[live]
-            t = col.dtype
-            if t.kind is TypeKind.VARCHAR and decode_strings and col.dictionary is not None:
-                vals = col.dictionary.decode(data).astype(object)
-            elif t.kind is TypeKind.BYTES and decode_strings:
-                vals = np.array(
-                    [bytes(row).rstrip(b"\x00").decode("latin1") for row in data],
-                    dtype=object,
-                )
-            elif t.kind is TypeKind.DECIMAL and logical:
-                vals = data.astype(np.float64) / 10**t.scale
-            elif t.kind is TypeKind.DATE and logical:
-                vals = np.datetime64("1970-01-01", "D") + data.astype(np.int64)
-            else:
-                vals = data
-            if not valid.all():
-                vals = np.asarray(vals, dtype=object)
-                vals[~valid] = None
-            out[name] = vals
+            out[name] = decode_values(
+                data, valid, col.dtype, col.dictionary,
+                decode_strings=decode_strings, logical=logical,
+            )
         return pd.DataFrame(out)
 
     def __repr__(self) -> str:
@@ -240,3 +232,35 @@ jax.tree_util.register_pytree_node(
 def live_count(batch: Batch) -> int:
     """Host-side concrete live-row count."""
     return int(batch.count())
+
+
+def decode_values(
+    data: np.ndarray,
+    valid: np.ndarray | None,
+    dtype: DataType,
+    dictionary: Dictionary | None = None,
+    decode_strings: bool = True,
+    logical: bool = True,
+) -> np.ndarray:
+    """Physical -> logical value decode, shared by every host-side sink
+    (Batch.to_pandas, connectors' oracle fixtures, the client protocol).
+    BYTES are zero-padded on the right; padding (and only padding) is
+    stripped on decode."""
+    t = dtype
+    if t.kind is TypeKind.VARCHAR and decode_strings and dictionary is not None:
+        vals = dictionary.decode(data).astype(object)
+    elif t.kind is TypeKind.BYTES and decode_strings:
+        vals = np.array(
+            [bytes(row).rstrip(b"\x00").decode("latin1") for row in data],
+            dtype=object,
+        )
+    elif t.kind is TypeKind.DECIMAL and logical:
+        vals = data.astype(np.float64) / 10**t.scale
+    elif t.kind is TypeKind.DATE and logical:
+        vals = np.datetime64("1970-01-01", "D") + data.astype(np.int64)
+    else:
+        vals = data
+    if valid is not None and not valid.all():
+        vals = np.asarray(vals, dtype=object)
+        vals[~np.asarray(valid)] = None
+    return vals

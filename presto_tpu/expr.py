@@ -197,6 +197,17 @@ def _t_bigint(_):
 # ---- numeric helpers ------------------------------------------------------
 
 
+def _round_half_away(d, f):
+    """Divide int64 ``d`` by positive ``f`` rounding half away from zero.
+
+    jnp ``//`` floors (unlike C truncation), so negatives need their own
+    branch: |d| is rounded, then the sign is reapplied.
+    """
+    a = jnp.abs(d)
+    q = (a + f // 2) // f
+    return jnp.where(d >= 0, q, -q)
+
+
 def _to_physical(v: Val, target: DataType):
     """Rescale/convert v.data to target's physical representation."""
     src = v.dtype
@@ -214,9 +225,7 @@ def _to_physical(v: Val, target: DataType):
             if src.scale < target.scale:
                 return data.astype(jnp.int64) * np.int64(10 ** (target.scale - src.scale))
             f = np.int64(10 ** (src.scale - target.scale))
-            # round-half-away-from-zero
-            d = data.astype(jnp.int64)
-            return (d + jnp.sign(d) * (f // 2)) // f
+            return _round_half_away(data.astype(jnp.int64), f)
         return data.astype(jnp.int64) * np.int64(10**target.scale)
     if target.kind in (TypeKind.BIGINT, TypeKind.INTEGER, TypeKind.DATE):
         return data.astype(target.jnp_dtype)
@@ -249,8 +258,7 @@ def _mul_impl(args: list[Val], out: DataType):
         prod = x * y  # scale sa+sb
         excess = sa + sb - out.scale
         if excess > 0:
-            f = np.int64(10**excess)
-            prod = (prod + jnp.sign(prod) * (f // 2)) // f
+            prod = _round_half_away(prod, np.int64(10**excess))
         return prod, None
     x = _to_physical(a, out)
     y = _to_physical(b, out)
@@ -292,8 +300,17 @@ def _cmp_physicals(a: Val, b: Val):
     """Bring two comparable Vals to a common physical domain."""
     ta, tb = a.dtype, b.dtype
     if ta.kind is TypeKind.VARCHAR or tb.kind is TypeKind.VARCHAR:
-        # codes compare lexicographically within one ordered dictionary;
+        # codes compare lexicographically within ONE ordered dictionary;
         # literals are encoded against the column's dictionary upstream.
+        if (
+            a.dictionary is not None
+            and b.dictionary is not None
+            and a.dictionary is not b.dictionary
+        ):
+            raise ValueError(
+                "comparing VARCHAR columns from different dictionaries; "
+                "re-encode to a shared dictionary first"
+            )
         return a.data, b.data
     t = common_super_type(ta, tb) if ta != tb else ta
     if t.kind is TypeKind.DECIMAL:
@@ -425,10 +442,12 @@ def _in(args, out):
 def civil_from_days(days):
     """days since 1970-01-01 -> (year, month, day); branch-free int32 math.
 
-    Standard civil-calendar algorithm (Hinnant); vectorizes onto the VPU.
+    Standard civil-calendar algorithm (Hinnant), adapted to floor
+    division (jnp ``//`` floors, so no negative-era correction is
+    needed); vectorizes onto the VPU.
     """
     z = days.astype(jnp.int32) + 719468
-    era = jnp.where(z >= 0, z, z - 146096) // 146097
+    era = z // 146097
     doe = z - era * 146097
     yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
     y = yoe + era * 400
@@ -593,23 +612,21 @@ def _encode_string_literals(fn: str, args: list[Val]) -> list[Val]:
     if dictionary is None:
         return args
     out = []
-    for a in args:
+    for pos, a in enumerate(args):
         if a.dtype.kind is TypeKind.VARCHAR and isinstance(a.data, str):
             s = a.data
-            if fn == "eq" and s not in dictionary._index:
-                # equality with an absent value is constant-false: encode
-                # as an impossible code
-                code = len(dictionary)
-            elif fn in ("lt", "le", "gt", "ge", "between"):
-                # range compare: lower_bound gives the order-preserving code
+            if s in dictionary._index:
+                code = dictionary._index[s]
+            elif fn in ("lt", "ge") or (fn == "between" and pos == 1):
+                # x < s  ==  code < lb(s); x >= s  ==  code >= lb(s)
                 code = dictionary.lower_bound(s)
-                if fn in ("le", "gt") and (
-                    code < len(dictionary) and str(dictionary.values[code]) != s
-                ):
-                    # x <= s with s absent  ==  x < lb(s)  ==  x <= lb(s)-1
-                    code -= 1
+            elif fn in ("le", "gt") or (fn == "between" and pos == 2):
+                # x <= s with s absent  ==  code <= lb(s)-1 (may be -1:
+                # constant-false for le, constant-true for gt)
+                code = dictionary.lower_bound(s) - 1
             else:
-                code = dictionary._index.get(s, len(dictionary))
+                # eq/ne/in with an absent value: impossible code
+                code = len(dictionary)
             cap = next(x.data.shape[0] for x in args if x.dictionary is not None)
             out.append(
                 Val(

@@ -1,0 +1,67 @@
+"""Connector SPI — the engine/connector seam.
+
+Reference parity: ``presto-spi`` (``ConnectorMetadata``,
+``ConnectorSplitManager``, ``ConnectorSplit``, ``ConnectorPageSource``)
+[SURVEY §2.1; reference tree unavailable, paths reconstructed].
+
+TPU-first shape: a split is a deterministic key-range descriptor (pure
+data, shippable to any host); a page source produces host-columnar
+chunks that the engine pads into fixed-capacity device Batches. Column
+pruning happens at the source (`columns=`), and connectors expose
+statistics for the cost-based optimizer.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Mapping, Protocol, Sequence
+
+import numpy as np
+
+from presto_tpu.batch import Batch, Dictionary
+from presto_tpu.types import DataType
+
+
+@dataclass(frozen=True)
+class Split:
+    """A deterministic unit of scan work (a key range of a table)."""
+
+    table: str
+    chunk: int
+    lo: int
+    hi: int
+    row_hint: int  # expected output rows (>= actual is fine)
+
+
+class Connector(Protocol):
+    name: str
+
+    def tables(self) -> Sequence[str]: ...
+
+    def schema(self, table: str) -> Mapping[str, DataType]: ...
+
+    def dictionaries(self, table: str) -> Mapping[str, Dictionary]: ...
+
+    def splits(self, table: str, target_splits: int) -> Sequence[Split]: ...
+
+    def scan_numpy(
+        self, split: Split, columns: Sequence[str] | None = None
+    ) -> Mapping[str, np.ndarray]: ...
+
+    def scan(
+        self, split: Split, columns: Sequence[str] | None = None, capacity: int | None = None
+    ) -> Batch: ...
+
+    def row_count(self, table: str) -> int: ...
+
+
+def batch_capacity(n: int, minimum: int = 1024) -> int:
+    """Round a row count up to a compile-friendly capacity bucket.
+
+    Power-of-two buckets bound the number of distinct XLA programs per
+    operator chain (SURVEY §7.4 hard part #6).
+    """
+    cap = minimum
+    while cap < n:
+        cap *= 2
+    return cap

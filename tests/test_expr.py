@@ -141,3 +141,38 @@ def test_div_by_zero_is_null():
     v = evaluate(Call(DOUBLE, "div", (col("x", BIGINT), col("y", BIGINT))), b)
     assert bool(v.valid[0]) and not bool(v.valid[1])
     assert float(v.data[0]) == 5.0
+
+
+def test_negative_decimal_rescale_rounding():
+    """Regression: floor-division rounding must not shift negatives."""
+    types = {"x": decimal(12, 1)}
+    b = Batch.from_numpy({"x": np.array([-10, -11, -15, 10, 15])}, types)
+    from presto_tpu.expr import Call as C
+
+    from presto_tpu.expr import rescale_decimal
+
+    name = rescale_decimal(0)
+    v = evaluate(C(decimal(38, 0), name, (col("x", decimal(12, 1)),)), b)
+    # -1.0 -> -1, -1.1 -> -1, -1.5 -> -2 (half away), 1.0 -> 1, 1.5 -> 2
+    np.testing.assert_array_equal(np.asarray(v.data)[:5], [-1, -1, -2, 1, 2])
+
+
+def test_varchar_between_absent_bounds():
+    types = {"s": varchar()}
+    d = Dictionary(["A", "N", "R"])
+    b = Batch.from_numpy({"s": d.encode(["A", "N", "R"])}, types, dictionaries={"s": d})
+    e = Call(
+        BOOLEAN,
+        "between",
+        (col("s", varchar()), lit("B", varchar()), lit("M", varchar())),
+    )
+    mask = evaluate_predicate(e, b)
+    # only values in ["B","M"]: none of A/N/R qualify
+    assert not np.asarray(mask)[:3].any()
+    e2 = Call(
+        BOOLEAN,
+        "between",
+        (col("s", varchar()), lit("B", varchar()), lit("O", varchar())),
+    )
+    mask2 = evaluate_predicate(e2, b)
+    np.testing.assert_array_equal(np.asarray(mask2)[:3], [False, True, False])

@@ -1,0 +1,142 @@
+"""TPC-H generator tests: determinism, referential integrity, spec
+distributions (reference parity: airlift tpch generator tests [SURVEY §2.2])."""
+
+import numpy as np
+import pytest
+
+from presto_tpu.connectors.tpch import TpchConnector
+from presto_tpu.connectors.tpch import schema as S
+from presto_tpu.connectors.tpch.generator import (
+    customer_draw_to_key,
+    order_index_to_key,
+    partsupp_suppkey,
+)
+
+SF = 0.01  # 1500 customers, 15000 orders, ~60000 lineitems
+
+
+@pytest.fixture(scope="module")
+def conn():
+    return TpchConnector(sf=SF, units_per_split=4096)
+
+
+def test_row_counts(conn):
+    assert len(conn.table_numpy("customer")["c_custkey"]) == 1500
+    assert len(conn.table_numpy("orders")["o_orderkey"]) == 15000
+    assert len(conn.table_numpy("part")["p_partkey"]) == 2000
+    assert len(conn.table_numpy("partsupp")["ps_partkey"]) == 8000
+    assert len(conn.table_numpy("supplier")["s_suppkey"]) == 100
+    n = len(conn.table_numpy("lineitem", ["l_orderkey"])["l_orderkey"])
+    assert 15000 * 1 <= n <= 15000 * 7
+    assert abs(n / 15000 - 4.0) < 0.1  # mean lines/order
+
+
+def test_determinism_and_column_pruning_stability(conn):
+    s = conn.splits("lineitem")[0]
+    a = conn.scan_numpy(s, ["l_orderkey", "l_quantity", "l_comment"])
+    b = conn.scan_numpy(s, ["l_quantity"])
+    np.testing.assert_array_equal(a["l_quantity"], b["l_quantity"])
+    c = conn.scan_numpy(s, ["l_comment"])
+    np.testing.assert_array_equal(a["l_comment"], c["l_comment"])
+
+
+def test_orderkey_sparsity():
+    idx = np.arange(32)
+    keys = order_index_to_key(idx)
+    assert keys[0] == 1 and keys[7] == 8 and keys[8] == 33
+    assert ((keys - 1) % 32 < 8).all()
+
+
+def test_custkey_thirds():
+    draws = np.arange(1000)
+    keys = customer_draw_to_key(draws)
+    assert (keys % 3 != 0).all()
+    assert len(np.unique(keys)) == 1000
+
+
+def test_partsupp_four_distinct_suppliers(conn):
+    ps = conn.table_numpy("partsupp", ["ps_partkey", "ps_suppkey"])
+    pairs = set(zip(ps["ps_partkey"].tolist(), ps["ps_suppkey"].tolist()))
+    assert len(pairs) == len(ps["ps_partkey"])  # (partkey, suppkey) unique
+    assert (ps["ps_suppkey"] >= 1).all() and (ps["ps_suppkey"] <= 100).all()
+
+
+def test_lineitem_fk_into_partsupp(conn):
+    """Every (l_partkey, l_suppkey) must exist in partsupp (Q9 join)."""
+    li = conn.table_numpy("lineitem", ["l_partkey", "l_suppkey"])
+    ps = conn.table_numpy("partsupp", ["ps_partkey", "ps_suppkey"])
+    pairs = set(zip(ps["ps_partkey"].tolist(), ps["ps_suppkey"].tolist()))
+    li_pairs = set(zip(li["l_partkey"].tolist(), li["l_suppkey"].tolist()))
+    assert li_pairs <= pairs
+
+
+def test_orders_fk_into_customer(conn):
+    o = conn.table_numpy("orders", ["o_custkey"])
+    assert (o["o_custkey"] >= 1).all() and (o["o_custkey"] <= 1500).all()
+    assert (o["o_custkey"] % 3 != 0).all()
+
+
+def test_date_relationships(conn):
+    li = conn.table_numpy(
+        "lineitem", ["l_shipdate", "l_commitdate", "l_receiptdate"]
+    )
+    o = conn.table_numpy("orders", ["o_orderdate"])
+    assert (li["l_receiptdate"] > li["l_shipdate"]).all()
+    assert (li["l_receiptdate"] - li["l_shipdate"] <= 30).all()
+    assert (o["o_orderdate"] >= S.STARTDATE).all()
+    assert (o["o_orderdate"] <= S.ORDER_MAXDATE).all()
+
+
+def test_returnflag_linestatus_rule(conn):
+    li = conn.table_numpy(
+        "lineitem", ["l_returnflag", "l_linestatus", "l_shipdate", "l_receiptdate"]
+    )
+    dflag = S.DICTS["l_returnflag"]
+    dstat = S.DICTS["l_linestatus"]
+    n_code = dflag.code_of("N")
+    late = li["l_receiptdate"] > S.CURRENTDATE
+    assert ((li["l_returnflag"] == n_code) == late).all()
+    open_ = li["l_shipdate"] > S.CURRENTDATE
+    assert ((li["l_linestatus"] == dstat.code_of("O")) == open_).all()
+
+
+def test_totalprice_matches_lineitems(conn):
+    o = conn.table_numpy("orders", ["o_orderkey", "o_totalprice"])
+    li = conn.table_numpy(
+        "lineitem", ["l_orderkey", "l_extendedprice", "l_discount", "l_tax"]
+    )
+    charge = (
+        li["l_extendedprice"] * (100 - li["l_discount"]) * (100 + li["l_tax"])
+    )
+    charge = (charge + 5000) // 10000
+    import pandas as pd
+
+    got = pd.Series(charge).groupby(li["l_orderkey"]).sum()
+    want = pd.Series(o["o_totalprice"], index=o["o_orderkey"])
+    joined = want.to_frame("want").join(got.rename("got"))
+    assert (joined["want"] == joined["got"]).all()
+
+
+def test_comment_injection_rates(conn):
+    df = conn.table_pandas("orders", ["o_comment"])
+    frac = df["o_comment"].str.contains(r"special.*requests").mean()
+    assert 0.005 < frac < 0.10
+    sup = conn.table_pandas("supplier", ["s_comment"])
+    assert sup["s_comment"].str.contains("Customer").any() or len(sup) < 2000
+
+
+def test_scan_to_batch(conn):
+    s = conn.splits("lineitem")[0]
+    b = conn.scan(s, ["l_orderkey", "l_quantity", "l_returnflag", "l_shipdate"])
+    assert b.capacity >= s.row_hint / 7
+    df = b.to_pandas()
+    assert set(df["l_returnflag"]) <= {"R", "A", "N"}
+    assert (df["l_quantity"] >= 1).all() and (df["l_quantity"] <= 50).all()
+
+
+def test_nation_region(conn):
+    n = conn.table_pandas("nation")
+    r = conn.table_pandas("region")
+    assert len(n) == 25 and len(r) == 5
+    assert "GERMANY" in set(n["n_name"])
+    assert set(n["n_regionkey"]) == {0, 1, 2, 3, 4}
