@@ -1,0 +1,11 @@
+"""Relational kernels over fixed-capacity device arrays.
+
+These are the TPU-native replacements for the reference's hot operator
+internals (``GroupByHash``, ``PagesHash``/``JoinProbe``,
+``PagePartitioner`` ... [SURVEY §2.1]): sort/segment/gather idioms with
+static shapes instead of scatter-heavy open-addressing hash tables
+(SURVEY §7.1 design stance).
+"""
+
+from presto_tpu.ops.compact import compact_indices, compact_mask_overflow
+from presto_tpu.ops.hashing import hash_columns, mix64

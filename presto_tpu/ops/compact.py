@@ -1,0 +1,34 @@
+"""Row compaction: mask -> packed row indices.
+
+Reference parity: the positions-list/selected-positions machinery inside
+``PageProcessor`` and ``PartitionedOutputOperator``'s row gathering
+[SURVEY §2.1; reference tree unavailable]. TPU-first: compaction is the
+*only* data-movement primitive — filters just AND masks; rows physically
+move only at shuffle/build/output boundaries, and then via a single
+``nonzero``+gather with a static output capacity.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def compact_indices(mask, out_capacity: int):
+    """Packed indices of True positions, padded with ``cap`` (an
+    out-of-range sentinel safe for ``.at[].set`` with drop semantics /
+    gathers with fill).
+
+    Returns (indices[out_capacity], n_selected, overflowed).
+    ``overflowed`` is a traced bool: True when more rows were selected
+    than ``out_capacity`` — the host must retry at a larger bucket
+    (SURVEY §7.4 hard part #1).
+    """
+    cap = mask.shape[0]
+    n = jnp.sum(mask.astype(jnp.int32))
+    idx = jnp.nonzero(mask, size=out_capacity, fill_value=cap)[0]
+    return idx, n, n > out_capacity
+
+
+def compact_mask_overflow(mask, out_capacity: int):
+    """Just the overflow flag for a planned compaction."""
+    return jnp.sum(mask.astype(jnp.int32)) > out_capacity

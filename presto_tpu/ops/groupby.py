@@ -1,0 +1,143 @@
+"""Grouping kernels: row -> group-id assignment + segment aggregation.
+
+Reference parity: ``GroupByHash`` (``BigintGroupByHash`` fast path,
+``MultiChannelGroupByHash``) + ``InMemoryHashAggregationBuilder`` /
+``GroupedAccumulator`` [SURVEY §2.1, §3.3; reference tree unavailable].
+
+TPU-first (SURVEY §7.1): open-addressing hash tables are
+scatter-serialized on TPU, so grouping is
+
+- **direct addressing** when the composite key domain is small and
+  known (dictionary codes, bounded ints): gid = bit-packed key. The
+  analog of BigintGroupByHash's array-based fast path — Q1's
+  returnflag x linestatus lands here, zero sorting.
+- **sort-based** otherwise: stable multi-key argsort, adjacent-diff
+  boundaries, cumsum group ids — O(n log n) but built entirely from
+  TPU-friendly sort/gather/scan primitives.
+
+Aggregation is ``jax.ops.segment_*`` over the group ids with one extra
+"trash" segment that absorbs dead rows; outputs have a static
+``max_groups`` capacity with an overflow flag (SURVEY §7.4 #1).
+"""
+
+from __future__ import annotations
+
+from functools import reduce
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def gather_padded(arr, idx, fill):
+    """arr[idx] with out-of-range idx (>= len) producing ``fill``."""
+    cap = arr.shape[0]
+    safe = jnp.minimum(idx, cap - 1)
+    return jnp.where(idx < cap, arr[safe], fill)
+
+
+# ---------------------------------------------------------------------------
+# group-id assignment
+# ---------------------------------------------------------------------------
+
+
+def group_ids_direct(key_cols, mins, strides, live, num_groups: int):
+    """Direct-addressed gids: gid = sum_i (k_i - min_i) * stride_i.
+
+    Caller guarantees the packed domain is exactly ``num_groups``.
+    Dead rows get gid == num_groups (the trash segment).
+    Returns (gids, rep_valid) where rep_valid[g] marks groups with >=1
+    live row.
+    """
+    gid = None
+    for k, m, s in zip(key_cols, mins, strides):
+        t = (k.astype(jnp.int32) - np.int32(m)) * np.int32(s)
+        gid = t if gid is None else gid + t
+    gid = jnp.clip(gid, 0, num_groups - 1)
+    gid = jnp.where(live, gid, num_groups)
+    present = jnp.zeros(num_groups + 1, dtype=jnp.bool_).at[gid].set(True)[:num_groups]
+    return gid, present
+
+
+def group_ids_sort(key_cols, live, max_groups: int):
+    """Sort-based gids for arbitrary keys.
+
+    Returns (gids[cap], rep_idx[max_groups], ngroups, overflow):
+    - gids: per-row group id in [0, max_groups) for live rows,
+      ``max_groups`` (trash) for dead rows;
+    - rep_idx: original row index of each group's first member
+      (sentinel ``cap`` for unused slots) — gather key columns through
+      it to materialize group keys;
+    - overflow: True when distinct live keys exceeded max_groups.
+    """
+    cap = live.shape[0]
+    order = jnp.arange(cap)
+    for k in reversed(list(key_cols)):
+        order = order[jnp.argsort(k[order], stable=True)]
+    # liveness is the most significant key: live rows first
+    order = order[jnp.argsort(~live[order], stable=True)]
+
+    sl = live[order]
+    diffs = [k[order][1:] != k[order][:-1] for k in key_cols]
+    any_diff = reduce(jnp.logical_or, diffs) if diffs else jnp.zeros(cap - 1, bool)
+    boundary = any_diff | ~sl[:-1]
+    newgrp = jnp.concatenate([sl[:1], boundary & sl[1:]])
+    ngroups = jnp.sum(newgrp.astype(jnp.int32))
+    gid_sorted = jnp.cumsum(newgrp.astype(jnp.int32)) - 1
+    gid_sorted = jnp.where(sl, jnp.minimum(gid_sorted, max_groups), max_groups)
+    gids = jnp.zeros(cap, dtype=jnp.int32).at[order].set(gid_sorted)
+
+    rep_sorted = jnp.nonzero(newgrp, size=max_groups, fill_value=cap)[0]
+    rep_idx = gather_padded(order, rep_sorted, cap)
+    return gids, rep_idx, ngroups, ngroups > max_groups
+
+
+# ---------------------------------------------------------------------------
+# segment aggregation
+# ---------------------------------------------------------------------------
+
+_I64_MIN = np.int64(np.iinfo(np.int64).min)
+_I64_MAX = np.int64(np.iinfo(np.int64).max)
+
+
+def _identity(kind: str, dtype):
+    if kind == "min":
+        return (
+            jnp.asarray(np.inf, dtype)
+            if jnp.issubdtype(dtype, jnp.floating)
+            else jnp.asarray(jnp.iinfo(dtype).max, dtype)
+        )
+    if kind == "max":
+        return (
+            jnp.asarray(-np.inf, dtype)
+            if jnp.issubdtype(dtype, jnp.floating)
+            else jnp.asarray(jnp.iinfo(dtype).min, dtype)
+        )
+    return jnp.asarray(0, dtype)
+
+
+def segment_agg(values, contrib, gids, max_groups: int, kind: str):
+    """Aggregate ``values`` per group.
+
+    contrib: bool mask of rows that contribute (live AND value-valid).
+    kind: 'sum' | 'count' | 'min' | 'max'.
+    Returns array [max_groups] (trash segment sliced off). Groups with
+    no contributing rows yield the kind's identity — pair with a count
+    to rebuild SQL NULL semantics.
+    """
+    nseg = max_groups + 1
+    g = jnp.where(contrib, gids, max_groups)
+    if kind == "count":
+        return jax.ops.segment_sum(
+            contrib.astype(jnp.int64), g, num_segments=nseg
+        )[:max_groups]
+    if kind == "sum":
+        vals = jnp.where(contrib, values, _identity("sum", values.dtype))
+        return jax.ops.segment_sum(vals, g, num_segments=nseg)[:max_groups]
+    if kind == "min":
+        vals = jnp.where(contrib, values, _identity("min", values.dtype))
+        return jax.ops.segment_min(vals, g, num_segments=nseg)[:max_groups]
+    if kind == "max":
+        vals = jnp.where(contrib, values, _identity("max", values.dtype))
+        return jax.ops.segment_max(vals, g, num_segments=nseg)[:max_groups]
+    raise ValueError(f"unknown aggregate kind {kind!r}")

@@ -1,0 +1,47 @@
+"""Vectorized 64-bit key hashing.
+
+Reference parity: ``InterpretedHashGenerator`` / the XxHash64-based
+``CombineHashFunction`` used by ``GroupByHash`` and the
+``LocalPartitionGenerator`` [SURVEY §2.1; reference tree unavailable].
+TPU-first: a splitmix64 finalizer chain over int64 lanes — pure VPU
+bit-math, no lookup tables. The same function must be used engine-wide:
+partitioned exchanges rely on every device computing identical
+partition ids for a key.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+_M1 = np.uint64(0xBF58476D1CE4E5B9)
+_M2 = np.uint64(0x94D049BB133111EB)
+_GOLDEN = np.uint64(0x9E3779B97F4A7C15)
+
+
+def mix64(x):
+    """splitmix64 finalizer: uint64 -> uint64, good avalanche."""
+    x = x.astype(jnp.uint64)
+    x = (x ^ (x >> np.uint64(30))) * _M1
+    x = (x ^ (x >> np.uint64(27))) * _M2
+    return x ^ (x >> np.uint64(31))
+
+
+def hash_columns(columns) -> jnp.ndarray:
+    """Combined uint64 hash of one or more key arrays (int-like).
+
+    Combine rule: h = mix(h*GOLDEN ^ mix(col)) — order-sensitive, so
+    (a, b) and (b, a) hash differently.
+    """
+    h = None
+    for c in columns:
+        hc = mix64(c.astype(jnp.int64).view(jnp.uint64) if c.dtype == jnp.int64 else c.astype(jnp.uint64))
+        h = hc if h is None else mix64(h * _GOLDEN ^ hc)
+    return h
+
+
+def partition_ids(columns, num_partitions: int) -> jnp.ndarray:
+    """Hash-partition assignment in [0, num_partitions): the exchange's
+    row->consumer map (reference: PagePartitioner)."""
+    h = hash_columns(columns)
+    return (h % np.uint64(num_partitions)).astype(jnp.int32)

@@ -1,0 +1,54 @@
+"""Partitioning kernels: row -> destination packing for the exchange.
+
+Reference parity: ``PartitionedOutputOperator`` (``PagePartitioner``,
+per-partition PageBuilders) and the serialized-page OutputBuffer
+[SURVEY §2.1, §2.5; reference tree unavailable].
+
+TPU-first (SURVEY §2.5): instead of serializing pages into per-consumer
+HTTP buffers, rows are scattered into a dense ``[P, Q]`` send tensor
+(P destinations x Q quota rows) that feeds ``jax.lax.all_to_all``
+directly. Quota overflow (skew) raises the overflow flag so the host
+retries at a bigger quota or falls back to multi-round shuffles
+(SURVEY §7.4 #4).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def partition_layout(pids, live, num_partitions: int, quota: int):
+    """Compute each row's slot in the [P, quota] send buffer.
+
+    Returns (slot, counts, overflow):
+    - slot[cap]: flattened destination slot p*quota + rank, or P*quota
+      (dropped) for dead/overflowing rows;
+    - counts[P]: rows destined to each partition (pre-overflow);
+    - overflow: any partition exceeded its quota.
+    """
+    cap = pids.shape[0]
+    p = jnp.where(live, pids, num_partitions)
+    # rank of each row within its partition (stable by row order):
+    # sort rows by partition, rank = position - partition start
+    order = jnp.argsort(p, stable=True)
+    ps = p[order]
+    counts = jnp.zeros(num_partitions + 1, dtype=jnp.int32).at[p].add(1)[
+        :num_partitions
+    ]
+    starts = jnp.cumsum(counts) - counts
+    pos = jnp.arange(cap)
+    start_of_row = jnp.where(ps < num_partitions, starts[jnp.minimum(ps, num_partitions - 1)], 0)
+    rank_sorted = pos - start_of_row
+    rank = jnp.zeros(cap, dtype=jnp.int32).at[order].set(rank_sorted.astype(jnp.int32))
+    ok = live & (rank < quota)
+    slot = jnp.where(ok, p * quota + rank, num_partitions * quota)
+    overflow = jnp.any(counts > quota)
+    return slot, counts, overflow
+
+
+def scatter_to_buffer(values, slot, num_partitions: int, quota: int, fill=0):
+    """Scatter a column into the dense [P, quota] send tensor."""
+    flat = jnp.full((num_partitions * quota + 1,) + values.shape[1:], fill, values.dtype)
+    flat = flat.at[slot].set(values)
+    return flat[:-1].reshape((num_partitions, quota) + values.shape[1:])

@@ -1,0 +1,60 @@
+"""Ordering kernels: multi-key sort, Top-N.
+
+Reference parity: ``OrderByOperator`` (PagesIndex sort), ``TopNOperator``
+(bounded heap) [SURVEY §2.1; reference tree unavailable]. TPU-first:
+stable chained ``argsort`` (the device bitonic/radix sort XLA emits) —
+a heap is serial, a sort is parallel; Top-N is sort + static prefix.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def _desc_transform(k):
+    """Order-reversing transform so a single ascending sort handles
+    mixed ASC/DESC keys."""
+    if jnp.issubdtype(k.dtype, jnp.floating):
+        return -k
+    return ~k.astype(jnp.int64)  # bitwise-not reverses int order, no overflow
+
+
+def sort_indices(
+    key_cols: Sequence[jnp.ndarray],
+    descending: Sequence[bool],
+    live,
+    nulls_first: Sequence[bool] | None = None,
+    valids: Sequence[jnp.ndarray] | None = None,
+):
+    """Row order: stable multi-key argsort; dead rows sort last.
+
+    Returns order[cap] (original row indices, dead rows at the tail).
+    """
+    cap = live.shape[0]
+    order = jnp.arange(cap)
+    n = len(list(key_cols))
+    for i in range(n - 1, -1, -1):
+        kk = _desc_transform(key_cols[i]) if descending[i] else key_cols[i]
+        order = order[jnp.argsort(kk[order], stable=True)]
+        if valids is not None and valids[i] is not None:
+            # null placement is more significant than the key value:
+            # a second stable sort on the null flag (False sorts first)
+            is_null = ~valids[i]
+            nf = bool(nulls_first[i]) if nulls_first else False
+            flag = ~is_null if nf else is_null
+            order = order[jnp.argsort(flag[order], stable=True)]
+    order = order[jnp.argsort(~live[order], stable=True)]
+    return order
+
+
+def top_n_indices(key_cols, descending, live, n: int):
+    """Indices of the top-n rows by the sort order (sentinel cap
+    beyond the live count)."""
+    cap = live.shape[0]
+    order = sort_indices(key_cols, descending, live)
+    count = jnp.sum(live.astype(jnp.int32))
+    take = order[:n]
+    return jnp.where(jnp.arange(n) < count, take, cap)

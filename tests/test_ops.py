@@ -1,0 +1,239 @@
+"""Kernel tests, differential against NumPy/pandas (reference parity:
+operator-level unit tests w/ RowPagesBuilder+OperatorAssertion [SURVEY §4])."""
+
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+import pytest
+
+from presto_tpu.ops.compact import compact_indices
+from presto_tpu.ops.groupby import (
+    gather_padded,
+    group_ids_direct,
+    group_ids_sort,
+    segment_agg,
+)
+from presto_tpu.ops.hashing import hash_columns, partition_ids
+from presto_tpu.ops.join import (
+    build_lookup,
+    pack_key_columns,
+    probe_exists,
+    probe_expand,
+    probe_unique,
+)
+from presto_tpu.ops.partition import partition_layout, scatter_to_buffer
+from presto_tpu.ops.sort import sort_indices, top_n_indices
+
+
+def _live(n, cap):
+    m = np.zeros(cap, bool)
+    m[:n] = True
+    return jnp.asarray(m)
+
+
+def test_compact_indices():
+    mask = jnp.asarray(np.array([1, 0, 1, 1, 0, 0, 1, 0], bool))
+    idx, n, ovf = compact_indices(mask, 6)
+    assert int(n) == 4 and not bool(ovf)
+    np.testing.assert_array_equal(np.asarray(idx)[:4], [0, 2, 3, 6])
+    assert (np.asarray(idx)[4:] == 8).all()
+    _, _, ovf2 = compact_indices(mask, 3)
+    assert bool(ovf2)
+
+
+def test_hash_determinism_and_order_sensitivity():
+    a = jnp.asarray(np.arange(100, dtype=np.int64))
+    b = jnp.asarray(np.arange(100, dtype=np.int64)[::-1].copy())
+    h1 = hash_columns([a, b])
+    h2 = hash_columns([a, b])
+    np.testing.assert_array_equal(np.asarray(h1), np.asarray(h2))
+    h3 = hash_columns([b, a])
+    assert (np.asarray(h1) != np.asarray(h3)).any()
+    p = partition_ids([a], 8)
+    assert ((np.asarray(p) >= 0) & (np.asarray(p) < 8)).all()
+    # distribution sanity: no partition empty for 100 sequential keys
+    assert len(np.unique(np.asarray(p))) == 8
+
+
+def test_group_ids_sort_vs_numpy(rng):
+    cap, n, maxg = 64, 50, 32
+    k1 = rng.integers(0, 5, cap).astype(np.int64)
+    k2 = rng.integers(0, 3, cap).astype(np.int64)
+    live = _live(n, cap)
+    gids, rep, ng, ovf = group_ids_sort([jnp.asarray(k1), jnp.asarray(k2)], live, maxg)
+    want_groups = set(zip(k1[:n].tolist(), k2[:n].tolist()))
+    assert int(ng) == len(want_groups)
+    assert not bool(ovf)
+    # all rows of the same (k1,k2) share a gid; distinct pairs differ
+    df = pd.DataFrame({"k1": k1[:n], "k2": k2[:n], "g": np.asarray(gids)[:n]})
+    assert (df.groupby(["k1", "k2"])["g"].nunique() == 1).all()
+    assert df["g"].nunique() == len(want_groups)
+    # rep indices point at rows with matching keys
+    rep = np.asarray(rep)
+    for g in range(int(ng)):
+        r = rep[g]
+        assert r < cap
+        assert np.asarray(gids)[r] == g
+
+
+def test_group_ids_sort_overflow():
+    cap = 32
+    keys = jnp.asarray(np.arange(cap, dtype=np.int64))
+    gids, rep, ng, ovf = group_ids_sort([keys], _live(cap, cap), 8)
+    assert bool(ovf) and int(ng) == 32
+
+
+def test_segment_agg_vs_pandas(rng):
+    cap, n, maxg = 128, 100, 16
+    k = rng.integers(0, 10, cap).astype(np.int64)
+    v = rng.integers(-50, 50, cap).astype(np.int64)
+    valid = rng.random(cap) > 0.2
+    live = _live(n, cap)
+    gids, rep, ng, _ = group_ids_sort([jnp.asarray(k)], live, maxg)
+    contrib = jnp.asarray(valid) & live
+    s = segment_agg(jnp.asarray(v), contrib, gids, maxg, "sum")
+    c = segment_agg(jnp.asarray(v), contrib, gids, maxg, "count")
+    mn = segment_agg(jnp.asarray(v), contrib, gids, maxg, "min")
+    mx = segment_agg(jnp.asarray(v), contrib, gids, maxg, "max")
+    df = pd.DataFrame({"k": k[:n], "v": v[:n], "ok": valid[:n]})
+    df = df[df.ok]
+    want = df.groupby("k")["v"].agg(["sum", "count", "min", "max"])
+    gmap = {int(k[np.asarray(rep)[g]]): g for g in range(int(ng))}
+    for key, row in want.iterrows():
+        g = gmap[int(key)]
+        assert int(np.asarray(s)[g]) == row["sum"]
+        assert int(np.asarray(c)[g]) == row["count"]
+        assert int(np.asarray(mn)[g]) == row["min"]
+        assert int(np.asarray(mx)[g]) == row["max"]
+
+
+def test_group_ids_direct():
+    cap = 16
+    flag = np.array([0, 1, 2, 0, 1, 2, 0, 0] + [0] * 8, dtype=np.int32)
+    stat = np.array([0, 1, 0, 1, 0, 1, 0, 1] + [0] * 8, dtype=np.int32)
+    live = _live(8, cap)
+    gids, present = group_ids_direct(
+        [jnp.asarray(flag), jnp.asarray(stat)], [0, 0], [2, 1], live, 6
+    )
+    # gid = flag*2 + stat
+    np.testing.assert_array_equal(np.asarray(gids)[:8], [0, 3, 4, 1, 2, 5, 0, 1])
+    assert (np.asarray(gids)[8:] == 6).all()
+    assert np.asarray(present).all()
+
+
+def test_join_unique_probe(rng):
+    bcap, pcap = 32, 64
+    bkeys = np.arange(1, 21, dtype=np.int64) * 3  # 3,6,...,60 unique
+    bk = np.zeros(bcap, np.int64)
+    bk[:20] = bkeys
+    pkeys = rng.integers(1, 70, pcap).astype(np.int64)
+    build = build_lookup(jnp.asarray(bk), _live(20, bcap), 32)
+    assert not bool(build.overflow)
+    res = probe_unique(build, jnp.asarray(pkeys), _live(pcap, pcap))
+    for i in range(pcap):
+        want = pkeys[i] in set(bkeys.tolist())
+        assert bool(np.asarray(res.matched)[i]) == want
+        if want:
+            br = int(np.asarray(res.build_row)[i])
+            assert bk[br] == pkeys[i]
+
+
+def test_join_expand_vs_pandas(rng):
+    bcap, pcap, ocap = 32, 16, 128
+    bk = rng.integers(0, 6, bcap).astype(np.int64)  # duplicate keys
+    pk = rng.integers(0, 8, pcap).astype(np.int64)
+    bn, pn = 25, 12
+    build = build_lookup(jnp.asarray(bk), _live(bn, bcap), 32)
+    res = probe_expand(build, jnp.asarray(pk), _live(pn, pcap), ocap)
+    assert not bool(res.overflow)
+    got = []
+    for j in range(ocap):
+        if bool(np.asarray(res.live)[j]):
+            got.append(
+                (int(np.asarray(res.probe_row)[j]), int(np.asarray(res.build_row)[j]))
+            )
+    left = pd.DataFrame({"k": pk[:pn], "p": np.arange(pn)})
+    right = pd.DataFrame({"k": bk[:bn], "b": np.arange(bn)})
+    want = left.merge(right, on="k")
+    want_pairs = set(zip(want["p"].tolist(), want["b"].tolist()))
+    assert set(got) == want_pairs
+    assert int(res.n_out) == len(want_pairs)
+
+
+def test_join_expand_overflow():
+    bcap, pcap = 16, 8
+    bk = np.zeros(bcap, np.int64)  # all same key
+    pk = np.zeros(pcap, np.int64)
+    build = build_lookup(jnp.asarray(bk), _live(16, bcap), 16)
+    res = probe_expand(build, jnp.asarray(pk), _live(8, pcap), 64)
+    assert bool(res.overflow)  # 8*16=128 > 64
+    assert int(res.n_out) == 128
+
+
+def test_probe_exists():
+    bk = jnp.asarray(np.array([2, 4, 6, 0], dtype=np.int64))
+    build = build_lookup(bk, _live(3, 4), 4)
+    pk = jnp.asarray(np.array([1, 2, 3, 4, 5, 6], dtype=np.int64))
+    m = probe_exists(build, pk, _live(6, 6))
+    np.testing.assert_array_equal(np.asarray(m), [False, True, False, True, False, True])
+
+
+def test_sort_and_topn(rng):
+    cap, n = 32, 20
+    k1 = rng.integers(0, 5, cap).astype(np.int64)
+    k2 = rng.integers(0, 100, cap).astype(np.int64)
+    live = _live(n, cap)
+    order = sort_indices([jnp.asarray(k1), jnp.asarray(k2)], [False, True], live)
+    o = np.asarray(order)[:n]
+    df = pd.DataFrame({"k1": k1[:n], "k2": k2[:n]}).sort_values(
+        ["k1", "k2"], ascending=[True, False], kind="stable"
+    )
+    np.testing.assert_array_equal(k1[o], df["k1"].to_numpy())
+    np.testing.assert_array_equal(k2[o], df["k2"].to_numpy())
+    top = top_n_indices([jnp.asarray(k2)], [True], live, 5)
+    want_top = np.sort(k2[:n])[::-1][:5]
+    np.testing.assert_array_equal(np.sort(k2[np.asarray(top)])[::-1], want_top)
+
+
+def test_sort_nulls_ordering():
+    cap = 8
+    k = jnp.asarray(np.array([3, 1, 2, 5, 4, 0, 0, 0], dtype=np.int64))
+    valid = jnp.asarray(np.array([1, 1, 0, 1, 0, 0, 0, 0], bool))
+    live = _live(5, cap)
+    order = sort_indices([k], [False], live, nulls_first=[False], valids=[valid])
+    o = np.asarray(order)[:5]
+    np.testing.assert_array_equal(o, [1, 0, 3, 2, 4])  # 1,3,5 then nulls (2,4)
+    order_nf = sort_indices([k], [False], live, nulls_first=[True], valids=[valid])
+    onf = np.asarray(order_nf)[:5]
+    np.testing.assert_array_equal(onf, [2, 4, 1, 0, 3])
+
+
+def test_partition_roundtrip(rng):
+    cap, n, P, Q = 64, 50, 4, 32
+    keys = rng.integers(0, 1000, cap).astype(np.int64)
+    live = _live(n, cap)
+    pids = partition_ids([jnp.asarray(keys)], P)
+    slot, counts, ovf = partition_layout(pids, live, P, Q)
+    assert not bool(ovf)
+    assert int(np.asarray(counts).sum()) == n
+    buf = scatter_to_buffer(jnp.asarray(keys), slot, P, Q, fill=-1)
+    got = np.asarray(buf)
+    for p in range(P):
+        want = sorted(keys[:n][np.asarray(pids)[:n] == p].tolist())
+        have = sorted(x for x in got[p].tolist() if x != -1)
+        assert want == have
+
+
+def test_partition_overflow():
+    cap, P, Q = 32, 4, 4
+    keys = jnp.asarray(np.full(cap, 7, dtype=np.int64))  # all -> same pid
+    pids = partition_ids([keys], P)
+    slot, counts, ovf = partition_layout(pids, _live(32, cap), P, Q)
+    assert bool(ovf)
+
+
+def test_pack_key_columns():
+    a = jnp.asarray(np.array([1, 2, 3], dtype=np.int64))
+    b = jnp.asarray(np.array([0, 1, 0], dtype=np.int64))
+    packed = pack_key_columns([a, b], [8, 1])
+    np.testing.assert_array_equal(np.asarray(packed), [2, 5, 6])
