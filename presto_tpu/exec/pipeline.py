@@ -1,0 +1,111 @@
+"""Pipelines and the driver loop.
+
+Reference parity: ``operator.Driver.processFor`` — the inner loop moving
+Pages between adjacent operators — and ``DriverFactory``/pipeline
+structure from ``LocalExecutionPlanner`` [SURVEY §2.1, §3.2; reference
+tree unavailable, paths reconstructed].
+
+TPU-first: the driver is a *push* loop on the host; batches are device
+arrays, so each ``process`` call is an async XLA dispatch and the loop
+runs ahead of the device (the cooperative time-slicing machinery of
+``TaskExecutor`` collapses into Python + the XLA stream). A pipeline is
+``source -> transforms... -> sink``; pipeline-breaking operators
+(aggregations, sorts, joins' build side) buffer device-side and emit on
+``finish()``.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Iterable, Iterator, Sequence
+
+from presto_tpu.batch import Batch
+from presto_tpu.exec.operators import Operator
+from presto_tpu.spi import Connector, Split, batch_capacity
+
+
+@dataclass
+class OperatorStats:
+    """Per-operator runtime stats (reference: OperatorStats rollup into
+    QueryStats [SURVEY §5.1])."""
+
+    name: str
+    input_batches: int = 0
+    output_batches: int = 0
+    wall_s: float = 0.0
+
+
+class ScanSource:
+    """Pulls splits from a connector and yields device batches
+    (reference: ScanFilterAndProjectOperator's page source half +
+    SourcePartitionedScheduler's split feed)."""
+
+    def __init__(
+        self,
+        connector: Connector,
+        table: str,
+        columns: Sequence[str] | None,
+        splits: Sequence[Split] | None = None,
+        capacity: int | None = None,
+    ):
+        self.connector = connector
+        self.table = table
+        self.columns = list(columns) if columns is not None else None
+        self.splits = list(splits) if splits is not None else list(connector.splits(table))
+        # one shared capacity bucket across splits keeps a single
+        # compiled program per chain
+        self.capacity = capacity or batch_capacity(
+            max(s.row_hint for s in self.splits)
+        )
+
+    def __iter__(self) -> Iterator[Batch]:
+        for split in self.splits:
+            yield self.connector.scan(split, self.columns, self.capacity)
+
+
+class BatchSource:
+    """A source over in-memory batches (exchange inputs, tests)."""
+
+    def __init__(self, batches: Iterable[Batch]):
+        self._batches = batches
+
+    def __iter__(self) -> Iterator[Batch]:
+        return iter(self._batches)
+
+
+class Pipeline:
+    """source -> op chain; run() returns the terminal output batches."""
+
+    def __init__(self, source: Iterable[Batch], operators: Sequence[Operator]):
+        self.source = source
+        self.operators = list(operators)
+        self.stats = [OperatorStats(type(op).__name__) for op in self.operators]
+
+    def run(self) -> list[Batch]:
+        outputs: list[Batch] = []
+
+        def push(i: int, batch: Batch):
+            if i == len(self.operators):
+                outputs.append(batch)
+                return
+            st = self.stats[i]
+            st.input_batches += 1
+            t0 = time.perf_counter()
+            produced = self.operators[i].process(batch)
+            st.wall_s += time.perf_counter() - t0
+            for b in produced:
+                st.output_batches += 1
+                push(i + 1, b)
+
+        for batch in self.source:
+            push(0, batch)
+        # finish cascade
+        for i, op in enumerate(self.operators):
+            t0 = time.perf_counter()
+            tail = op.finish()
+            self.stats[i].wall_s += time.perf_counter() - t0
+            for b in tail:
+                self.stats[i].output_batches += 1
+                push(i + 1, b)
+        return outputs
