@@ -1,0 +1,93 @@
+"""Benchmark driver: TPC-H Q1 rows/sec/chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Methodology (mirrors the reference's HandTpchQuery1 operator benchmark
+[SURVEY §6]): lineitem columns for the benchmark scale factor are
+materialized device-resident (the reference's tpch connector also
+serves generated, memory-resident data), then the fused Q1 step
+(filter + 6-group decimal aggregation) is timed warm over all batches.
+
+vs_baseline: BASELINE.json sets the north star at >=10x rows/sec vs the
+Java operators on equal-cost CPUs. The Java engine's Q1 aggregation
+throughput on a CPU node cost-equivalent to one v5e chip (~24 vCPU) is
+estimated at ~8M rows/s/core x 24 = 1.9e8 rows/s (JMH
+BenchmarkHashAggregationOperator order of magnitude; no published
+numbers exist — SURVEY §6). vs_baseline = value / 1.9e8, so
+vs_baseline >= 10 means the north star is met.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+BASELINE_ROWS_PER_SEC = 1.9e8  # equal-cost CPU estimate (see docstring)
+
+
+def main() -> None:
+    import os
+
+    import jax
+
+    sf = float(sys.argv[1]) if len(sys.argv) > 1 else 1.0
+    # Local smoke runs: PRESTO_TPU_BENCH_CPU=1 pins the CPU backend
+    # before any accelerator plugin initializes (the TPU tunnel hangs
+    # hard when unhealthy). The driver's real bench run uses the TPU.
+    if os.environ.get("PRESTO_TPU_BENCH_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+    devices = jax.devices()
+    dev = devices[0]
+
+    from presto_tpu.connectors.tpch import TpchConnector
+    from presto_tpu.spi import batch_capacity
+    from presto_tpu.workloads import Q1_COLS, combine_q1_states, q1_fused_step
+
+    conn = TpchConnector(sf=sf, units_per_split=1 << 18)
+    splits = list(conn.splits("lineitem"))
+    cap = batch_capacity(max(s.row_hint for s in splits))
+
+    step = jax.jit(q1_fused_step)
+    batches = []
+    total_rows = 0
+    for s in splits:
+        b = conn.scan(s, Q1_COLS, cap)
+        b = jax.device_put(b, dev)
+        total_rows += int(b.count())
+        batches.append(b)
+
+    # warmup / compile
+    state = step(batches[0])
+    jax.block_until_ready(state)
+
+    def run():
+        st = step(batches[0])
+        for b in batches[1:]:
+            st = combine_q1_states(st, step(b))
+        jax.block_until_ready(st)
+        return st
+
+    run()  # warm
+    iters = 3
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        st = run()
+    t1 = time.perf_counter()
+    secs = (t1 - t0) / iters
+    rows_per_sec = total_rows / secs
+
+    print(
+        json.dumps(
+            {
+                "metric": f"tpch_q1_rows_per_sec_per_chip_sf{sf:g}",
+                "value": round(rows_per_sec),
+                "unit": "rows/s",
+                "vs_baseline": round(rows_per_sec / BASELINE_ROWS_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

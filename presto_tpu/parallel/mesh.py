@@ -1,0 +1,40 @@
+"""Device mesh setup — the worker set.
+
+Reference parity: the coordinator's view of the cluster
+(``DiscoveryNodeManager``'s NodeMap + ``NodeScheduler`` placing tasks
+on workers [SURVEY §2.1]). TPU-first: the "cluster" is a
+``jax.sharding.Mesh``; placement is a sharding annotation, and the
+entire REST control plane collapses into the single-controller driver
+(SURVEY §7.1).
+
+One mesh axis ``"workers"`` plays the role of Presto's worker set: scan
+splits are data-parallel across it, hash-partitioned exchanges are
+``all_to_all`` along it, broadcasts are ``all_gather``. Multi-host later
+adds an outer DCN axis without changing fragment code.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+WORKERS = "workers"
+
+
+def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    devs = list(devices) if devices is not None else jax.devices()
+    if n_devices is not None:
+        if len(devs) < n_devices:
+            raise ValueError(f"need {n_devices} devices, have {len(devs)}")
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (WORKERS,))
+
+
+def row_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard batch rows across the worker axis (data parallel scan)."""
+    return NamedSharding(mesh, PartitionSpec(WORKERS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())

@@ -1,0 +1,166 @@
+"""Hand-built physical plans for the benchmark workloads.
+
+Reference parity: ``presto-benchmark``'s hand-built operator pipelines
+(``HandTpchQuery1`` / ``HandTpchQuery6`` [SURVEY §6]) — the same role:
+benchmark the operator/kernel layer without the SQL frontend. Shared by
+tests, ``bench.py`` and ``__graft_entry__.py``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from presto_tpu.batch import Batch
+from presto_tpu.connectors.tpch import TpchConnector
+from presto_tpu.exec.operators import (
+    AggSpec,
+    DirectStrategy,
+    FilterProjectOperator,
+    HashAggregationOperator,
+)
+from presto_tpu.exec.pipeline import Pipeline, ScanSource
+from presto_tpu.expr import Call, col, evaluate, evaluate_predicate, lit
+from presto_tpu.ops.groupby import group_ids_direct, segment_agg
+from presto_tpu.types import BIGINT, BOOLEAN, DATE, decimal, varchar
+
+dec2 = decimal(12, 2)
+dec4 = decimal(38, 4)
+
+Q1_COLS = [
+    "l_returnflag", "l_linestatus", "l_quantity", "l_extendedprice",
+    "l_discount", "l_tax", "l_shipdate",
+]
+Q1_CUTOFF = "1998-09-02"  # date '1998-12-01' - interval '90' day
+Q1_GROUPS = 6  # |returnflag| x |linestatus| = 3 x 2
+
+
+def q1_exprs():
+    one = lit(1, dec2)
+    disc_price = Call(
+        dec4, "mul",
+        (col("l_extendedprice", dec2), Call(dec2, "sub", (one, col("l_discount", dec2)))),
+    )
+    charge = Call(dec4, "mul", (disc_price, Call(dec2, "add", (one, col("l_tax", dec2)))))
+    pred = Call(BOOLEAN, "le", (col("l_shipdate", DATE), lit(Q1_CUTOFF, DATE)))
+    return pred, disc_price, charge
+
+
+def q1_aggs():
+    _, disc_price, charge = q1_exprs()
+    return [
+        AggSpec("sum", col("l_quantity", dec2), "sum_qty", decimal(38, 2)),
+        AggSpec("sum", col("l_extendedprice", dec2), "sum_base_price", decimal(38, 2)),
+        AggSpec("sum", disc_price, "sum_disc_price", dec4),
+        AggSpec("sum", charge, "sum_charge", dec4),
+        AggSpec("count_star", None, "count_order", BIGINT),
+    ]
+
+
+def q1_strategy() -> DirectStrategy:
+    return DirectStrategy((0, 0), (2, 1), Q1_GROUPS)
+
+
+def q1_pipeline(conn: TpchConnector):
+    pred, _, _ = q1_exprs()
+    return Pipeline(
+        ScanSource(conn, "lineitem", Q1_COLS),
+        [
+            FilterProjectOperator(pred, None),
+            HashAggregationOperator(
+                [("l_returnflag", col("l_returnflag", varchar())),
+                 ("l_linestatus", col("l_linestatus", varchar()))],
+                q1_aggs(), q1_strategy(),
+            ),
+        ],
+    )
+
+
+# ---------------------------------------------------------------------------
+# The fused single-step form: one traced function Batch -> state.
+# This is the engine's "forward step": what per-query JIT compilation
+# produces for the leaf fragment of Q1 (scan -> filter -> partial agg).
+# ---------------------------------------------------------------------------
+
+
+def q1_fused_step(batch: Batch):
+    """One fully-fused Q1 partial-aggregation step over a batch.
+
+    Returns a dict of [6]-arrays: sums per (returnflag x linestatus)
+    group plus the group-present mask and row count.
+    """
+    pred, disc_price, charge = q1_exprs()
+    live = batch.live & evaluate_predicate(pred, batch)
+    gids, present = group_ids_direct(
+        [batch["l_returnflag"].data, batch["l_linestatus"].data],
+        (0, 0), (2, 1), live, Q1_GROUPS,
+    )
+    qty = batch["l_quantity"].data
+    ep = batch["l_extendedprice"].data
+    dp = evaluate(disc_price, batch).data
+    ch = evaluate(charge, batch).data
+    seg = partial(segment_agg, gids=gids, max_groups=Q1_GROUPS, kind="sum")
+    return {
+        "present": present,
+        "sum_qty": seg(qty, live),
+        "sum_base_price": seg(ep, live),
+        "sum_disc_price": seg(dp, live),
+        "sum_charge": seg(ch, live),
+        "count_order": segment_agg(
+            jnp.ones_like(qty), live, gids, Q1_GROUPS, "count"
+        ),
+    }
+
+
+def combine_q1_states(a: dict, b: dict) -> dict:
+    out = {k: a[k] + b[k] for k in a if k != "present"}
+    out["present"] = a["present"] | b["present"]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Distributed Q1: data-parallel partial agg + psum final combine.
+# The minimal real multi-chip fragment step (SURVEY §2.4 DP row).
+# ---------------------------------------------------------------------------
+
+
+def q1_distributed_step(mesh):
+    """Returns a jitted SPMD step: sharded Batch -> replicated Q1 state.
+
+    Rows are sharded over the ``workers`` axis (each device holds its
+    scan partition); partial aggregation runs per device; the final
+    combine is a ``psum`` over ICI — the degenerate (6-group) case of
+    the partitioned-exchange final aggregation.
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from presto_tpu.parallel.mesh import WORKERS
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(WORKERS),),
+        out_specs=P(),
+        check_vma=False,
+    )
+    def step(batch: Batch):
+        state = q1_fused_step(batch)
+
+        def allreduce(x):
+            if x.dtype == jnp.bool_:
+                return jax.lax.psum(x.astype(jnp.int32), WORKERS) > 0
+            return jax.lax.psum(x, WORKERS)
+
+        return jax.tree.map(allreduce, state)
+
+    return jax.jit(step)
+
+
+def q1_batch(conn: TpchConnector, split=None, capacity=None) -> Batch:
+    splits = conn.splits("lineitem")
+    s = split if split is not None else splits[0]
+    return conn.scan(s, Q1_COLS, capacity)
