@@ -1,0 +1,128 @@
+"""Join operator tests (reference parity: TestHashJoinOperator with
+RowPagesBuilder-style fixtures [SURVEY §4])."""
+
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+import pytest
+
+from presto_tpu.batch import Batch
+from presto_tpu.exec.joins import BuildOutput, JoinBuildOperator, LookupJoinOperator
+from presto_tpu.exec.operators import CapacityOverflow
+from presto_tpu.exec.pipeline import BatchSource, Pipeline
+from presto_tpu.expr import col
+from presto_tpu.types import BIGINT, DOUBLE, INTEGER
+
+
+def _batch(arrays, types, cap=None, valids=None):
+    return Batch.from_numpy(arrays, types, capacity=cap, valids=valids)
+
+
+def build_batch():
+    return _batch(
+        {"bk": np.array([1, 3, 5, 7], dtype=np.int64),
+         "bval": np.array([10, 30, 50, 70], dtype=np.int64)},
+        {"bk": BIGINT, "bval": BIGINT}, cap=8,
+    )
+
+
+def probe_batch():
+    return _batch(
+        {"pk": np.array([5, 2, 3, 7, 9, 1], dtype=np.int64),
+         "pval": np.array([100, 200, 300, 400, 500, 600], dtype=np.int64)},
+        {"pk": BIGINT, "pval": BIGINT}, cap=8,
+    )
+
+
+def run_join(join_type, unique=True, outputs=(BuildOutput("bval", "bval"),)):
+    b = JoinBuildOperator(col("bk", BIGINT))
+    Pipeline(BatchSource([build_batch()]), [b]).run()
+    j = LookupJoinOperator(
+        b, col("pk", BIGINT), outputs, join_type, unique=unique,
+        out_capacity=None if unique or join_type in ("semi", "anti") else 32,
+    )
+    out = Pipeline(BatchSource([probe_batch()]), [j]).run()
+    return pd.concat([o.to_pandas() for o in out])
+
+
+def test_inner_unique():
+    df = run_join("inner").sort_values("pk")
+    assert df["pk"].tolist() == [1, 3, 5, 7]
+    assert df["bval"].tolist() == [10, 30, 50, 70]
+    assert df["pval"].tolist() == [600, 300, 100, 400]
+
+
+def test_left_outer_unique():
+    df = run_join("left").sort_values("pk")
+    assert df["pk"].tolist() == [1, 2, 3, 5, 7, 9]
+    vals = dict(zip(df["pk"], df["bval"]))
+    assert vals[2] is None and vals[9] is None
+    assert vals[3] == 30
+
+
+def test_semi():
+    df = run_join("semi", outputs=()).sort_values("pk")
+    assert df["pk"].tolist() == [1, 3, 5, 7]
+    assert list(df.columns) == ["pk", "pval"]
+
+
+def test_anti():
+    df = run_join("anti", outputs=()).sort_values("pk")
+    assert df["pk"].tolist() == [2, 9]
+
+
+def test_expansion_join_with_duplicates():
+    bb = _batch(
+        {"bk": np.array([1, 1, 2, 2, 2], dtype=np.int64),
+         "bval": np.array([10, 11, 20, 21, 22], dtype=np.int64)},
+        {"bk": BIGINT, "bval": BIGINT}, cap=8,
+    )
+    b = JoinBuildOperator(col("bk", BIGINT))
+    Pipeline(BatchSource([bb]), [b]).run()
+    j = LookupJoinOperator(
+        b, col("pk", BIGINT), [BuildOutput("bval", "bval")], "inner",
+        unique=False, out_capacity=32,
+    )
+    out = Pipeline(BatchSource([probe_batch()]), [j]).run()
+    df = pd.concat([o.to_pandas() for o in out])
+    left = probe_batch().to_pandas()
+    right = bb.to_pandas()
+    want = left.merge(right, left_on="pk", right_on="bk")
+    got = df.sort_values(["pk", "bval"]).reset_index(drop=True)
+    want = want.sort_values(["pk", "bval"]).reset_index(drop=True)
+    assert got["pk"].tolist() == want["pk"].tolist()
+    assert got["bval"].tolist() == want["bval"].tolist()
+    assert got["pval"].tolist() == want["pval"].tolist()
+
+
+def test_expansion_overflow_raises():
+    bb = _batch(
+        {"bk": np.zeros(8, dtype=np.int64), "bval": np.arange(8, dtype=np.int64)},
+        {"bk": BIGINT, "bval": BIGINT},
+    )
+    pb = _batch(
+        {"pk": np.zeros(8, dtype=np.int64), "pval": np.arange(8, dtype=np.int64)},
+        {"pk": BIGINT, "pval": BIGINT},
+    )
+    b = JoinBuildOperator(col("bk", BIGINT))
+    Pipeline(BatchSource([bb]), [b]).run()
+    j = LookupJoinOperator(
+        b, col("pk", BIGINT), [BuildOutput("bval", "bval")], "inner",
+        unique=False, out_capacity=16,
+    )
+    with pytest.raises(CapacityOverflow):
+        Pipeline(BatchSource([pb]), [j]).run()
+
+
+def test_null_probe_keys_never_match():
+    b = JoinBuildOperator(col("bk", BIGINT))
+    Pipeline(BatchSource([build_batch()]), [b]).run()
+    pb = _batch(
+        {"pk": np.array([1, 3], dtype=np.int64), "pval": np.array([1, 2], dtype=np.int64)},
+        {"pk": BIGINT, "pval": BIGINT},
+        valids={"pk": np.array([True, False])},
+    )
+    j = LookupJoinOperator(b, col("pk", BIGINT), (), "inner")
+    out = Pipeline(BatchSource([pb]), [j]).run()
+    df = pd.concat([o.to_pandas() for o in out])
+    assert df["pval"].tolist() == [1]

@@ -1,0 +1,167 @@
+"""Milestone B (SURVEY §7.2 step 4): TPC-H Q3 end-to-end —
+customer ⋈ orders ⋈ lineitem, high-cardinality grouped agg, TopN.
+
+select l_orderkey, sum(l_extendedprice*(1-l_discount)) revenue,
+       o_orderdate, o_shippriority
+from customer, orders, lineitem
+where c_mktsegment='BUILDING' and c_custkey=o_custkey
+  and l_orderkey=o_orderkey and o_orderdate < '1995-03-15'
+  and l_shipdate > '1995-03-15'
+group by l_orderkey, o_orderdate, o_shippriority
+order by revenue desc, o_orderdate limit 10
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from presto_tpu.connectors.tpch import TpchConnector
+from presto_tpu.exec.joins import BuildOutput, JoinBuildOperator, LookupJoinOperator
+from presto_tpu.exec.operators import (
+    AggSpec,
+    FilterProjectOperator,
+    HashAggregationOperator,
+    SortKey,
+    SortStrategy,
+    TopNOperator,
+)
+from presto_tpu.exec.pipeline import Pipeline, ScanSource
+from presto_tpu.expr import Call, col, lit
+from presto_tpu.types import BIGINT, BOOLEAN, DATE, INTEGER, decimal, varchar
+
+SF = 0.01
+DATE_CUT = "1995-03-15"
+dec2 = decimal(12, 2)
+dec4 = decimal(38, 4)
+
+
+def revenue_expr():
+    one = lit(1, dec2)
+    return Call(
+        dec4, "mul",
+        (col("l_extendedprice", dec2),
+         Call(dec2, "sub", (one, col("l_discount", dec2)))),
+    )
+
+
+def run_q3(conn):
+    # stage 1: customer build (filtered to BUILDING)
+    cust_build = JoinBuildOperator(col("c_custkey", BIGINT))
+    Pipeline(
+        ScanSource(conn, "customer", ["c_custkey", "c_mktsegment"]),
+        [
+            FilterProjectOperator(
+                Call(BOOLEAN, "eq",
+                     (col("c_mktsegment", varchar()), lit("BUILDING", varchar()))),
+                None,
+            ),
+            cust_build,
+        ],
+    ).run()
+
+    # stage 2: orders filtered + semi-joined to customers -> build side 2
+    orders_build = JoinBuildOperator(col("o_orderkey", BIGINT))
+    Pipeline(
+        ScanSource(conn, "orders",
+                   ["o_orderkey", "o_custkey", "o_orderdate", "o_shippriority"]),
+        [
+            FilterProjectOperator(
+                Call(BOOLEAN, "lt", (col("o_orderdate", DATE), lit(DATE_CUT, DATE))),
+                None,
+            ),
+            LookupJoinOperator(cust_build, col("o_custkey", BIGINT), (), "inner"),
+            orders_build,
+        ],
+    ).run()
+
+    # stage 3: lineitem probe -> agg -> topN
+    p = Pipeline(
+        ScanSource(conn, "lineitem",
+                   ["l_orderkey", "l_extendedprice", "l_discount", "l_shipdate"]),
+        [
+            FilterProjectOperator(
+                Call(BOOLEAN, "gt", (col("l_shipdate", DATE), lit(DATE_CUT, DATE))),
+                None,
+            ),
+            LookupJoinOperator(
+                orders_build, col("l_orderkey", BIGINT),
+                [BuildOutput("o_orderdate", "o_orderdate"),
+                 BuildOutput("o_shippriority", "o_shippriority")],
+                "inner",
+            ),
+            HashAggregationOperator(
+                [("l_orderkey", col("l_orderkey", BIGINT)),
+                 ("o_orderdate", col("o_orderdate", DATE)),
+                 ("o_shippriority", col("o_shippriority", INTEGER))],
+                [AggSpec("sum", revenue_expr(), "revenue", dec4)],
+                SortStrategy(8192),
+            ),
+            TopNOperator(
+                [SortKey(col("revenue", dec4), descending=True),
+                 SortKey(col("o_orderdate", DATE))],
+                10,
+            ),
+        ],
+    )
+    out = p.run()
+    return pd.concat([b.to_pandas(logical=False) for b in out])
+
+
+def q3_oracle(conn):
+    cust = conn.table_pandas("customer", ["c_custkey", "c_mktsegment"])
+    orders = conn.table_pandas(
+        "orders", ["o_orderkey", "o_custkey", "o_orderdate", "o_shippriority"]
+    )
+    li = conn.table_numpy(
+        "lineitem", ["l_orderkey", "l_extendedprice", "l_discount", "l_shipdate"]
+    )
+    cut = (np.datetime64(DATE_CUT) - np.datetime64("1970-01-01")).astype(int)
+    m = li["l_shipdate"] > cut
+    lid = pd.DataFrame(
+        {
+            "l_orderkey": li["l_orderkey"][m],
+            "rev": li["l_extendedprice"][m].astype(np.int64)
+            * (100 - li["l_discount"][m].astype(np.int64)),  # scale 4 exact
+        }
+    )
+    cust = cust[cust.c_mktsegment == "BUILDING"]
+    orders = orders[orders.o_orderdate < np.datetime64(DATE_CUT)]
+    j = orders.merge(cust, left_on="o_custkey", right_on="c_custkey")
+    j = lid.merge(j, left_on="l_orderkey", right_on="o_orderkey")
+    g = (
+        j.groupby(["l_orderkey", "o_orderdate", "o_shippriority"])["rev"]
+        .sum()
+        .reset_index()
+    )
+    g = g.sort_values(
+        ["rev", "o_orderdate"], ascending=[False, True], kind="stable"
+    ).head(10)
+    return g
+
+
+@pytest.fixture(scope="module")
+def conn():
+    return TpchConnector(sf=SF, units_per_split=1 << 14)
+
+
+def test_q3_end_to_end(conn):
+    got = run_q3(conn)
+    want = q3_oracle(conn)
+    assert len(got) == len(want) == 10
+    # revenues must match exactly (scaled ints); order by revenue desc
+    np.testing.assert_array_equal(
+        got["revenue"].to_numpy().astype(np.int64),
+        want["rev"].to_numpy(),
+    )
+    np.testing.assert_array_equal(
+        got["l_orderkey"].to_numpy().astype(np.int64),
+        want["l_orderkey"].to_numpy(),
+    )
+    # o_orderdate comes back as raw day ints with logical=False
+    want_days = (
+        want["o_orderdate"].to_numpy().astype("datetime64[D]")
+        - np.datetime64("1970-01-01")
+    ).astype(np.int64)
+    np.testing.assert_array_equal(
+        got["o_orderdate"].to_numpy().astype(np.int64), want_days
+    )
