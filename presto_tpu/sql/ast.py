@@ -1,0 +1,202 @@
+"""SQL abstract syntax tree.
+
+Reference parity: ``com.facebook.presto.sql.tree`` (``Query``,
+``QuerySpecification``, ``Select``, ``Join``, ``ComparisonExpression``,
+...) [SURVEY §2.1; reference tree unavailable, paths reconstructed].
+Small immutable dataclasses; the analyzer turns these into the typed
+relational IR — the AST itself is untyped.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+# ---------------------------------------------------------------------------
+# expressions
+# ---------------------------------------------------------------------------
+
+
+class Node:
+    pass
+
+
+@dataclass(frozen=True)
+class Identifier(Node):
+    parts: tuple[str, ...]  # ("o", "custkey") or ("custkey",)
+
+    def __str__(self):
+        return ".".join(self.parts)
+
+
+@dataclass(frozen=True)
+class NumberLit(Node):
+    text: str  # keep text: "1", "0.05" — analyzer picks int/decimal/double
+
+    def __str__(self):
+        return self.text
+
+
+@dataclass(frozen=True)
+class StringLit(Node):
+    value: str
+
+
+@dataclass(frozen=True)
+class DateLit(Node):
+    value: str  # 'YYYY-MM-DD'
+
+
+@dataclass(frozen=True)
+class IntervalLit(Node):
+    value: str
+    unit: str  # day | month | year
+
+
+@dataclass(frozen=True)
+class BinaryOp(Node):
+    op: str  # + - * / % = <> < <= > >= and or
+    left: Node
+    right: Node
+
+
+@dataclass(frozen=True)
+class UnaryOp(Node):
+    op: str  # - not
+    operand: Node
+
+
+@dataclass(frozen=True)
+class FunctionCall(Node):
+    name: str
+    args: tuple[Node, ...]
+    distinct: bool = False
+    is_star: bool = False  # count(*)
+
+
+@dataclass(frozen=True)
+class CaseExpr(Node):
+    whens: tuple[tuple[Node, Node], ...]
+    else_: Optional[Node]
+    operand: Optional[Node] = None  # CASE x WHEN v THEN ...
+
+
+@dataclass(frozen=True)
+class Between(Node):
+    value: Node
+    low: Node
+    high: Node
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class InList(Node):
+    value: Node
+    items: tuple[Node, ...]
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class InSubquery(Node):
+    value: Node
+    query: "Query"
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class Exists(Node):
+    query: "Query"
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class ScalarSubquery(Node):
+    query: "Query"
+
+
+@dataclass(frozen=True)
+class Like(Node):
+    value: Node
+    pattern: Node
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class IsNull(Node):
+    value: Node
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class Cast(Node):
+    value: Node
+    type_name: str  # "double", "decimal(12,2)", "date", "bigint", "varchar"
+
+
+@dataclass(frozen=True)
+class Extract(Node):
+    field: str  # year | month | day
+    value: Node
+
+
+@dataclass(frozen=True)
+class Star(Node):
+    qualifier: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class Substring(Node):
+    value: Node
+    start: Node
+    length: Optional[Node]
+
+
+# ---------------------------------------------------------------------------
+# relations & query structure
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Table(Node):
+    name: str
+    alias: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class SubqueryRelation(Node):
+    query: "Query"
+    alias: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class Join(Node):
+    kind: str  # inner | left | right | full | cross
+    left: Node
+    right: Node
+    on: Optional[Node] = None
+
+
+@dataclass(frozen=True)
+class SelectItem(Node):
+    expr: Node
+    alias: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class OrderItem(Node):
+    expr: Node
+    descending: bool = False
+    nulls_first: Optional[bool] = None
+
+
+@dataclass(frozen=True)
+class Query(Node):
+    select: tuple[SelectItem, ...]
+    from_: Optional[Node]  # relation tree (None for SELECT <expr>)
+    where: Optional[Node] = None
+    group_by: tuple[Node, ...] = ()
+    having: Optional[Node] = None
+    order_by: tuple[OrderItem, ...] = ()
+    limit: Optional[int] = None
+    distinct: bool = False
+    ctes: tuple[tuple[str, "Query"], ...] = ()  # WITH name AS (query)
