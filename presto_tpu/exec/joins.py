@@ -163,10 +163,11 @@ class LookupJoinOperator(Operator):
 
         out_cap = self.out_capacity
         assert out_cap is not None, "expansion join requires out_capacity"
+        left = jt == "left"
 
         def step(side: BuildSide, payload: Batch, batch: Batch):
             v = evaluate(key, batch)
-            res = probe_expand(side, v.data, batch.live & v.valid, out_cap)
+            res = probe_expand(side, v.data, batch.live & v.valid, out_cap, left=left)
             cols = {}
             for name in batch.names:
                 src = batch[name]

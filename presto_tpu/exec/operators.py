@@ -157,18 +157,37 @@ class HashAggregationOperator(Operator):
         aggs: Sequence[AggSpec],
         strategy: DirectStrategy | SortStrategy,
         phase: str = "single",  # single | partial | final
+        passengers: Sequence[tuple[str, Expr]] = (),
     ):
         self.group_keys = list(group_keys)
         self.aggs = list(aggs)
         self.strategy = strategy
         self.phase = phase
+        self.passengers = list(passengers)
         self.state: dict[str, Any] | None = None
         self._dicts: dict[str, Dictionary | None] = {}
         self._key_types: dict[str, DataType] = {n: e.dtype for n, e in self.group_keys}
         if isinstance(strategy, DirectStrategy):
+            if self.passengers:
+                raise ValueError("passenger keys need the sort strategy")
             self._update = jax.jit(self._direct_update)
         else:
             self._update = jax.jit(self._sort_update)
+
+    @staticmethod
+    def _sortable(v):
+        """Group-sort surrogate: BYTES(<=7) packs big-endian into int64
+        (order-preserving with zero padding); others pass through."""
+        data, dtype = v.data, v.dtype
+        if dtype.kind is TypeKind.BYTES:
+            w = dtype.width
+            if w > 7:
+                raise ValueError("cannot sort-group wide BYTES keys")
+            out = jnp.zeros(data.shape[0], jnp.int64)
+            for i in range(w):
+                out = (out << np.int64(8)) | data[:, i].astype(jnp.int64)
+            return out
+        return data
 
     # -- shared helpers ---------------------------------------------------
 
@@ -195,21 +214,33 @@ class HashAggregationOperator(Operator):
         return out
 
     def _eval_keys(self, batch: Batch):
-        cols = []
+        """Key Vals (dictionaries captured at trace time)."""
+        out = []
         for name, e in self.group_keys:
             v = evaluate(e, batch)
             if v.dictionary is not None:
                 self._dicts[name] = v.dictionary
             else:
                 self._dicts.setdefault(name, None)
-            cols.append(v.data)
-        return cols
+            out.append(v)
+        return out
+
+    def _eval_passengers(self, batch: Batch):
+        out = []
+        for name, e in self.passengers:
+            v = evaluate(e, batch)
+            if v.dictionary is not None:
+                self._dicts[name] = v.dictionary
+            else:
+                self._dicts.setdefault(name, None)
+            out.append(v)
+        return out
 
     # -- direct-addressed path -------------------------------------------
 
     def _direct_update(self, state, batch: Batch):
         st: DirectStrategy = self.strategy
-        keys = self._eval_keys(batch)
+        keys = [v.data for v in self._eval_keys(batch)]
         gids, present = group_ids_direct(
             keys, st.mins, st.strides, batch.live, st.num_groups
         )
@@ -247,25 +278,42 @@ class HashAggregationOperator(Operator):
 
     def _sort_update(self, state, batch: Batch):
         """Fold a batch into the state by concatenating the state rows
-        (as a pseudo-batch) with the batch's per-group partials, then
-        re-grouping — bounded memory, two sorts per batch."""
+        (as a pseudo-batch) with the batch's rows, then re-grouping —
+        bounded memory, one multi-key sort per batch."""
         st: SortStrategy = self.strategy
         g = st.max_groups
-        keys = self._eval_keys(batch)
+        kvals = self._eval_keys(batch)
+        pvals = self._eval_passengers(batch)
         inputs = self._eval_inputs(batch)
 
-        # concat: state keys [g] + batch rows [cap]
-        cat_keys = [
-            jnp.concatenate([state["key$" + n], k.astype(state["key$" + n].dtype)])
-            for (n, _), k in zip(self.group_keys, keys)
-        ]
+        # concat: state group rows [g] + batch rows [cap]
+        cat_sort = []
+        for (n, _), v in zip(self.group_keys, kvals):
+            s = self._sortable(v)
+            cat_sort.append(
+                jnp.concatenate([state["key$" + n], s.astype(state["key$" + n].dtype)])
+            )
         cat_live = jnp.concatenate([state["present"], batch.live])
-        gids, rep, ng, ovf = group_ids_sort(cat_keys, cat_live, g)
+        gids, rep, ng, ovf = group_ids_sort(cat_sort, cat_live, g)
+
+        def gat(cat, fill=0):
+            if cat.ndim > 1:
+                safe = jnp.minimum(rep, cat.shape[0] - 1)
+                return jnp.where((rep < cat.shape[0])[:, None], cat[safe], fill)
+            return gather_padded(cat, rep, fill)
 
         new = dict(state)
         new["overflow"] = state["overflow"] | ovf
-        for i, (n, _) in enumerate(self.group_keys):
-            new["key$" + n] = gather_padded(cat_keys[i], rep, 0)
+        for i, ((n, e), v) in enumerate(zip(self.group_keys, kvals)):
+            new["key$" + n] = gat(cat_sort[i])
+            if e.dtype.kind is TypeKind.BYTES:
+                cat_raw = jnp.concatenate([state["keyraw$" + n], v.data])
+                new["keyraw$" + n] = gat(cat_raw)
+        for (n, e), v in zip(self.passengers, pvals):
+            cat_p = jnp.concatenate([state["pax$" + n], v.data])
+            cat_pv = jnp.concatenate([state["paxv$" + n], v.valid])
+            new["pax$" + n] = gat(cat_p)
+            new["paxv$" + n] = gather_padded(cat_pv, rep, False)
         present = jnp.arange(g) < ng
         new["present"] = present
         for a, (vals, contrib) in zip(self.aggs, inputs):
@@ -283,7 +331,7 @@ class HashAggregationOperator(Operator):
             new[a.name + "$has"] = ncnt > 0
         return new
 
-    def _sort_init(self, batch: Batch):
+    def _sort_init(self):
         st: SortStrategy = self.strategy
         g = st.max_groups
         state: dict[str, Any] = {
@@ -291,7 +339,17 @@ class HashAggregationOperator(Operator):
             "overflow": jnp.zeros((), jnp.bool_),
         }
         for name, e in self.group_keys:
-            state["key$" + name] = jnp.zeros(g, e.dtype.jnp_dtype)
+            if e.dtype.kind is TypeKind.BYTES:
+                state["key$" + name] = jnp.zeros(g, jnp.int64)  # packed
+                state["keyraw$" + name] = jnp.zeros((g, e.dtype.width), jnp.uint8)
+            else:
+                state["key$" + name] = jnp.zeros(g, e.dtype.jnp_dtype)
+        for name, e in self.passengers:
+            if e.dtype.kind is TypeKind.BYTES:
+                state["pax$" + name] = jnp.zeros((g, e.dtype.width), jnp.uint8)
+            else:
+                state["pax$" + name] = jnp.zeros(g, e.dtype.jnp_dtype)
+            state["paxv$" + name] = jnp.zeros(g, jnp.bool_)
         for a in self.aggs:
             dt = _phys_dtype(a)
             from presto_tpu.ops.groupby import _identity
@@ -308,7 +366,7 @@ class HashAggregationOperator(Operator):
             if isinstance(self.strategy, DirectStrategy):
                 self.state = self._direct_init()
             else:
-                self.state = self._sort_init(batch)
+                self.state = self._sort_init()
         # key-column dictionaries are discovered at trace time
         self.state = self._update(self.state, batch)
         return []
@@ -318,7 +376,7 @@ class HashAggregationOperator(Operator):
             if isinstance(self.strategy, DirectStrategy):
                 self.state = self._direct_init()
             else:
-                return [self._empty_output()]
+                self.state = self._sort_init()
         st = self.state
         if isinstance(self.strategy, SortStrategy) and bool(st["overflow"]):
             raise CapacityOverflow("HashAggregation", self.strategy.max_groups)
@@ -344,8 +402,16 @@ class HashAggregationOperator(Operator):
             g = self.strategy.max_groups
             live = st["present"]
             for name, e in self.group_keys:
+                if e.dtype.kind is TypeKind.BYTES:
+                    data = st["keyraw$" + name]
+                else:
+                    data = st["key$" + name]
                 cols[name] = Column(
-                    st["key$" + name], jnp.ones(g, jnp.bool_), e.dtype,
+                    data, jnp.ones(g, jnp.bool_), e.dtype, self._dicts.get(name)
+                )
+            for name, e in self.passengers:
+                cols[name] = Column(
+                    st["pax$" + name], st["paxv$" + name], e.dtype,
                     self._dicts.get(name),
                 )
         for a in self.aggs:
@@ -361,24 +427,6 @@ class HashAggregationOperator(Operator):
             data = jnp.where(valid, data, 0)
             cols[a.name] = Column(data.astype(a.dtype.jnp_dtype), valid, a.dtype)
         return [Batch(cols, live)]
-
-    def _empty_output(self) -> Batch:
-        g = (
-            self.strategy.num_groups
-            if isinstance(self.strategy, DirectStrategy)
-            else self.strategy.max_groups
-        )
-        cols = {}
-        for name, e in self.group_keys:
-            cols[name] = Column(
-                jnp.zeros(g, e.dtype.jnp_dtype), jnp.zeros(g, jnp.bool_), e.dtype,
-                self._dicts.get(name),
-            )
-        for a in self.aggs:
-            cols[a.name] = Column(
-                jnp.zeros(g, a.dtype.jnp_dtype), jnp.zeros(g, jnp.bool_), a.dtype
-            )
-        return Batch(cols, jnp.zeros(g, jnp.bool_))
 
 
 def _phys_dtype(a: AggSpec):
@@ -557,9 +605,16 @@ class TopNOperator(CollectingOperator):
         )
         take = order[: self.n]
         live = gather_padded(batch.live, take, False)
+
+        def gat(data):
+            if data.ndim > 1:
+                safe = jnp.minimum(take, data.shape[0] - 1)
+                return jnp.where((take < data.shape[0])[:, None], data[safe], 0)
+            return gather_padded(data, take, 0)
+
         cols = {
             n_: Column(
-                gather_padded(batch[n_].data, take, 0),
+                gat(batch[n_].data),
                 gather_padded(batch[n_].valid, take, False),
                 batch[n_].dtype,
                 batch[n_].dictionary,

@@ -88,6 +88,29 @@ class Call(Expr):
         return f"{self.fn}({', '.join(map(str, self.args))})"
 
 
+@dataclass(frozen=True)
+class Unbound(Expr):
+    """A runtime-scalar slot (uncorrelated scalar subquery result).
+    The executor substitutes a Literal before compiling the consuming
+    pipeline; evaluating an Unbound directly is an error."""
+
+    name: str = ""
+
+    def __str__(self) -> str:
+        return f"?{self.name}"
+
+
+def bind_scalars(e: Expr, values: dict[str, Any]) -> Expr:
+    """Replace Unbound slots with Literals (executor-side)."""
+    if isinstance(e, Unbound):
+        if e.name not in values:
+            raise KeyError(f"unbound scalar {e.name}")
+        return Literal(e.dtype, values[e.name])
+    if isinstance(e, Call):
+        return Call(e.dtype, e.fn, tuple(bind_scalars(a, values) for a in e.args))
+    return e
+
+
 def col(name: str, dtype: DataType) -> InputRef:
     return InputRef(dtype, name)
 
@@ -296,6 +319,40 @@ def _neg(args, out):
 # ---- comparisons ----------------------------------------------------------
 
 
+def _bytes_sign(a: Val, b: Val):
+    """3-way lexicographic compare involving a BYTES side: returns an
+    int32 sign array; comparisons test it against 0."""
+    from presto_tpu.ops import strings as ops_strings
+
+    if a.dtype.kind is TypeKind.BYTES and isinstance(b.data, str):
+        lit = ops_strings.pad_literal(b.data, a.data.shape[1])
+        return ops_strings.bytes_compare(
+            a.data, jnp.broadcast_to(jnp.asarray(lit), a.data.shape)
+        )
+    if b.dtype.kind is TypeKind.BYTES and isinstance(a.data, str):
+        lit = ops_strings.pad_literal(a.data, b.data.shape[1])
+        return -ops_strings.bytes_compare(
+            b.data, jnp.broadcast_to(jnp.asarray(lit), b.data.shape)
+        )
+    if a.dtype.kind is TypeKind.BYTES and b.dtype.kind is TypeKind.BYTES:
+        from presto_tpu.ops.strings import bytes_compare
+
+        w = max(a.data.shape[1], b.data.shape[1])
+
+        def widen(d):
+            if d.shape[1] == w:
+                return d
+            pad = jnp.zeros((d.shape[0], w - d.shape[1]), d.dtype)
+            return jnp.concatenate([d, pad], axis=1)
+
+        return bytes_compare(widen(a.data), widen(b.data))
+    raise TypeError("not a BYTES comparison")
+
+
+def _is_bytes_cmp(a: Val, b: Val) -> bool:
+    return a.dtype.kind is TypeKind.BYTES or b.dtype.kind is TypeKind.BYTES
+
+
 def _cmp_physicals(a: Val, b: Val):
     """Bring two comparable Vals to a common physical domain."""
     ta, tb = a.dtype, b.dtype
@@ -322,6 +379,9 @@ def _cmp_physicals(a: Val, b: Val):
 
 def _cmp(op):
     def impl(args: list[Val], out: DataType):
+        if _is_bytes_cmp(args[0], args[1]):
+            sign = _bytes_sign(args[0], args[1])
+            return op(sign, jnp.zeros_like(sign)), None
         x, y = _cmp_physicals(args[0], args[1])
         return op(x, y), None
 
@@ -429,11 +489,15 @@ def _case(args, out):
 def _in(args, out):
     """in(needle, v1, v2, ...) — small literal lists."""
     needle = args[0]
-    hit = jnp.zeros_like(needle.valid)
+    hit = None
     for v in args[1:]:
-        x, y = _cmp_physicals(needle, v)
-        hit = hit | (x == y)
-    return hit, None
+        if _is_bytes_cmp(needle, v):
+            h = _bytes_sign(needle, v) == 0
+        else:
+            x, y = _cmp_physicals(needle, v)
+            h = x == y
+        hit = h if hit is None else (hit | h)
+    return hit, needle.valid if needle.valid is not None else None
 
 
 # ---- dates ----------------------------------------------------------------
@@ -533,12 +597,17 @@ def _dict_predicate_table(dictionary: Dictionary, pred) -> np.ndarray:
 @register("like", _t_bool)
 def _like(args, out):
     """like(col, pattern_literal). Dictionary path: host regex over the
-    dictionary -> device gather. BYTES path handled in ops.strings."""
+    dictionary -> device gather by code (a scan over distinct values).
+    BYTES path: vectorized sliding-window segment matching on device."""
     import re
 
     target, pat = args
+    if target.dtype.kind is TypeKind.BYTES:
+        from presto_tpu.ops.strings import like_mask
+
+        return like_mask(target.data, pat.data), None
     if target.dictionary is None:
-        raise NotImplementedError("LIKE on non-dictionary column: use ops.strings")
+        raise NotImplementedError("LIKE on dictionary-less VARCHAR")
     rx = re.compile(_like_to_regex(pat.data))
     table = _dict_predicate_table(target.dictionary, lambda v: rx.match(v) is not None)
     return jnp.asarray(table)[target.data], None
@@ -547,10 +616,34 @@ def _like(args, out):
 @register("starts_with", _t_bool)
 def _starts_with(args, out):
     target, pref = args
+    if target.dtype.kind is TypeKind.BYTES:
+        from presto_tpu.ops.strings import starts_with_mask
+
+        return starts_with_mask(target.data, pref.data), None
     if target.dictionary is None:
-        raise NotImplementedError("starts_with on non-dictionary column")
+        raise NotImplementedError("starts_with on dictionary-less VARCHAR")
     table = _dict_predicate_table(target.dictionary, lambda v: v.startswith(pref.data))
     return jnp.asarray(table)[target.data], None
+
+
+def substr_fn(start: int, length: int) -> str:
+    """Register (once) and return the name of a static-bound substr:
+    BYTES(w) -> BYTES(length). SQL is 1-based."""
+    from presto_tpu.types import fixed_bytes
+
+    name = f"substr_{start}_{length}"
+    if name not in _REGISTRY:
+
+        def rule(args, _l=length):
+            return fixed_bytes(_l)
+
+        @register(name, rule)
+        def impl(args, out, _s=start, _l=length):
+            from presto_tpu.ops.strings import substr
+
+            return substr(args[0].data, _s, _l), None
+
+    return name
 
 
 # ---------------------------------------------------------------------------

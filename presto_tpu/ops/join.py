@@ -84,18 +84,23 @@ class ExpandedProbe(NamedTuple):
     overflow: jnp.ndarray  # traced bool
 
 
-def probe_expand(build: BuildSide, probe_keys, probe_live, out_capacity: int) -> ExpandedProbe:
-    """General inner-join probe with duplicate build keys.
+def probe_expand(
+    build: BuildSide, probe_keys, probe_live, out_capacity: int, left: bool = False
+) -> ExpandedProbe:
+    """General join probe with duplicate build keys.
 
     For each probe row: match range [lo, hi) in the sorted build keys;
     outputs one row per (probe, build-match) pair, laid out by a
-    prefix-sum expansion into a static out_capacity.
+    prefix-sum expansion into a static out_capacity. With ``left=True``
+    (probe-outer), match-less probe rows emit one row whose build_row is
+    the miss sentinel (build payload gathers yield invalid/null).
     """
     probe_cap = probe_keys.shape[0]
     pk = jnp.where(probe_live, probe_keys.astype(jnp.int64), _I64_MAX)
     lo = jnp.searchsorted(build.sorted_keys, pk, side="left")
     hi = jnp.searchsorted(build.sorted_keys, pk, side="right")
-    counts = jnp.where(probe_live & (pk != _I64_MAX), hi - lo, 0)
+    matches = jnp.where(probe_live & (pk != _I64_MAX), hi - lo, 0)
+    counts = jnp.where(probe_live & (matches == 0), 1, matches) if left else matches
     offsets = jnp.cumsum(counts) - counts  # exclusive prefix
     total = jnp.sum(counts)
 
@@ -105,8 +110,11 @@ def probe_expand(build: BuildSide, probe_keys, probe_live, out_capacity: int) ->
     probe_row = jnp.clip(probe_row, 0, probe_cap - 1)
     rank = j - offsets[probe_row]
     valid = (j < total) & (rank >= 0) & (rank < counts[probe_row])
+    is_match = valid & (rank < matches[probe_row])
     bpos = lo[probe_row] + rank
-    build_row = jnp.where(valid, gather_padded(build.row_idx, bpos, 0), build.row_idx.shape[0])
+    build_row = jnp.where(
+        is_match, gather_padded(build.row_idx, bpos, 0), build.row_idx.shape[0]
+    )
     probe_row = jnp.where(valid, probe_row, probe_cap)
     return ExpandedProbe(probe_row, build_row, valid, total, total > out_capacity)
 

@@ -22,6 +22,44 @@ def _desc_transform(k):
     return ~k.astype(jnp.int64)  # bitwise-not reverses int order, no overflow
 
 
+def bytes_sort_chunks(data) -> list[jnp.ndarray]:
+    """[n, W] bytes -> big-endian int64 chunks (7 bytes each), most
+    significant first; comparing the chunk tuple == lexicographic
+    byte comparison."""
+    w = data.shape[1]
+    out = []
+    for c0 in range(0, w, 7):
+        chunk = data[:, c0 : c0 + 7]
+        v = jnp.zeros(data.shape[0], jnp.int64)
+        for i in range(chunk.shape[1]):
+            v = (v << np.int64(8)) | chunk[:, i].astype(jnp.int64)
+        out.append(v)
+    return out
+
+
+def _expand_keys(key_cols, descending, nulls_first, valids):
+    """Expand 2-D BYTES keys into int64 chunk keys (lexicographic)."""
+    ks, ds, nf, vs = [], [], [], []
+    for i, k in enumerate(key_cols):
+        d = descending[i]
+        f = nulls_first[i] if nulls_first else False
+        v = valids[i] if valids else None
+        if k.ndim == 2:
+            chunks = bytes_sort_chunks(k)
+            for j, c in enumerate(chunks):
+                ks.append(c)
+                ds.append(d)
+                # null flag only once (on the most significant chunk)
+                nf.append(f)
+                vs.append(v if j == 0 else None)
+        else:
+            ks.append(k)
+            ds.append(d)
+            nf.append(f)
+            vs.append(v)
+    return ks, ds, nf, vs
+
+
 def sort_indices(
     key_cols: Sequence[jnp.ndarray],
     descending: Sequence[bool],
@@ -33,6 +71,9 @@ def sort_indices(
 
     Returns order[cap] (original row indices, dead rows at the tail).
     """
+    key_cols, descending, nulls_first, valids = _expand_keys(
+        list(key_cols), list(descending), nulls_first, valids
+    )
     cap = live.shape[0]
     order = jnp.arange(cap)
     n = len(list(key_cols))

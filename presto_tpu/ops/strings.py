@@ -1,0 +1,114 @@
+"""String kernels over fixed-width byte tensors.
+
+Reference parity: the string function family in ``presto-main``
+``operator.scalar`` (LikeFunctions with compiled JONI regex, substr)
+[SURVEY §2.1; reference tree unavailable]. TPU-first: a LIKE pattern is
+decomposed into ordered literal segments; each segment match is a
+vectorized sliding-window byte comparison over the [rows, width]
+tensor — all VPU-friendly compares/reductions, no regex automaton.
+These are the jnp reference kernels; the Pallas variants fuse the
+window loop (SURVEY config 5).
+
+Byte layout contract: rows are zero-padded on the right (the padding
+byte 0 never appears in content).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def encode_needle(s: str) -> np.ndarray:
+    return np.frombuffer(s.encode("latin1"), dtype=np.uint8)
+
+
+def pad_literal(s: str, width: int) -> np.ndarray:
+    out = np.zeros(width, dtype=np.uint8)
+    b = s.encode("latin1")[:width]
+    out[: len(b)] = np.frombuffer(b, dtype=np.uint8)
+    return out
+
+
+def row_lengths(data) -> jnp.ndarray:
+    """Logical length of each row = bytes before the zero padding."""
+    return jnp.sum((data != 0).astype(jnp.int32), axis=1)
+
+
+def find_from(data, needle: np.ndarray, min_pos):
+    """Earliest occurrence index of ``needle`` at position >= min_pos
+    per row; returns (found_pos, ok)."""
+    n, width = data.shape
+    L = len(needle)
+    if L > width:
+        z = jnp.zeros(n, jnp.int32)
+        return z, jnp.zeros(n, jnp.bool_)
+    nshift = width - L + 1
+    hits = jnp.stack(
+        [jnp.all(data[:, s : s + L] == jnp.asarray(needle), axis=1) for s in range(nshift)],
+        axis=1,
+    )
+    valid = hits & (jnp.arange(nshift)[None, :] >= min_pos[:, None])
+    ok = jnp.any(valid, axis=1)
+    found = jnp.argmax(valid, axis=1).astype(jnp.int32)
+    return found, ok
+
+
+def like_mask(data, pattern: str) -> jnp.ndarray:
+    """SQL LIKE on byte rows. Supports '%' wildcards (not '_')."""
+    if "_" in pattern:
+        raise NotImplementedError("LIKE '_' wildcard on byte columns")
+    n, width = data.shape
+    segs = pattern.split("%")
+    anchored_start = segs[0] != ""
+    anchored_end = segs[-1] != ""
+    segs_nonempty = [s for s in segs if s != ""]
+    ok = jnp.ones(n, jnp.bool_)
+    pos = jnp.zeros(n, jnp.int32)
+    for i, seg in enumerate(segs_nonempty):
+        needle = encode_needle(seg)
+        if i == 0 and anchored_start:
+            L = len(needle)
+            if L > width:
+                return jnp.zeros(n, jnp.bool_)
+            ok = ok & jnp.all(data[:, :L] == jnp.asarray(needle), axis=1)
+            pos = jnp.full(n, L, jnp.int32)
+            continue
+        found, hit = find_from(data, needle, pos)
+        ok = ok & hit
+        pos = found + np.int32(len(needle))
+    if anchored_end:
+        # last segment must END at the logical row length
+        ok = ok & (pos == row_lengths(data))
+    return ok
+
+
+def starts_with_mask(data, prefix: str) -> jnp.ndarray:
+    needle = encode_needle(prefix)
+    L = len(needle)
+    if L > data.shape[1]:
+        return jnp.zeros(data.shape[0], jnp.bool_)
+    return jnp.all(data[:, :L] == jnp.asarray(needle), axis=1)
+
+
+def substr(data, start: int, length: int):
+    """1-based SQL substr with static bounds -> BYTES(length)."""
+    return data[:, start - 1 : start - 1 + length]
+
+
+def bytes_eq_literal(data, s: str) -> jnp.ndarray:
+    lit = pad_literal(s, data.shape[1])
+    return jnp.all(data == jnp.asarray(lit), axis=1)
+
+
+def bytes_compare(a, b):
+    """Lexicographic 3-way compare of two [n, W] byte tensors:
+    returns int32 in {-1, 0, 1} per row."""
+    diff = a != b
+    any_diff = jnp.any(diff, axis=1)
+    first = jnp.argmax(diff, axis=1)
+    idx = jnp.arange(a.shape[0])
+    av = a[idx, first].astype(jnp.int32)
+    bv = b[idx, first].astype(jnp.int32)
+    sign = jnp.sign(av - bv)
+    return jnp.where(any_diff, sign, 0).astype(jnp.int32)

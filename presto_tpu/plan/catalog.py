@@ -1,0 +1,61 @@
+"""Catalog: table resolution + metadata for analysis and planning.
+
+Reference parity: ``MetadataManager`` + ``ConnectorMetadata`` (schema
+resolution, table handles, statistics for the CBO) [SURVEY §2.1;
+reference tree unavailable, paths reconstructed].
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Mapping
+
+from presto_tpu.types import DataType
+
+#: primary/unique keys per TPC-H table — drives the FK->PK unique-probe
+#: fast path (reference: TpchMetadata's implicit key knowledge).
+TPCH_UNIQUE_KEYS: dict[str, tuple[tuple[str, ...], ...]] = {
+    "customer": (("c_custkey",),),
+    "orders": (("o_orderkey",),),
+    "lineitem": (("l_orderkey", "l_linenumber"),),
+    "part": (("p_partkey",),),
+    "supplier": (("s_suppkey",),),
+    "partsupp": (("ps_partkey", "ps_suppkey"),),
+    "nation": (("n_nationkey",), ("n_name",)),
+    "region": (("r_regionkey",), ("r_name",)),
+}
+
+
+@dataclass(frozen=True)
+class TableMeta:
+    connector_name: str
+    table: str
+    schema: Mapping[str, DataType]
+    row_count: int
+    unique_keys: tuple[tuple[str, ...], ...]
+
+
+class Catalog:
+    def __init__(self, connectors: Mapping[str, object], default: str = "tpch"):
+        self.connectors = dict(connectors)
+        self.default = default
+
+    def connector(self, name: str):
+        return self.connectors[name]
+
+    def resolve(self, table: str) -> TableMeta:
+        for cname, conn in self.connectors.items():
+            if table in conn.tables():
+                uk = getattr(conn, "unique_keys", lambda t: ())(table)
+                if not uk and table in TPCH_UNIQUE_KEYS and cname == "tpch":
+                    uk = TPCH_UNIQUE_KEYS[table]
+                return TableMeta(
+                    cname, table, dict(conn.schema(table)), conn.row_count(table), tuple(uk)
+                )
+        raise KeyError(f"table not found in any catalog: {table}")
+
+    def stats(self, connector_name: str, table: str, column: str):
+        conn = self.connectors[connector_name]
+        if hasattr(conn, "stats"):
+            return conn.stats(table, column)
+        return None

@@ -1,0 +1,274 @@
+"""Logical plan nodes.
+
+Reference parity: ``com.facebook.presto.sql.planner.plan`` (``PlanNode``
+hierarchy: TableScanNode, FilterNode, ProjectNode, AggregationNode,
+JoinNode, SemiJoinNode, TopNNode, SortNode, LimitNode, ValuesNode ...)
+[SURVEY §2.1; reference tree unavailable, paths reconstructed].
+
+Fields are named, typed columns (the reference's Symbols); expressions
+are the typed IR from ``presto_tpu.expr``. Scalar subqueries appear as
+``ScalarValue`` nodes referenced by name from expressions (executed
+before their consumers — the analog of uncorrelated-subquery plans
+feeding filters).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+from presto_tpu.exec.operators import AggSpec, SortKey
+from presto_tpu.expr import Expr
+from presto_tpu.types import DataType
+
+
+@dataclass(frozen=True)
+class Field:
+    name: str
+    dtype: DataType
+
+
+class PlanNode:
+    @property
+    def children(self) -> tuple["PlanNode", ...]:
+        return ()
+
+    @property
+    def fields(self) -> tuple[Field, ...]:
+        raise NotImplementedError
+
+    def field_names(self) -> list[str]:
+        return [f.name for f in self.fields]
+
+
+@dataclass(frozen=True)
+class TableScan(PlanNode):
+    connector: str
+    table: str
+    columns: tuple[tuple[str, str], ...]  # (output field name, source column)
+    types: tuple[DataType, ...]
+    predicate: Optional[Expr] = None  # pushed-down filter
+
+    @property
+    def fields(self):
+        return tuple(Field(n, t) for (n, _), t in zip(self.columns, self.types))
+
+
+@dataclass(frozen=True)
+class Filter(PlanNode):
+    child: PlanNode
+    predicate: Expr
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    @property
+    def fields(self):
+        return self.child.fields
+
+
+@dataclass(frozen=True)
+class Project(PlanNode):
+    child: PlanNode
+    exprs: tuple[tuple[str, Expr], ...]  # (output name, expr)
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    @property
+    def fields(self):
+        return tuple(Field(n, e.dtype) for n, e in self.exprs)
+
+
+@dataclass(frozen=True)
+class Aggregate(PlanNode):
+    child: PlanNode
+    keys: tuple[tuple[str, Expr], ...]  # (output name, key expr over child)
+    aggs: tuple[AggSpec, ...]
+    #: functionally-determined columns carried per group without being
+    #: grouped on (their value is any row's value — legal because a
+    #: unique key of their table is among ``keys``)
+    passengers: tuple[tuple[str, Expr], ...] = ()
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    @property
+    def fields(self):
+        return (
+            tuple(Field(n, e.dtype) for n, e in self.keys)
+            + tuple(Field(n, e.dtype) for n, e in self.passengers)
+            + tuple(Field(a.name, a.dtype) for a in self.aggs)
+        )
+
+
+@dataclass(frozen=True)
+class Join(PlanNode):
+    """Equi-join. probe = left child (streamed), build = right child.
+    unique: build keys are unique (FK->PK fast path, no expansion)."""
+
+    left: PlanNode
+    right: PlanNode
+    kind: str  # inner | left
+    left_keys: tuple[Expr, ...]
+    right_keys: tuple[Expr, ...]
+    unique: bool
+    output_right: tuple[str, ...]  # build-side fields to carry
+
+    @property
+    def children(self):
+        return (self.left, self.right)
+
+    @property
+    def fields(self):
+        rmap = {f.name: f for f in self.right.fields}
+        return self.left.fields + tuple(rmap[n] for n in self.output_right)
+
+
+@dataclass(frozen=True)
+class SemiJoin(PlanNode):
+    """left WHERE left_key [NOT] IN (right keys) — filter-only join."""
+
+    left: PlanNode
+    right: PlanNode
+    left_keys: tuple[Expr, ...]
+    right_keys: tuple[Expr, ...]
+    negated: bool = False
+
+    @property
+    def children(self):
+        return (self.left, self.right)
+
+    @property
+    def fields(self):
+        return self.left.fields
+
+
+@dataclass(frozen=True)
+class Sort(PlanNode):
+    child: PlanNode
+    keys: tuple[SortKey, ...]
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    @property
+    def fields(self):
+        return self.child.fields
+
+
+@dataclass(frozen=True)
+class TopN(PlanNode):
+    child: PlanNode
+    keys: tuple[SortKey, ...]
+    count: int
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    @property
+    def fields(self):
+        return self.child.fields
+
+
+@dataclass(frozen=True)
+class Limit(PlanNode):
+    child: PlanNode
+    count: int
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    @property
+    def fields(self):
+        return self.child.fields
+
+
+@dataclass(frozen=True)
+class ScalarValue(PlanNode):
+    """An uncorrelated scalar subquery: child must produce exactly one
+    row/column; the value is bound as a runtime literal under ``name``
+    (reference: EnforceSingleRowOperator + semi-join-less subquery
+    plans)."""
+
+    child: PlanNode
+    name: str
+    dtype: DataType
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    @property
+    def fields(self):
+        return (Field(self.name, self.dtype),)
+
+
+@dataclass(frozen=True)
+class BindScalars(PlanNode):
+    """Execute the scalar subplans first, bind their values into the
+    child's ``Unbound`` expression slots."""
+
+    child: PlanNode
+    scalars: tuple[ScalarValue, ...]
+
+    @property
+    def children(self):
+        return (self.child,) + self.scalars
+
+    @property
+    def fields(self):
+        return self.child.fields
+
+
+@dataclass(frozen=True)
+class Output(PlanNode):
+    """Final projection to client column names."""
+
+    child: PlanNode
+    names: tuple[str, ...]  # client-visible names
+    sources: tuple[str, ...]  # child field names
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    @property
+    def fields(self):
+        smap = {f.name: f for f in self.child.fields}
+        return tuple(
+            Field(n, smap[s].dtype) for n, s in zip(self.names, self.sources)
+        )
+
+
+def plan_tree_str(node: PlanNode, indent: int = 0) -> str:
+    """EXPLAIN-style rendering (reference: PlanPrinter)."""
+    pad = "  " * indent
+    name = type(node).__name__
+    detail = ""
+    if isinstance(node, TableScan):
+        detail = f" {node.table}{' [pred]' if node.predicate is not None else ''} -> {[c for c, _ in node.columns]}"
+    elif isinstance(node, Aggregate):
+        detail = f" keys={[n for n, _ in node.keys]} aggs={[a.name for a in node.aggs]}"
+    elif isinstance(node, (Join,)):
+        detail = f" {node.kind}{' unique' if node.unique else ''}"
+    elif isinstance(node, SemiJoin):
+        detail = f"{' anti' if node.negated else ''}"
+    elif isinstance(node, (TopN,)):
+        detail = f" n={node.count}"
+    elif isinstance(node, Limit):
+        detail = f" n={node.count}"
+    elif isinstance(node, Output):
+        detail = f" {list(node.names)}"
+    elif isinstance(node, Project):
+        detail = f" {[n for n, _ in node.exprs]}"
+    out = f"{pad}{name}{detail}\n"
+    for c in node.children:
+        out += plan_tree_str(c, indent + 1)
+    return out

@@ -1,0 +1,39 @@
+"""Session: the client-facing query surface.
+
+Reference parity: ``Session`` + the statement execution path
+(``SqlQueryExecution``: parse -> analyze -> plan -> execute)
+[SURVEY §2.1, §3.1; reference tree unavailable, paths reconstructed].
+Single-controller: there is no dispatch/queueing tier; ``sql()`` drives
+the full pipeline synchronously and returns a DataFrame.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping
+
+from presto_tpu.exec.local_planner import LocalExecutor
+from presto_tpu.plan.catalog import Catalog
+from presto_tpu.plan.nodes import PlanNode, plan_tree_str
+from presto_tpu.plan.prune import prune
+from presto_tpu.sql.analyzer import Analyzer
+from presto_tpu.sql.parser import parse
+
+
+class Session:
+    def __init__(self, connectors: Mapping[str, object], properties=None):
+        self.catalog = Catalog(connectors)
+        self.analyzer = Analyzer(self.catalog)
+        self.executor = LocalExecutor(self.catalog)
+        self.properties = dict(properties or {})
+
+    def plan(self, sql: str) -> PlanNode:
+        ast = parse(sql)
+        logical = self.analyzer.analyze(ast)
+        return prune(logical)
+
+    def explain(self, sql: str) -> str:
+        return plan_tree_str(self.plan(sql))
+
+    def sql(self, sql: str):
+        """Execute and return a pandas DataFrame."""
+        return self.executor.run(self.plan(sql))

@@ -113,7 +113,8 @@ def tokenize(sql: str) -> list[Token]:
                 advance(1)
             if i >= n:
                 raise LexError(f"unterminated quoted identifier at line {sline}")
-            out.append(Token("IDENT", sql[qstart:i], qstart, sline, scol))
+            # QIDENT: case-preserved (unquoted identifiers fold to lower)
+            out.append(Token("QIDENT", sql[qstart:i], qstart, sline, scol))
             advance(1)
             continue
         two = sql[i : i + 2]

@@ -126,6 +126,9 @@ class Parser:
 
     def parse_name(self) -> str:
         t = self.cur
+        if t.kind == "QIDENT":
+            self.eat()
+            return t.text
         if t.kind in ("IDENT", "KW"):
             self.eat()
             return t.text.lower()
@@ -149,6 +152,8 @@ class Parser:
             alias = self.parse_name()
         elif self.cur.kind == "IDENT":
             alias = self.eat().text.lower()
+        elif self.cur.kind == "QIDENT":
+            alias = self.eat().text
         return A.SelectItem(e, alias)
 
     def parse_order_item(self) -> A.OrderItem:
@@ -219,6 +224,8 @@ class Parser:
             return self.parse_name()
         if self.cur.kind == "IDENT":
             return self.eat().text.lower()
+        if self.cur.kind == "QIDENT":
+            return self.eat().text
         return None
 
     # -- expressions ------------------------------------------------------
@@ -397,9 +404,12 @@ class Parser:
             self.expect_op(")")
             return e
         # function call or identifier (agg keywords double as functions)
-        if t.kind == "IDENT" or self.kw("count", "sum", "avg", "min", "max",
-                                        "year", "month", "day"):
-            name = self.eat().text.lower()
+        if t.kind in ("IDENT", "QIDENT") or self.kw(
+            "count", "sum", "avg", "min", "max", "year", "month", "day"
+        ):
+            name = self.eat().text
+            if t.kind != "QIDENT":
+                name = name.lower()
             if self.op("("):
                 self.eat()
                 distinct = self.accept_kw("distinct")
@@ -415,9 +425,12 @@ class Parser:
                 self.expect_op(")")
                 return A.FunctionCall(name, tuple(args), distinct=distinct)
             parts = [name]
-            while self.op(".") and self.toks[self.i + 1].kind in ("IDENT", "KW"):
+            while self.op(".") and self.toks[self.i + 1].kind in (
+                "IDENT", "KW", "QIDENT"
+            ):
                 self.eat()
-                parts.append(self.eat().text.lower())
+                nt = self.eat()
+                parts.append(nt.text if nt.kind == "QIDENT" else nt.text.lower())
             return A.Identifier(tuple(parts))
         raise ParseError("unexpected token", t)
 
