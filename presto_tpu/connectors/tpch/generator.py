@@ -165,12 +165,25 @@ def customer_draw_to_key(draw: np.ndarray) -> np.ndarray:
 
 
 def partsupp_suppkey(partkey: np.ndarray, i: np.ndarray, s_count: int) -> np.ndarray:
-    """The spec's supplier-of-part formula (4.2.3): guarantees exactly
-    SUPPLIERS_PER_PART distinct suppliers per part, uniform supplier load."""
+    """The spec's supplier-of-part formula (4.2.3): exactly
+    SUPPLIERS_PER_PART distinct suppliers per part, uniform load.
+
+    At tiny scale factors the spec step (S/4 + (p-1)/S) can hit a value
+    where k*step % S == 0 for k < 4 (e.g. S=50, step=25), collapsing
+    the four suppliers onto two — impossible at SF>=1 where S>=10000.
+    The step is nudged forward until the four offsets are distinct, so
+    the (ps_partkey, ps_suppkey) primary key holds at every SF.
+    """
     p = partkey.astype(np.int64)
-    return (
-        p + i * (s_count // S.SUPPLIERS_PER_PART + (p - 1) // s_count)
-    ) % s_count + 1
+    step = s_count // S.SUPPLIERS_PER_PART + (p - 1) // s_count
+    for _ in range(4):
+        bad = np.zeros(p.shape, dtype=bool)
+        for k in range(1, S.SUPPLIERS_PER_PART):
+            bad |= (k * step) % s_count == 0
+        if not bad.any():
+            break
+        step = step + bad
+    return (p + i * step) % s_count + 1
 
 
 def retail_price_cents(partkey: np.ndarray) -> np.ndarray:

@@ -551,12 +551,14 @@ class Analyzer:
             bkeys: list[FieldRef] = []
             kind = "inner"
             used = []
+            on_residual: list[A.Node] = []
             for e2 in pending_edges:
                 p2 = e2["pair"]
                 if set(p2) <= joined | {bidx} and bidx in p2:
                     used.append(e2)
                     if e2["kind"] == "left":
                         kind = "left"
+                    on_residual.extend(e2.get("residual", ()))
                     for ak, bk in zip(e2["akeys"], e2["bkeys"]):
                         # orient: probe key in joined set, build key in bidx
                         if self._owner_index(rels, ak) == bidx:
@@ -567,6 +569,25 @@ class Analyzer:
                 pending_edges.remove(u)
             if not akeys:
                 raise AnalysisError("join without equi keys")
+            # ON-clause residual conjuncts: build-side-only ones filter
+            # the build input (required for LEFT semantics); others are
+            # legal as post-join filters only for INNER joins.
+            post_join: list[A.Node] = []
+            for c in on_residual:
+                ids: list[A.Identifier] = []
+                collect_identifiers(c, ids)
+                bscope = rels[bidx].scope
+                if all(bscope.try_resolve(i.parts) is not None for i in ids):
+                    plans[bidx] = N.Filter(
+                        plans[bidx], self._expr(c, bscope, None, {}, [])
+                    )
+                elif kind == "inner":
+                    post_join.append(c)
+                else:
+                    raise AnalysisError(
+                        "outer-join ON condition spanning both sides is "
+                        "not supported"
+                    )
             build_rel = rels[bidx]
             unique = self._is_unique_key(build_rel, [k.column for k in bkeys])
             plan = N.Join(
@@ -583,6 +604,8 @@ class Analyzer:
             joined.add(bidx)
             remaining.discard(bidx)
             cur_fields += build_rel.scope.fields
+            for c in post_join:
+                plan = N.Filter(plan, self._expr(c, Scope(cur_fields), None, {}, []))
         return plan
 
     def _is_unique_key(self, rel: Rel, cols: list[str]) -> bool:
@@ -934,11 +957,16 @@ class Analyzer:
                     else:
                         passengers.append((n, e))
                 continue
-            # hidden-PK grouping: a narrow unique key of the same
-            # relation instance exists in the child scope (even if not
-            # grouped on) — group by it, demote the named keys to
-            # passengers. Finer-than-named grouping is equivalence
-            # because the named keys are functionally determined.
+            if all(narrow(e.dtype) for _, e in ks):
+                # all keys groupable directly — no dependency tricks
+                grouping.extend(ks)
+                continue
+            # hidden-PK grouping (only when a wide BYTES key forces it):
+            # a narrow unique key of the same relation instance exists
+            # in the child scope (even if not grouped on) — group by
+            # it, demote the named keys to passengers. Finer-than-named
+            # grouping is equivalent because the named keys are
+            # functionally determined by the unique key.
             hidden = None
             for uk in uks:
                 fs = [

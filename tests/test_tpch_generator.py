@@ -140,3 +140,12 @@ def test_nation_region(conn):
     assert len(n) == 25 and len(r) == 5
     assert "GERMANY" in set(n["n_name"])
     assert set(n["n_regionkey"]) == {0, 1, 2, 3, 4}
+
+
+def test_partsupp_pk_holds_at_tiny_sf():
+    """Regression: S=50 (sf=0.005) used to produce duplicate
+    (ps_partkey, ps_suppkey) pairs via a degenerate supplier step."""
+    c = TpchConnector(sf=0.005)
+    ps = c.table_numpy("partsupp", ["ps_partkey", "ps_suppkey"])
+    pairs = list(zip(ps["ps_partkey"].tolist(), ps["ps_suppkey"].tolist()))
+    assert len(set(pairs)) == len(pairs)
