@@ -50,6 +50,41 @@ MAX_GROUP_CAP = 1 << 20
 MAX_RETRIES = 6
 
 
+def pick_group_strategy(keys, pax, child: list[Batch]):
+    """Grouping-strategy choice shared by the local and distributed
+    executors: direct addressing for small dictionary-key domains,
+    bounded merge-by-sort otherwise (see module docstring)."""
+    if not child:
+        return SortStrategy(1024)
+    if not pax and keys:
+        first = child[0]
+        domains = []
+        ok = True
+        for _, e in keys:
+            if (
+                isinstance(e, InputRef)
+                and e.dtype.kind is TypeKind.VARCHAR
+                and e.name in first
+                and first[e.name].dictionary is not None
+            ):
+                domains.append(len(first[e.name].dictionary))
+            else:
+                ok = False
+                break
+        if ok and domains and int(np.prod(domains)) <= DIRECT_LIMIT:
+            strides = []
+            acc = 1
+            for d in reversed(domains):
+                strides.append(acc)
+                acc *= d
+            strides.reverse()
+            return DirectStrategy(
+                tuple(0 for _ in domains), tuple(strides), int(np.prod(domains))
+            )
+    total = sum(live_count(b) for b in child)
+    return SortStrategy(min(batch_capacity(max(total, 16)), MAX_GROUP_CAP))
+
+
 class LocalExecutor:
     def __init__(self, catalog: Catalog):
         self.catalog = catalog
@@ -148,35 +183,7 @@ class LocalExecutor:
         raise CapacityOverflow("Aggregate", strategy.max_groups)
 
     def _pick_group_strategy(self, keys, pax, child: list[Batch]):
-        if not child:
-            return SortStrategy(1024)
-        if not pax and keys:
-            first = child[0]
-            domains = []
-            ok = True
-            for _, e in keys:
-                if (
-                    isinstance(e, InputRef)
-                    and e.dtype.kind is TypeKind.VARCHAR
-                    and e.name in first
-                    and first[e.name].dictionary is not None
-                ):
-                    domains.append(len(first[e.name].dictionary))
-                else:
-                    ok = False
-                    break
-            if ok and domains and int(np.prod(domains)) <= DIRECT_LIMIT:
-                strides = []
-                acc = 1
-                for d in reversed(domains):
-                    strides.append(acc)
-                    acc *= d
-                strides.reverse()
-                return DirectStrategy(
-                    tuple(0 for _ in domains), tuple(strides), int(np.prod(domains))
-                )
-        total = sum(live_count(b) for b in child)
-        return SortStrategy(min(batch_capacity(max(total, 16)), MAX_GROUP_CAP))
+        return pick_group_strategy(keys, pax, child)
 
     # ---- joins -----------------------------------------------------------
     def _join_key_exprs(

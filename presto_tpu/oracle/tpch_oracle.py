@@ -229,9 +229,6 @@ def q12(t):
     )
     j = li[m].merge(o, left_on="l_orderkey", right_on="o_orderkey")
     hi = j.o_orderpriority.isin(["1-URGENT", "2-HIGH"])
-    g = j.groupby("l_shipmode", as_index=False).agg(
-        high_line_count=("o_orderpriority", lambda x: 0),
-    )
     g = (
         j.assign(hi=hi.astype(int), lo=(~hi).astype(int))
         .groupby("l_shipmode", as_index=False)
@@ -379,8 +376,7 @@ def q21(t):
     ok_orders = o[o.o_orderstatus == "F"][["o_orderkey"]]
     j = l1.merge(ok_orders, left_on="l_orderkey", right_on="o_orderkey")
     per_order = li.groupby("l_orderkey")["l_suppkey"].agg(["min", "max"])
-    late = li[li.l_receiptdate > li.l_commitdate]
-    late_per_order = late.groupby("l_orderkey")["l_suppkey"].agg(["min", "max"])
+    late_per_order = l1.groupby("l_orderkey")["l_suppkey"].agg(["min", "max"])
     j = j.merge(per_order, left_on="l_orderkey", right_index=True)
     j = j.merge(late_per_order, left_on="l_orderkey", right_index=True,
                 suffixes=("", "_late"))

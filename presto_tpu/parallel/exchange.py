@@ -1,0 +1,147 @@
+"""Distributed exchange: the inter-device data plane.
+
+Reference parity: the whole L8 shuffle stack — ``PartitionedOutputOperator``
+(PagePartitioner), ``OutputBuffer`` (partitioned/broadcast), ``PagesSerde``,
+``ExchangeClient``/``ExchangeOperator`` pulling
+``GET /v1/task/{id}/results/{buffer}/{token}`` [SURVEY §2.1, §2.5, §3.3;
+reference tree unavailable, paths reconstructed].
+
+TPU-first (SURVEY §2.5, §7.1): the pull-based HTTP page shuffle becomes
+**compiled push-style collectives over ICI**:
+
+- hash-partitioned exchange  -> ``jax.lax.all_to_all`` of a dense
+  ``[P, quota]`` send tensor per column (P = mesh size);
+- broadcast exchange         -> ``jax.lax.all_gather``;
+- single/gather exchange     -> ``all_gather`` + host slice.
+
+Serialization disappears (arrays stay columnar on device); token-based
+flow control becomes static capacity planning: every device reserves a
+``quota`` of rows per destination, and quota overflow (skew, SURVEY
+§7.4 #4) raises a flag that the host handles by re-running the step at
+a doubled quota — the moral equivalent of output-buffer backpressure.
+
+The functions here are *per-device* bodies, meant to be called inside
+``shard_map`` over the ``workers`` mesh axis; the executor fuses them
+into larger traced fragment steps (partial-agg -> shuffle -> final-agg
+compiles to ONE XLA program with the collective in the middle).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from presto_tpu.batch import Batch, Column
+from presto_tpu.ops.partition import partition_layout, scatter_to_buffer
+from presto_tpu.parallel.mesh import WORKERS
+
+
+def _a2a(x):
+    """all_to_all along the workers axis; bools ride as uint8."""
+    if x.dtype == jnp.bool_:
+        return _a2a(x.astype(jnp.uint8)).astype(jnp.bool_)
+    return jax.lax.all_to_all(x, WORKERS, split_axis=0, concat_axis=0)
+
+
+def _ag(x):
+    """Tiled all_gather along the workers axis (concat on rows)."""
+    if x.dtype == jnp.bool_:
+        return _ag(x.astype(jnp.uint8)).astype(jnp.bool_)
+    return jax.lax.all_gather(x, WORKERS, axis=0, tiled=True)
+
+
+def exchange_local(batch: Batch, pids, num_partitions: int, quota: int):
+    """Per-device hash-partitioned shuffle body.
+
+    ``pids[cap]``: destination partition of each row (int32, computed by
+    the caller — typically ``ops.hashing.partition_ids`` over the
+    repartitioning keys so every device agrees on the row->owner map).
+
+    Returns ``(received, overflow)``: a local Batch of capacity
+    ``num_partitions * quota`` holding every row whose key this device
+    owns, and this device's *send-side* overflow flag (psum it across
+    the axis before acting on it).
+    """
+    slot, _counts, overflow = partition_layout(
+        pids, batch.live, num_partitions, quota
+    )
+
+    def send_recv(values, fill=0):
+        buf = scatter_to_buffer(values, slot, num_partitions, quota, fill)
+        out = _a2a(buf)
+        return out.reshape((num_partitions * quota,) + values.shape[1:])
+
+    cols = {}
+    for name, c in batch.columns.items():
+        cols[name] = Column(
+            send_recv(c.data),
+            send_recv(c.valid, False),
+            c.dtype,
+            c.dictionary,
+        )
+    live = send_recv(batch.live, False)
+    return Batch(cols, live), overflow
+
+
+def broadcast_local(batch: Batch) -> Batch:
+    """Per-device broadcast body: every device ends up with all rows
+    (reference: BroadcastOutputBuffer / REPLICATED join distribution)."""
+    cols = {
+        n: Column(_ag(c.data), _ag(c.valid), c.dtype, c.dictionary)
+        for n, c in batch.columns.items()
+    }
+    return Batch(cols, _ag(batch.live))
+
+
+def any_flag(flag):
+    """Combine per-device overflow flags (inside shard_map)."""
+    return jax.lax.psum(flag.astype(jnp.int32), WORKERS) > 0
+
+
+# ---------------------------------------------------------------------------
+# Standalone jitted steps (tests + the shuffle microbenchmark)
+# ---------------------------------------------------------------------------
+
+
+def make_shuffle_step(mesh, num_partitions: int, quota: int):
+    """jitted (sharded Batch, sharded pids) -> (sharded Batch, overflow).
+
+    The building block the ICI-shuffle GB/s microbench times
+    (BASELINE metric: ici_shuffle_gbps).
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(WORKERS), P(WORKERS)),
+        out_specs=(P(WORKERS), P()),
+        check_vma=False,
+    )
+    def step(batch: Batch, pids):
+        out, ovf = exchange_local(batch, pids, num_partitions, quota)
+        return out, any_flag(ovf)
+
+    return jax.jit(step)
+
+
+def make_broadcast_step(mesh):
+    """jitted sharded Batch -> replicated Batch (all rows everywhere)."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(WORKERS),),
+        out_specs=P(),
+        check_vma=False,
+    )
+    def step(batch: Batch):
+        return broadcast_local(batch)
+
+    return jax.jit(step)

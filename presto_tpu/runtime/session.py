@@ -20,11 +20,28 @@ from presto_tpu.sql.parser import parse
 
 
 class Session:
-    def __init__(self, connectors: Mapping[str, object], properties=None):
+    def __init__(self, connectors: Mapping[str, object], properties=None, mesh=None):
+        """``mesh=None`` runs single-device (the LocalQueryRunner shape);
+        passing a ``jax.sharding.Mesh`` runs every query distributed
+        over its ``workers`` axis (the DistributedQueryRunner shape).
+        Session properties override engine defaults per query, the
+        reference's SystemSessionProperties rule [SURVEY §5.6]."""
         self.catalog = Catalog(connectors)
         self.analyzer = Analyzer(self.catalog)
-        self.executor = LocalExecutor(self.catalog)
         self.properties = dict(properties or {})
+        self.mesh = mesh
+        if mesh is None:
+            self.executor = LocalExecutor(self.catalog)
+        else:
+            from presto_tpu.exec.distributed import DistributedExecutor
+
+            self.executor = DistributedExecutor(
+                self.catalog,
+                mesh,
+                broadcast_limit=int(
+                    self.properties.get("broadcast_join_row_limit", 1 << 21)
+                ),
+            )
 
     def plan(self, sql: str) -> PlanNode:
         ast = parse(sql)
