@@ -499,6 +499,19 @@ class DistributedExecutor:
         shim = _SemiShim(node)
         return self._repartition_join(shim, left, right, lkey, rkey)
 
+    # ---- window functions ------------------------------------------------
+    def _exec_window(self, node: N.Window, scalars) -> DistBatch:
+        """v1 distribution: gather then window locally (windows in the
+        TPC-H/DS shapes run post-aggregation on small inputs). The
+        partition-parallel variant (all_to_all by hash(partition keys),
+        windows device-local) is the planned upgrade."""
+        from presto_tpu.exec.operators import window_operator_from_node
+
+        d = self._replicate(self._exec(node.child, scalars))
+        op = window_operator_from_node(node, scalars)
+        out = Pipeline(BatchSource([d.batch]), [op]).run()
+        return DistBatch(out[0], sharded=False)
+
     # ---- ordering / limiting (gather exchanges: outputs are small) -------
     def _exec_sort(self, node: N.Sort, scalars) -> DistBatch:
         d = self._replicate(self._exec(node.child, scalars))

@@ -263,6 +263,14 @@ class LocalExecutor:
         )
         return [op.process(b)[0] for b in left]
 
+    # ---- window functions -----------------------------------------------
+    def _exec_window(self, node: N.Window, scalars):
+        child = self._exec(node.child, scalars)
+        from presto_tpu.exec.operators import window_operator_from_node
+
+        op = window_operator_from_node(node, scalars)
+        return Pipeline(BatchSource(child), [op]).run()
+
     # ---- ordering / limiting --------------------------------------------
     def _exec_sort(self, node: N.Sort, scalars):
         child = self._exec(node.child, scalars)

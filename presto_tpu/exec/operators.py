@@ -624,6 +624,187 @@ class TopNOperator(CollectingOperator):
         return [Batch(cols, live)]
 
 
+class WindowOperator(CollectingOperator):
+    """Window functions (reference: WindowOperator + WindowPartition
+    row walk; RowNumberOperator / TopNRowNumberOperator fast paths).
+
+    TPU-first: one sort of the whole input by (partition keys, order
+    keys), then every function is computed with segmented scans and
+    boundary gathers over the sorted rows — no per-partition loop
+    (``presto_tpu.ops.window``). Output rows stay in sorted order (SQL
+    imposes no output order; a downstream Sort/TopN reorders).
+
+    funcs reuse AggSpec; supported kinds: row_number / rank /
+    dense_rank (require order keys) and sum / count / count_star /
+    min / max (windowed aggregates honoring ``frame``).
+    """
+
+    def __init__(
+        self,
+        partition_by: Sequence[Expr],
+        order_keys: Sequence[SortKey],
+        funcs: Sequence[AggSpec],
+        frame: str = "range",
+    ):
+        super().__init__()
+        self.partition_by = list(partition_by)
+        self.order_keys = list(order_keys)
+        self.funcs = list(funcs)
+        self.frame = frame
+        if frame not in ("range", "rows", "full"):
+            raise ValueError(f"unsupported window frame {frame!r}")
+        ranked = [f for f in funcs if f.kind in ("row_number", "rank", "dense_rank")]
+        if ranked and not self.order_keys:
+            raise ValueError(f"{ranked[0].kind}() requires ORDER BY in its window")
+        self._step = jax.jit(self._make_step())
+
+    def _make_step(self):
+        from presto_tpu.ops.window import (
+            change_flags,
+            rank_values,
+            windowed_agg,
+        )
+
+        sortable = HashAggregationOperator._sortable
+        from presto_tpu.ops.sort import bytes_sort_chunks
+
+        def key_parts(v):
+            """int64 comparison columns for a key Val: wide BYTES
+            expand to big-endian chunk columns (lexicographic), all
+            else is a single sortable surrogate."""
+            if v.dtype.kind is TypeKind.BYTES and v.dtype.width > 7:
+                return bytes_sort_chunks(v.data)
+            return [sortable(v)]
+
+        def step(batch: Batch) -> Batch:
+            cap = batch.capacity
+            # ---- sort keys: partition keys (nulls as a group), then
+            # order keys with SQL null placement
+            sort_cols, descs, nfs, valids = [], [], [], []
+            part_cmp: list = []  # comparison columns (null-normalized)
+            for e in self.partition_by:
+                v = evaluate(e, batch)
+                isnull = (~v.valid).astype(jnp.int32)
+                sort_cols.append(isnull)
+                descs.append(False)
+                nfs.append(False)
+                valids.append(None)
+                part_cmp.append(isnull)
+                for p in key_parts(v):
+                    norm = jnp.where(v.valid, p, 0)
+                    sort_cols.append(norm)
+                    descs.append(False)
+                    nfs.append(False)
+                    valids.append(None)
+                    part_cmp.append(norm)
+            peer_cmp: list = []
+            for k in self.order_keys:
+                v = evaluate(k.expr, batch)
+                peer_cmp.append((~v.valid).astype(jnp.int32))
+                for j, p in enumerate(key_parts(v)):
+                    sort_cols.append(p)
+                    descs.append(k.descending)
+                    nfs.append(k.nulls_first)
+                    valids.append(v.valid if j == 0 else None)
+                    peer_cmp.append(jnp.where(v.valid, p, 0))
+            order = sort_indices(sort_cols, descs, batch.live,
+                                 nulls_first=nfs, valids=valids)
+
+            def gat(data, fill=0):
+                if data.ndim > 1:
+                    safe = jnp.minimum(order, data.shape[0] - 1)
+                    return jnp.where((order < data.shape[0])[:, None], data[safe], fill)
+                return gather_padded(data, order, fill)
+
+            cols = {
+                n: Column(
+                    gat(batch[n].data),
+                    gather_padded(batch[n].valid, order, False),
+                    batch[n].dtype,
+                    batch[n].dictionary,
+                )
+                for n in batch.names
+            }
+            live = gather_padded(batch.live, order, False)
+            sorted_batch = Batch(cols, live)
+
+            # ---- boundary flags on the sorted layout ----------------
+            # liveness participates so the dead tail starts a fresh
+            # segment and never extends a live partition's scans
+            pcols = [c[order] for c in part_cmp] + [live.astype(jnp.int32)]
+            part_change = change_flags(pcols)
+            if peer_cmp:
+                peer_change = part_change | change_flags(
+                    [c[order] for c in peer_cmp]
+                )
+            else:
+                peer_change = part_change
+
+            # ---- functions ------------------------------------------
+            row_number, rank, dense = rank_values(part_change, peer_change)
+            all_valid = jnp.ones(cap, jnp.bool_)
+            for f in self.funcs:
+                if f.kind == "row_number":
+                    cols[f.name] = Column(row_number, all_valid, f.dtype)
+                    continue
+                if f.kind == "rank":
+                    cols[f.name] = Column(rank, all_valid, f.dtype)
+                    continue
+                if f.kind == "dense_rank":
+                    cols[f.name] = Column(dense, all_valid, f.dtype)
+                    continue
+                dt = _phys_dtype(f)
+                if f.kind == "count_star" or f.input is None:
+                    vals = jnp.ones(cap, jnp.int64)
+                    contrib = live
+                else:
+                    v = evaluate(f.input, sorted_batch)
+                    if f.kind == "count":
+                        vals, contrib = jnp.ones(cap, jnp.int64), live & v.valid
+                    else:
+                        vals, contrib = v.data.astype(dt), live & v.valid
+                kind = "sum" if f.kind in ("count", "count_star") else f.kind
+                val, cnt = windowed_agg(vals, contrib, part_change, peer_change,
+                                        kind, self.frame)
+                if f.kind in ("count", "count_star"):
+                    cols[f.name] = Column(
+                        val.astype(f.dtype.jnp_dtype), all_valid, f.dtype
+                    )
+                else:
+                    valid = cnt > 0
+                    cols[f.name] = Column(
+                        jnp.where(valid, val, 0).astype(f.dtype.jnp_dtype),
+                        valid, f.dtype,
+                    )
+            return Batch(cols, live)
+
+        return step
+
+    def finish(self) -> list[Batch]:
+        if not self.batches:
+            return []
+        return [self._step(concat_batches(self.batches))]
+
+
+def window_operator_from_node(node, scalars) -> WindowOperator:
+    """Lower an ``N.Window`` plan node to a WindowOperator (shared by
+    the local and distributed executors)."""
+    from presto_tpu.expr import bind_scalars
+
+    part = [bind_scalars(e, scalars) for e in node.partition_by]
+    keys = [
+        SortKey(bind_scalars(k.expr, scalars), k.descending, k.nulls_first)
+        for k in node.order_by
+    ]
+    aggs = [
+        AggSpec(f.kind,
+                bind_scalars(f.input, scalars) if f.input is not None else None,
+                f.name, f.dtype)
+        for f in node.funcs
+    ]
+    return WindowOperator(part, keys, aggs, node.frame)
+
+
 class LimitOperator(Operator):
     """Row-count limit across batches (reference: LimitOperator)."""
 

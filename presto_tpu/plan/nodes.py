@@ -106,6 +106,30 @@ class Aggregate(PlanNode):
 
 
 @dataclass(frozen=True)
+class Window(PlanNode):
+    """Window functions over partitioned, ordered row frames
+    (reference: WindowNode -> WindowOperator). ``funcs`` reuses
+    AggSpec; kinds additionally include rank/dense_rank/row_number.
+    frame: 'range' | 'rows' | 'full' (see sql.ast.WindowSpec)."""
+
+    child: PlanNode
+    partition_by: tuple[Expr, ...]
+    order_by: tuple[SortKey, ...]
+    funcs: tuple[AggSpec, ...]
+    frame: str = "range"
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    @property
+    def fields(self):
+        return self.child.fields + tuple(
+            Field(f.name, f.dtype) for f in self.funcs
+        )
+
+
+@dataclass(frozen=True)
 class Join(PlanNode):
     """Equi-join. probe = left child (streamed), build = right child.
     unique: build keys are unique (FK->PK fast path, no expansion)."""
@@ -258,6 +282,8 @@ def plan_tree_str(node: PlanNode, indent: int = 0) -> str:
         detail = f" keys={[n for n, _ in node.keys]} aggs={[a.name for a in node.aggs]}"
     elif isinstance(node, (Join,)):
         detail = f" {node.kind}{' unique' if node.unique else ''}"
+    elif isinstance(node, Window):
+        detail = f" funcs={[f.name for f in node.funcs]} frame={node.frame}"
     elif isinstance(node, SemiJoin):
         detail = f"{' anti' if node.negated else ''}"
     elif isinstance(node, (TopN,)):

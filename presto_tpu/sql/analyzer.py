@@ -129,7 +129,7 @@ def collect_identifiers(n, out: list[A.Identifier]):
 
 
 def contains_agg(n) -> bool:
-    if isinstance(n, A.FunctionCall) and (n.name in AGG_FUNCS):
+    if isinstance(n, A.FunctionCall) and n.name in AGG_FUNCS and n.over is None:
         return True
     if isinstance(n, (A.Exists, A.InSubquery, A.ScalarSubquery)):
         return False
@@ -141,7 +141,10 @@ def contains_agg(n) -> bool:
 
 
 def collect_aggs(n, out: list[A.FunctionCall]):
-    if isinstance(n, A.FunctionCall) and n.name in AGG_FUNCS:
+    """Plain aggregates; window calls (``over`` set) are skipped as
+    aggregates but their args/spec are searched (rank() over
+    (order by sum(x)) contributes sum(x))."""
+    if isinstance(n, A.FunctionCall) and n.name in AGG_FUNCS and n.over is None:
         out.append(n)
         return
     if isinstance(n, (A.Exists, A.InSubquery, A.ScalarSubquery)):
@@ -152,6 +155,64 @@ def collect_aggs(n, out: list[A.FunctionCall]):
     elif isinstance(n, tuple):
         for v in n:
             collect_aggs(v, out)
+
+
+WINDOW_ONLY_FUNCS = {"rank", "dense_rank", "row_number"}
+
+
+def collect_windows(n, out: list[A.FunctionCall]):
+    """Window function calls (FunctionCall with an OVER spec). Does not
+    descend into subqueries (analyzed separately) or into the window
+    call itself (SQL forbids nested windows)."""
+    if isinstance(n, A.FunctionCall) and n.over is not None:
+        if n not in out:
+            out.append(n)
+        return
+    if isinstance(n, (A.Exists, A.InSubquery, A.ScalarSubquery)):
+        return
+    if isinstance(n, A.Node):
+        for v in _ast_fields(n):
+            collect_windows(v, out)
+    elif isinstance(n, tuple):
+        for v in n:
+            collect_windows(v, out)
+
+
+def _resolved_refs(n, out: set[str]):
+    """Collect InputRef names inside Resolved (pre-lowered) AST slots."""
+    if isinstance(n, A.Resolved):
+        from presto_tpu.plan.prune import expr_refs
+
+        expr_refs(n.expr, out)
+        return
+    if isinstance(n, A.Node):
+        for v in _ast_fields(n):
+            _resolved_refs(v, out)
+    elif isinstance(n, tuple):
+        for v in n:
+            _resolved_refs(v, out)
+
+
+def substitute_nodes(n, mapping):
+    """Structurally replace AST nodes found in ``mapping`` (by value
+    equality) with their replacements; subqueries are left untouched."""
+    if isinstance(n, A.Node) and not isinstance(n, A.Query):
+        try:
+            if n in mapping:
+                return mapping[n]
+        except TypeError:
+            pass
+    if isinstance(n, A.Query) or not isinstance(n, (A.Node, tuple)):
+        return n
+    if isinstance(n, tuple):
+        return tuple(substitute_nodes(v, mapping) for v in n)
+    changes = {}
+    for f in n.__dataclass_fields__:
+        v = getattr(n, f)
+        nv = substitute_nodes(v, mapping)
+        if nv is not v:
+            changes[f] = nv
+    return replace(n, **changes) if changes else n
 
 
 # selectivity guesses for cardinality estimation (ReorderJoins-lite)
@@ -246,6 +307,28 @@ class Analyzer:
                            agg_map=agg_map, key_map=key_map)
             plan = N.Filter(plan, e)
 
+        # ---- window functions (evaluated over the grouped/filtered
+        # rows, before the SELECT projection) ---------------------------
+        win_calls: list[A.FunctionCall] = []
+        for it in q.select:
+            collect_windows(it.expr, win_calls)
+        order_only_wins: list[A.FunctionCall] = []
+        for ob in q.order_by:
+            collect_windows(ob.expr, order_only_wins)
+        order_only_wins = [w for w in order_only_wins if w not in win_calls]
+        win_fields: list[N.Field] = []
+        if win_calls or order_only_wins:
+            plan, win_map, win_fields = self._plan_windows(
+                win_calls + order_only_wins, plan, scope, outer, ctes,
+                scalar_binds, agg_map, key_map,
+            )
+            mapping = {w: A.Resolved(e) for w, e in win_map.items()}
+            q = replace(
+                q,
+                select=tuple(substitute_nodes(it, mapping) for it in q.select),
+                order_by=tuple(substitute_nodes(ob, mapping) for ob in q.order_by),
+            )
+
         # ---- SELECT projection ----------------------------------------
         out_names: list[str] = []
         out_exprs: list[tuple[str, Expr]] = []
@@ -260,7 +343,26 @@ class Analyzer:
             name = item.alias or self._default_name(item.expr, i)
             out_names.append(name)
             out_exprs.append((name, e))
-        plan = N.Project(plan, tuple(out_exprs))
+        # window outputs consumed only by ORDER BY ride the projection
+        # as hidden columns (pruned away when unreferenced); they are
+        # not client-visible fields
+        hidden: list[tuple[str, Expr]] = []
+        if win_fields and q.order_by:
+            produced = {n for n, _ in out_exprs}
+            ob_refs: set[str] = set()
+            for ob in q.order_by:
+                _resolved_refs(ob.expr, ob_refs)
+            hidden = [
+                (f.name, InputRef(f.dtype, f.name))
+                for f in win_fields
+                if f.name in ob_refs and f.name not in produced
+            ]
+            if q.distinct and hidden:
+                raise AnalysisError(
+                    "DISTINCT with window expressions repeated in ORDER BY "
+                    "is not supported; order by the select alias instead"
+                )
+        plan = N.Project(plan, tuple(out_exprs) + tuple(hidden))
         out_scope = Scope(
             [FieldRef(n, e.dtype, "", n) for n, e in out_exprs]
         )
@@ -1053,6 +1155,82 @@ class Analyzer:
         return t
 
     # ------------------------------------------------------------------
+    # window planning
+    # ------------------------------------------------------------------
+    def _plan_windows(self, win_calls, plan, scope, outer, ctes, scalar_binds,
+                      agg_map, key_map):
+        """Plan all window calls: one Window node per distinct OVER
+        spec, chained (reference: WindowNode per window; the planner
+        merges same-spec functions into one node)."""
+        win_map: dict[A.FunctionCall, Expr] = {}
+        groups: dict[A.WindowSpec, list[A.FunctionCall]] = {}
+        for w in win_calls:
+            groups.setdefault(w.over, []).append(w)
+        new_fields: list[N.Field] = []
+        for spec, calls in groups.items():
+            part = tuple(
+                self._expr(p, scope, outer, ctes, scalar_binds, agg_map, key_map)
+                for p in spec.partition_by
+            )
+            okeys = tuple(
+                SortKey(
+                    self._expr(it.expr, scope, outer, ctes, scalar_binds,
+                               agg_map, key_map),
+                    it.descending, bool(it.nulls_first),
+                )
+                for it in spec.order_by
+            )
+            funcs: list[AggSpec] = []
+            for w in calls:
+                if w in win_map:
+                    continue
+                specs, mapped = self._plan_one_window_func(
+                    w, spec, scope, outer, ctes, scalar_binds, agg_map, key_map
+                )
+                funcs.extend(specs)
+                win_map[w] = mapped
+            plan = N.Window(plan, part, okeys, tuple(funcs), spec.frame)
+            # window outputs are NOT added to the name scope: they are
+            # referenced only through Resolved slots, so SELECT * never
+            # leaks the synthetic columns
+            new_fields += [N.Field(f.name, f.dtype) for f in funcs]
+        return plan, win_map, new_fields
+
+    def _plan_one_window_func(self, w: A.FunctionCall, spec, scope, outer, ctes,
+                              scalar_binds, agg_map, key_map):
+        nm = self.fresh(w.name)
+        if w.distinct:
+            raise AnalysisError(f"DISTINCT in window function {w.name}")
+        if w.name in WINDOW_ONLY_FUNCS:
+            if w.args:
+                raise AnalysisError(f"{w.name}() takes no arguments")
+            if not spec.order_by:
+                raise AnalysisError(f"{w.name}() requires ORDER BY in its window")
+            return [AggSpec(w.name, None, nm, BIGINT)], InputRef(BIGINT, nm)
+        if w.name == "count":
+            if w.is_star or not w.args:
+                return [AggSpec("count_star", None, nm, BIGINT)], InputRef(BIGINT, nm)
+            arg = self._expr(w.args[0], scope, outer, ctes, scalar_binds,
+                             agg_map, key_map)
+            return [AggSpec("count", arg, nm, BIGINT)], InputRef(BIGINT, nm)
+        if w.name not in AGG_FUNCS:
+            raise AnalysisError(f"unknown window function {w.name}")
+        if len(w.args) != 1:
+            raise AnalysisError(f"{w.name}() window aggregate takes one argument")
+        arg = self._expr(w.args[0], scope, outer, ctes, scalar_binds,
+                         agg_map, key_map)
+        if w.name == "avg":
+            s, c = self.fresh("wavgsum"), self.fresh("wavgcnt")
+            sum_t = self._sum_type(arg.dtype)
+            specs = [AggSpec("sum", arg, s, sum_t), AggSpec("count", arg, c, BIGINT)]
+            return specs, Call(DOUBLE, "div", (InputRef(sum_t, s), InputRef(BIGINT, c)))
+        if w.name == "sum":
+            t = self._sum_type(arg.dtype)
+            return [AggSpec("sum", arg, nm, t)], InputRef(t, nm)
+        # min / max
+        return [AggSpec(w.name, arg, nm, arg.dtype)], InputRef(arg.dtype, nm)
+
+    # ------------------------------------------------------------------
     # order-by resolution
     # ------------------------------------------------------------------
     def _order_expr(self, e, out_scope, pre_scope, outer, ctes, scalar_binds,
@@ -1074,11 +1252,17 @@ class Analyzer:
     # ------------------------------------------------------------------
     def _expr(self, n: A.Node, scope: Scope, outer, ctes, scalar_binds,
               agg_map=None, key_map=None) -> Expr:
+        if isinstance(n, A.Resolved):
+            return n.expr
         if key_map and n in key_map:
             name, t = key_map[n]
             return InputRef(t, name)
         if agg_map and isinstance(n, A.FunctionCall) and n in agg_map:
             return agg_map[n]
+        if isinstance(n, A.FunctionCall) and n.over is not None:
+            raise AnalysisError(
+                f"window function {n.name}() is only allowed in SELECT/ORDER BY"
+            )
         if isinstance(n, A.Identifier):
             if n.parts == ("null",):
                 raise AnalysisError("bare NULL literal needs a typed context")

@@ -67,11 +67,35 @@ class UnaryOp(Node):
 
 
 @dataclass(frozen=True)
+class WindowSpec(Node):
+    """OVER (PARTITION BY ... ORDER BY ... [frame]).
+
+    frame: 'range' (SQL default: RANGE UNBOUNDED PRECEDING..CURRENT
+    ROW), 'rows' (ROWS UNBOUNDED PRECEDING..CURRENT ROW), or 'full'
+    (UNBOUNDED PRECEDING..UNBOUNDED FOLLOWING = whole partition).
+    """
+
+    partition_by: tuple[Node, ...] = ()
+    order_by: tuple["OrderItem", ...] = ()
+    frame: str = "range"
+
+
+@dataclass(frozen=True)
 class FunctionCall(Node):
     name: str
     args: tuple[Node, ...]
     distinct: bool = False
     is_star: bool = False  # count(*)
+    over: Optional[WindowSpec] = None  # window function when set
+
+
+@dataclass(frozen=True)
+class Resolved(Node):
+    """An AST slot already lowered to a typed engine Expr (used by the
+    analyzer to substitute planned window-function results before the
+    SELECT projection pass). ``expr`` is a presto_tpu.expr.Expr."""
+
+    expr: object
 
 
 @dataclass(frozen=True)

@@ -9,6 +9,8 @@ climbing for expressions.
 
 from __future__ import annotations
 
+import dataclasses
+
 from presto_tpu.sql import ast as A
 from presto_tpu.sql.lexer import Token, tokenize
 
@@ -416,14 +418,16 @@ class Parser:
                 if self.op("*"):
                     self.eat()
                     self.expect_op(")")
-                    return A.FunctionCall(name, (), is_star=True)
+                    return self._maybe_over(A.FunctionCall(name, (), is_star=True))
                 args: list[A.Node] = []
                 if not self.op(")"):
                     args.append(self.parse_expr())
                     while self.accept_op(","):
                         args.append(self.parse_expr())
                 self.expect_op(")")
-                return A.FunctionCall(name, tuple(args), distinct=distinct)
+                return self._maybe_over(
+                    A.FunctionCall(name, tuple(args), distinct=distinct)
+                )
             parts = [name]
             while self.op(".") and self.toks[self.i + 1].kind in (
                 "IDENT", "KW", "QIDENT"
@@ -433,6 +437,49 @@ class Parser:
                 parts.append(nt.text if nt.kind == "QIDENT" else nt.text.lower())
             return A.Identifier(tuple(parts))
         raise ParseError("unexpected token", t)
+
+    def _maybe_over(self, fc: A.FunctionCall) -> A.FunctionCall:
+        if not self.kw("over"):
+            return fc
+        self.eat()
+        self.expect_op("(")
+        partition: list[A.Node] = []
+        if self.accept_kw("partition"):
+            self.expect_kw("by")
+            partition.append(self.parse_expr())
+            while self.accept_op(","):
+                partition.append(self.parse_expr())
+        order: list[A.OrderItem] = []
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            order.append(self.parse_order_item())
+            while self.accept_op(","):
+                order.append(self.parse_order_item())
+        frame = "range"
+        if self.kw("rows", "range"):
+            unit = self.eat().text.lower()
+            frame = self._parse_frame(unit)
+        self.expect_op(")")
+        spec = A.WindowSpec(tuple(partition), tuple(order), frame)
+        return dataclasses.replace(fc, over=spec)
+
+    def _parse_frame(self, unit: str) -> str:
+        """Supported frames: [ROWS|RANGE] BETWEEN UNBOUNDED PRECEDING
+        AND {CURRENT ROW | UNBOUNDED FOLLOWING}, or the shorthand
+        [ROWS|RANGE] UNBOUNDED PRECEDING."""
+        if self.accept_kw("between"):
+            self.expect_kw("unbounded")
+            self.expect_kw("preceding")
+            self.expect_kw("and")
+            if self.accept_kw("current"):
+                self.expect_kw("row")
+                return unit  # rows | range
+            self.expect_kw("unbounded")
+            self.expect_kw("following")
+            return "full"
+        self.expect_kw("unbounded")
+        self.expect_kw("preceding")
+        return unit
 
     def parse_case(self) -> A.CaseExpr:
         self.expect_kw("case")

@@ -1,0 +1,304 @@
+"""Window function tests: kernels vs numpy, SQL vs pandas oracle
+(reference parity: TestWindowOperator + window function query tests in
+AbstractTestQueries [SURVEY §4])."""
+
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+import pytest
+
+from presto_tpu.connectors.tpch import TpchConnector
+from presto_tpu.ops.window import (
+    change_flags,
+    rank_values,
+    seg_scan,
+    segment_ends,
+    segment_starts,
+    windowed_agg,
+)
+from presto_tpu.runtime.session import Session
+
+from tests.test_tpch_sql import compare
+
+SF = 0.005
+
+
+@pytest.fixture(scope="module")
+def env():
+    conn = TpchConnector(sf=SF, units_per_split=1 << 14)
+    session = Session({"tpch": conn})
+    tables = {name: conn.table_pandas(name) for name in conn.tables()}
+    return session, tables
+
+
+# ---------------------------------------------------------------------------
+# kernels
+# ---------------------------------------------------------------------------
+
+
+def test_seg_scan_matches_loop(rng):
+    n = 257
+    vals = rng.integers(-50, 50, n).astype(np.int64)
+    reset = rng.random(n) < 0.15
+    reset[0] = True
+    for kind, op in (("sum", np.add), ("min", np.minimum), ("max", np.maximum)):
+        got = np.asarray(seg_scan(jnp.asarray(vals), jnp.asarray(reset), kind))
+        want = np.empty(n, np.int64)
+        for i in range(n):
+            want[i] = vals[i] if reset[i] else op(want[i - 1], vals[i])
+        np.testing.assert_array_equal(got, want, err_msg=kind)
+
+
+def test_segment_starts_ends():
+    flags = jnp.asarray([True, False, False, True, False, True])
+    np.testing.assert_array_equal(
+        np.asarray(segment_starts(flags)), [0, 0, 0, 3, 3, 5]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(segment_ends(flags)), [2, 2, 2, 4, 4, 5]
+    )
+
+
+def test_rank_values_with_ties():
+    # two partitions: [a a b b b] with order values [1 1 2 2 3]
+    part = jnp.asarray([True, False, True, False, False])
+    peer = jnp.asarray([True, False, True, False, True])
+    rn, rk, dr = rank_values(part, peer)
+    np.testing.assert_array_equal(np.asarray(rn), [1, 2, 1, 2, 3])
+    np.testing.assert_array_equal(np.asarray(rk), [1, 1, 1, 1, 3])
+    np.testing.assert_array_equal(np.asarray(dr), [1, 1, 1, 1, 2])
+
+
+def test_windowed_agg_null_and_frames():
+    # one partition of 4 rows + a second of 2; row 1 doesn't contribute
+    part = jnp.asarray([True, False, False, False, True, False])
+    peer = jnp.asarray([True, False, True, False, True, True])  # peers: {0,1},{2,3},{4},{5}
+    vals = jnp.asarray([10, 99, 5, 7, 3, 4], jnp.int64)
+    contrib = jnp.asarray([True, False, True, True, True, True])
+    v, c = windowed_agg(vals, contrib, part, peer, "sum", "rows")
+    np.testing.assert_array_equal(np.asarray(v), [10, 10, 15, 22, 3, 7])
+    np.testing.assert_array_equal(np.asarray(c), [1, 1, 2, 3, 1, 2])
+    v, c = windowed_agg(vals, contrib, part, peer, "sum", "range")
+    # peers share the frame end: rows 0,1 -> value at row 1; rows 2,3 -> at 3
+    np.testing.assert_array_equal(np.asarray(v), [10, 10, 22, 22, 3, 7])
+    v, c = windowed_agg(vals, contrib, part, peer, "sum", "full")
+    np.testing.assert_array_equal(np.asarray(v), [22, 22, 22, 22, 7, 7])
+
+
+def test_change_flags_nulls_compare():
+    data = jnp.asarray([1, 1, 1, 2], jnp.int64)
+    valid = jnp.asarray([True, False, False, True])
+    f = change_flags([jnp.where(valid, data, 0)], [valid])
+    np.testing.assert_array_equal(np.asarray(f), [True, True, False, True])
+
+
+# ---------------------------------------------------------------------------
+# SQL vs pandas
+# ---------------------------------------------------------------------------
+
+
+def test_rank_per_partition(env):
+    session, t = env
+    got = session.sql(
+        "select n_name, n_regionkey, "
+        "rank() over (partition by n_regionkey order by n_name) as rk "
+        "from nation"
+    )
+    n = t["nation"].copy()
+    n["rk"] = n.groupby("n_regionkey")["n_name"].rank(method="min").astype(np.int64)
+    compare(got, n[["n_name", "n_regionkey", "rk"]], "rank_per_partition")
+
+
+def test_row_number_unique_order(env):
+    session, t = env
+    got = session.sql(
+        "select s_suppkey, row_number() over (order by s_suppkey desc) as rn "
+        "from supplier"
+    )
+    s = t["supplier"].copy().sort_values("s_suppkey", ascending=False)
+    s["rn"] = np.arange(1, len(s) + 1)
+    compare(got, s[["s_suppkey", "rn"]], "row_number")
+
+
+def test_partition_aggregates(env):
+    session, t = env
+    got = session.sql(
+        "select o_orderkey, o_custkey, "
+        "sum(o_totalprice) over (partition by o_custkey) as tot, "
+        "avg(o_totalprice) over (partition by o_custkey) as av, "
+        "max(o_totalprice) over (partition by o_custkey) as mx, "
+        "count(*) over (partition by o_custkey) as cnt "
+        "from orders"
+    )
+    o = t["orders"].copy()
+    g = o.groupby("o_custkey")["o_totalprice"]
+    o["tot"] = g.transform("sum")
+    o["av"] = g.transform("mean")
+    o["mx"] = g.transform("max")
+    o["cnt"] = o.groupby("o_custkey")["o_orderkey"].transform("size").astype(np.int64)
+    compare(
+        got, o[["o_orderkey", "o_custkey", "tot", "av", "mx", "cnt"]],
+        "partition_aggregates",
+    )
+
+
+def test_dense_rank_with_ties(env):
+    session, t = env
+    got = session.sql(
+        "select c_custkey, "
+        "dense_rank() over (partition by c_nationkey order by c_mktsegment) as dr "
+        "from customer"
+    )
+    c = t["customer"].copy()
+    c["dr"] = (
+        c.groupby("c_nationkey")["c_mktsegment"].rank(method="dense").astype(np.int64)
+    )
+    compare(got, c[["c_custkey", "dr"]], "dense_rank")
+
+
+def test_running_sum_rows_frame(env):
+    session, t = env
+    got = session.sql(
+        "select ps_partkey, ps_suppkey, "
+        "sum(ps_availqty) over (partition by ps_suppkey order by ps_partkey "
+        "rows between unbounded preceding and current row) as run "
+        "from partsupp"
+    )
+    ps = t["partsupp"].copy().sort_values(["ps_suppkey", "ps_partkey"])
+    ps["run"] = ps.groupby("ps_suppkey")["ps_availqty"].cumsum().astype(np.int64)
+    compare(got, ps[["ps_partkey", "ps_suppkey", "run"]], "running_sum_rows")
+
+
+def test_running_sum_range_peers(env):
+    session, t = env
+    got = session.sql(
+        "select o_orderkey, "
+        "sum(o_totalprice) over (partition by o_custkey order by o_orderdate) as run "
+        "from orders"
+    )
+    o = t["orders"].copy()
+
+    def per_group(g):
+        g = g.sort_values("o_orderdate")
+        run = g["o_totalprice"].cumsum()
+        # RANGE frame: peers (equal o_orderdate) share the last peer's value
+        last = run.groupby(g["o_orderdate"].values).transform("last")
+        return pd.DataFrame({"o_orderkey": g["o_orderkey"], "run": last})
+
+    want = (
+        o.groupby("o_custkey", group_keys=False)[["o_custkey", "o_orderkey",
+                                                  "o_totalprice", "o_orderdate"]]
+        .apply(per_group)
+        .reset_index(drop=True)
+    )
+    compare(got, want[["o_orderkey", "run"]], "running_sum_range")
+
+
+def test_window_over_group_by(env):
+    session, t = env
+    got = session.sql(
+        "select l_returnflag, l_linestatus, sum(l_quantity) as s, "
+        "rank() over (order by sum(l_quantity) desc) as rk "
+        "from lineitem group by l_returnflag, l_linestatus"
+    )
+    li = t["lineitem"].groupby(["l_returnflag", "l_linestatus"], as_index=False).agg(
+        s=("l_quantity", "sum")
+    )
+    li["rk"] = li["s"].rank(method="min", ascending=False).astype(np.int64)
+    compare(got, li, "window_over_group_by")
+
+
+def test_topn_per_group_via_subquery(env):
+    session, t = env
+    got = session.sql(
+        "select s_suppkey, s_nationkey, rk from ("
+        "select s_suppkey, s_nationkey, "
+        "rank() over (partition by s_nationkey order by s_acctbal desc) as rk "
+        "from supplier) ranked where rk <= 2"
+    )
+    s = t["supplier"].copy()
+    s["rk"] = (
+        s.groupby("s_nationkey")["s_acctbal"]
+        .rank(method="min", ascending=False)
+        .astype(np.int64)
+    )
+    want = s[s["rk"] <= 2]
+    compare(got, want[["s_suppkey", "s_nationkey", "rk"]], "topn_per_group")
+
+
+def test_explain_shows_window(env):
+    session, _ = env
+    txt = session.explain(
+        "select rank() over (partition by n_regionkey order by n_name) from nation"
+    )
+    assert "Window" in txt
+
+
+def test_window_in_where_rejected(env):
+    session, _ = env
+    from presto_tpu.sql.analyzer import AnalysisError
+
+    with pytest.raises(AnalysisError):
+        session.plan(
+            "select n_name from nation "
+            "where rank() over (order by n_name) <= 2"
+        )
+
+
+def test_window_only_in_order_by(env):
+    session, t = env
+    got = session.sql(
+        "select n_name from nation "
+        "order by rank() over (order by n_name desc)"
+    )
+    want = t["nation"].sort_values("n_name", ascending=False)[["n_name"]]
+    assert got["n_name"].tolist() == want["n_name"].tolist()
+    assert list(got.columns) == ["n_name"]
+
+
+def test_select_star_does_not_leak_window_columns(env):
+    session, _ = env
+    got = session.sql(
+        "select *, rank() over (order by n_name) as rk from nation"
+    )
+    assert list(got.columns) == [
+        "n_nationkey", "n_name", "n_regionkey", "n_comment", "rk"
+    ]
+
+
+def test_wide_bytes_window_keys(env):
+    session, t = env
+    got = session.sql(
+        "select s_suppkey, rank() over (order by s_name) as rk from supplier"
+    )
+    s = t["supplier"].copy()
+    s["rk"] = s["s_name"].rank(method="min").astype(np.int64)
+    compare(got, s[["s_suppkey", "rk"]], "wide_bytes_order_key")
+    got = session.sql(
+        "select s_suppkey, "
+        "count(*) over (partition by s_name) as c from supplier"
+    )
+    s["c"] = s.groupby("s_name")["s_suppkey"].transform("size").astype(np.int64)
+    compare(got, s[["s_suppkey", "c"]], "wide_bytes_partition_key")
+
+
+def test_window_agg_without_args_rejected(env):
+    from presto_tpu.sql.analyzer import AnalysisError
+
+    session, _ = env
+    with pytest.raises(AnalysisError):
+        session.plan("select sum() over () from nation")
+
+
+def test_window_distributed_matches_local(env):
+    from presto_tpu.parallel.mesh import make_mesh
+
+    session, t = env
+    mesh = make_mesh(8)
+    dist = Session({"tpch": session.catalog.connector("tpch")}, mesh=mesh)
+    q = (
+        "select n_name, n_regionkey, "
+        "rank() over (partition by n_regionkey order by n_name) as rk "
+        "from nation"
+    )
+    compare(dist.sql(q), session.sql(q), "window_distributed")
