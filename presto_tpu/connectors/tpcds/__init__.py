@@ -1,0 +1,3 @@
+from presto_tpu.connectors.tpcds.connector import TpcdsConnector
+
+__all__ = ["TpcdsConnector"]

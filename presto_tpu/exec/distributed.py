@@ -145,14 +145,17 @@ class DistributedExecutor:
         conn = self.catalog.connector(node.connector)
         src_cols = [s for _, s in node.columns]
         parts = [conn.scan_numpy(s, src_cols) for s in conn.splits(node.table)]
-        arrays = {c: np.concatenate([p[c] for p in parts]) for c in parts[0]}
+        cat = {c: np.concatenate([p[c] for p in parts]) for c in parts[0]}
+        from presto_tpu.spi import split_valids
+
+        arrays, valids = split_valids(cat)
         rows = len(next(iter(arrays.values())))
         cap_dev = batch_capacity(-(-max(rows, 1) // self.nworkers), minimum=128)
         types = {c: conn.schema(node.table)[c] for c in src_cols}
         dicts = {c: d for c, d in conn.dictionaries(node.table).items() if c in types}
         host = Batch.from_numpy(
             arrays, types, count=rows, capacity=self.nworkers * cap_dev,
-            dictionaries=dicts,
+            dictionaries=dicts, valids=valids,
         )
         rename = {s: n for n, s in node.columns}
         b = self._shard(host.rename(rename))

@@ -754,11 +754,13 @@ class WindowOperator(CollectingOperator):
                     cols[f.name] = Column(dense, all_valid, f.dtype)
                     continue
                 dt = _phys_dtype(f)
+                dictionary = None
                 if f.kind == "count_star" or f.input is None:
                     vals = jnp.ones(cap, jnp.int64)
                     contrib = live
                 else:
                     v = evaluate(f.input, sorted_batch)
+                    dictionary = v.dictionary  # min/max on ordered codes
                     if f.kind == "count":
                         vals, contrib = jnp.ones(cap, jnp.int64), live & v.valid
                     else:
@@ -774,7 +776,7 @@ class WindowOperator(CollectingOperator):
                     valid = cnt > 0
                     cols[f.name] = Column(
                         jnp.where(valid, val, 0).astype(f.dtype.jnp_dtype),
-                        valid, f.dtype,
+                        valid, f.dtype, dictionary,
                     )
             return Batch(cols, live)
 

@@ -443,6 +443,11 @@ def _is_not_null(args, out):
     return args[0].valid, _all_valid(args[0].valid)
 
 
+@register("abs", _t_same)
+def _abs(args, out):
+    return jnp.abs(_to_physical(args[0], out)), None
+
+
 @register("coalesce", _t_same)
 def _coalesce(args, out):
     data = _to_physical(args[-1], out)

@@ -32,13 +32,8 @@ def change_flags(cols, valids=None) -> jnp.ndarray:
     """True where row i differs from row i-1 on any column (row 0 is
     always True). ``valids`` compares null flags as part of the value."""
     if not cols:
-        cols = []
-    n = None
-    for c in cols:
-        n = c.shape[0]
-        break
-    if n is None:
         raise ValueError("change_flags needs at least one column")
+    n = cols[0].shape[0]
     first = jnp.zeros(n, jnp.bool_).at[0].set(True)
     diff = jnp.zeros(n - 1, jnp.bool_)
     for i, c in enumerate(cols):

@@ -1,0 +1,251 @@
+"""Independent pandas oracle for the modeled TPC-DS query subset.
+
+Reference parity: the H2QueryRunner role for TPC-DS suites [SURVEY §4].
+Hand-written pandas translations of the query semantics (from the
+public TPC-DS spec templates, with the same documented adaptations as
+``connectors.tpcds.queries``); shares no code with the engine's
+planner/kernels. Inputs are the connector's decoded DataFrames — NULL
+FK values arrive as NaN, and pandas inner merges drop them exactly as
+SQL inner joins do (the dimension sides never carry NaN keys).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+
+D = np.datetime64
+
+
+def _ss_dd_it(t):
+    j = t["store_sales"].merge(
+        t["date_dim"], left_on="ss_sold_date_sk", right_on="d_date_sk"
+    )
+    return j.merge(t["item"], left_on="ss_item_sk", right_on="i_item_sk")
+
+
+def q3(t):
+    j = _ss_dd_it(t)
+    j = j[(j.i_manufact_id <= 50) & (j.d_moy == 11)]
+    g = j.groupby(["d_year", "i_brand", "i_brand_id"], as_index=False).agg(
+        sum_agg=("ss_ext_discount_amt", "sum")
+    )
+    g = g.sort_values(
+        ["d_year", "sum_agg", "i_brand_id"],
+        ascending=[True, False, True], kind="stable",
+    ).head(100)
+    return g[["d_year", "i_brand_id", "i_brand", "sum_agg"]].reset_index(drop=True)
+
+
+def q7(t):
+    cd = t["customer_demographics"]
+    cd = cd[
+        (cd.cd_gender == "M") & (cd.cd_marital_status == "S")
+        & (cd.cd_education_status == "College")
+    ]
+    p = t["promotion"]
+    p = p[(p.p_channel_email == "N") | (p.p_channel_event == "N")]
+    j = _ss_dd_it(t)
+    j = j[j.d_year == 2000]
+    j = j.merge(cd, left_on="ss_cdemo_sk", right_on="cd_demo_sk")
+    j = j.merge(p, left_on="ss_promo_sk", right_on="p_promo_sk")
+    g = j.groupby("i_item_id", as_index=False).agg(
+        agg1=("ss_quantity", "mean"),
+        agg2=("ss_list_price", "mean"),
+        agg3=("ss_coupon_amt", "mean"),
+        agg4=("ss_sales_price", "mean"),
+    )
+    return g.sort_values("i_item_id", kind="stable").head(100).reset_index(drop=True)
+
+
+def _revenue_ratio(t, fact, prefix, cats, lo, hi):
+    f = t[fact].merge(
+        t["date_dim"], left_on=f"{prefix}_sold_date_sk", right_on="d_date_sk"
+    )
+    f = f.merge(t["item"], left_on=f"{prefix}_item_sk", right_on="i_item_sk")
+    f = f[f.i_category.isin(cats) & (f.d_date >= D(lo)) & (f.d_date <= D(hi))]
+    g = f.groupby(
+        ["i_item_id", "i_item_desc", "i_category", "i_class", "i_current_price"],
+        as_index=False,
+    ).agg(itemrevenue=(f"{prefix}_ext_sales_price", "sum"))
+    g["revenueratio"] = (
+        g.itemrevenue * 100 / g.groupby("i_class")["itemrevenue"].transform("sum")
+    )
+    g = g.sort_values(
+        ["i_category", "i_class", "i_item_id", "i_item_desc", "revenueratio"],
+        kind="stable",
+    )
+    return g.reset_index(drop=True)
+
+
+def q12(t):
+    return _revenue_ratio(
+        t, "web_sales", "ws", ["Sports", "Books", "Home"],
+        "1999-02-22", "1999-04-22",
+    ).head(100)
+
+
+def q19(t):
+    j = _ss_dd_it(t)
+    j = j[(j.i_manager_id <= 30) & (j.d_moy == 11) & (j.d_year == 1998)]
+    j = j.merge(t["customer"], left_on="ss_customer_sk", right_on="c_customer_sk")
+    j = j.merge(
+        t["customer_address"], left_on="c_current_addr_sk", right_on="ca_address_sk"
+    )
+    j = j.merge(t["store"], left_on="ss_store_sk", right_on="s_store_sk")
+    j = j[j.ca_zip.str[:5] != j.s_zip.str[:5]]
+    g = j.groupby(
+        ["i_brand", "i_brand_id", "i_manufact_id", "i_manufact"], as_index=False
+    ).agg(ext_price=("ss_ext_sales_price", "sum"))
+    g = g.sort_values(
+        ["ext_price", "i_brand", "i_brand_id", "i_manufact_id", "i_manufact"],
+        ascending=[False, True, True, True, True], kind="stable",
+    ).head(100)
+    return g[
+        ["i_brand_id", "i_brand", "i_manufact_id", "i_manufact", "ext_price"]
+    ].reset_index(drop=True)
+
+
+def q20(t):
+    return _revenue_ratio(
+        t, "catalog_sales", "cs", ["Jewelry", "Music", "Women"],
+        "2001-01-12", "2001-03-12",
+    ).head(100)
+
+
+def q26(t):
+    cd = t["customer_demographics"]
+    cd = cd[
+        (cd.cd_gender == "F") & (cd.cd_marital_status == "W")
+        & (cd.cd_education_status == "Primary")
+    ]
+    p = t["promotion"]
+    p = p[(p.p_channel_email == "N") | (p.p_channel_event == "N")]
+    j = t["catalog_sales"].merge(
+        t["date_dim"], left_on="cs_sold_date_sk", right_on="d_date_sk"
+    )
+    j = j.merge(t["item"], left_on="cs_item_sk", right_on="i_item_sk")
+    j = j[j.d_year == 2000]
+    j = j.merge(cd, left_on="cs_bill_cdemo_sk", right_on="cd_demo_sk")
+    j = j.merge(p, left_on="cs_promo_sk", right_on="p_promo_sk")
+    g = j.groupby("i_item_id", as_index=False).agg(
+        agg1=("cs_quantity", "mean"),
+        agg2=("cs_list_price", "mean"),
+        agg3=("cs_coupon_amt", "mean"),
+        agg4=("cs_sales_price", "mean"),
+    )
+    return g.sort_values("i_item_id", kind="stable").head(100).reset_index(drop=True)
+
+
+def q42(t):
+    j = _ss_dd_it(t)
+    j = j[(j.i_manager_id <= 20) & (j.d_moy == 11) & (j.d_year == 1998)]
+    g = j.groupby(["d_year", "i_category_id", "i_category"], as_index=False).agg(
+        total_sales=("ss_ext_sales_price", "sum")
+    )
+    g = g.sort_values(
+        ["total_sales", "d_year", "i_category_id", "i_category"],
+        ascending=[False, True, True, True], kind="stable",
+    ).head(100)
+    return g[["d_year", "i_category_id", "i_category", "total_sales"]].reset_index(
+        drop=True
+    )
+
+
+def q52(t):
+    j = _ss_dd_it(t)
+    j = j[(j.i_manager_id <= 20) & (j.d_moy == 12) & (j.d_year == 1999)]
+    g = j.groupby(["d_year", "i_brand", "i_brand_id"], as_index=False).agg(
+        ext_price=("ss_ext_sales_price", "sum")
+    )
+    g = g.sort_values(
+        ["d_year", "ext_price", "i_brand_id"],
+        ascending=[True, False, True], kind="stable",
+    ).head(100)
+    return g[["d_year", "i_brand_id", "i_brand", "ext_price"]].reset_index(drop=True)
+
+
+def q53(t):
+    j = _ss_dd_it(t)
+    j = j.merge(t["store"], left_on="ss_store_sk", right_on="s_store_sk")
+    j = j[
+        j.d_month_seq.isin(range(1188, 1200))
+        & j.i_category.isin(
+            ["Books", "Children", "Electronics", "Home", "Jewelry", "Men"]
+        )
+    ]
+    g = j.groupby(["i_manufact_id", "d_qoy"], as_index=False).agg(
+        sum_sales=("ss_sales_price", "sum")
+    )
+    g["avg_quarterly_sales"] = g.groupby("i_manufact_id")["sum_sales"].transform("mean")
+    screen = np.where(
+        g.avg_quarterly_sales > 0,
+        np.abs(g.sum_sales - g.avg_quarterly_sales) / g.avg_quarterly_sales,
+        0.0,
+    )
+    g = g[screen > 0.05]
+    g = g.sort_values(
+        ["avg_quarterly_sales", "sum_sales", "i_manufact_id"], kind="stable"
+    ).head(100)
+    return g[["i_manufact_id", "sum_sales", "avg_quarterly_sales"]].reset_index(
+        drop=True
+    )
+
+
+def q55(t):
+    j = _ss_dd_it(t)
+    j = j[(j.i_manager_id <= 28) & (j.d_moy == 11) & (j.d_year == 1999)]
+    g = j.groupby(["i_brand", "i_brand_id"], as_index=False).agg(
+        ext_price=("ss_ext_sales_price", "sum")
+    )
+    g = g.sort_values(
+        ["ext_price", "i_brand_id"], ascending=[False, True], kind="stable"
+    ).head(100)
+    return g[["i_brand_id", "i_brand", "ext_price"]].reset_index(drop=True)
+
+
+def q89(t):
+    j = _ss_dd_it(t)
+    j = j.merge(t["store"], left_on="ss_store_sk", right_on="s_store_sk")
+    j = j[
+        (j.d_year == 1999)
+        & j.i_category.isin(["Books", "Electronics", "Sports", "Men", "Music", "Women"])
+    ]
+    g = j.groupby(
+        ["i_category", "i_class", "i_brand", "s_store_name", "s_company_name",
+         "d_moy"],
+        as_index=False,
+    ).agg(sum_sales=("ss_sales_price", "sum"))
+    g["avg_monthly_sales"] = g.groupby(
+        ["i_category", "i_brand", "s_store_name", "s_company_name"]
+    )["sum_sales"].transform("mean")
+    screen = np.where(
+        g.avg_monthly_sales != 0,
+        np.abs(g.sum_sales - g.avg_monthly_sales) / g.avg_monthly_sales,
+        0.0,
+    )
+    g = g[screen > 0.1].copy()
+    g["diff"] = g.sum_sales - g.avg_monthly_sales
+    g = g.sort_values(
+        ["diff", "s_store_name", "i_category", "i_class", "i_brand", "d_moy"],
+        kind="stable",
+    ).head(100)
+    return g[
+        ["i_category", "i_class", "i_brand", "s_store_name", "s_company_name",
+         "d_moy", "sum_sales", "avg_monthly_sales"]
+    ].reset_index(drop=True)
+
+
+def q98(t):
+    g = _revenue_ratio(
+        t, "store_sales", "ss", ["Children", "Shoes", "Electronics"],
+        "2000-01-29", "2000-03-29",
+    )
+    return g  # no LIMIT in q98
+
+
+ORACLES = {
+    name: globals()[name]
+    for name in ["q3", "q7", "q12", "q19", "q20", "q26", "q42", "q52", "q53",
+                 "q55", "q89", "q98"]
+}

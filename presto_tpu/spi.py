@@ -55,6 +55,20 @@ class Connector(Protocol):
     def row_count(self, table: str) -> int: ...
 
 
+def split_valids(arrays: Mapping[str, np.ndarray]):
+    """Separate ``<col>$valid`` NULL-mask companions from data columns.
+
+    Connectors whose sources carry NULLs (tpcds fact FKs, the memory
+    connector) return masks under this naming convention; the engine
+    splits them here before building device Batches.
+    """
+    data = {c: v for c, v in arrays.items() if not c.endswith("$valid")}
+    valids = {
+        c[: -len("$valid")]: v for c, v in arrays.items() if c.endswith("$valid")
+    }
+    return data, valids
+
+
 def batch_capacity(n: int, minimum: int = 1024) -> int:
     """Round a row count up to a compile-friendly capacity bucket.
 

@@ -1033,7 +1033,6 @@ class Analyzer:
             by_binding.setdefault(b, []).append((n, e))
         grouping: list[tuple[str, Expr]] = []
         passengers: list[tuple[str, Expr]] = []
-        from presto_tpu.plan.catalog import TPCH_UNIQUE_KEYS
 
         def narrow(t: DataType) -> bool:
             return not (t.kind is TypeKind.BYTES and t.width > 7)
@@ -1043,8 +1042,24 @@ class Analyzer:
                 grouping.extend(ks)
                 continue
             f0 = fmap[ks[0][0]]
-            uks = TPCH_UNIQUE_KEYS.get(f0.table, ())
+            uks = self.catalog.unique_keys(f0.table) if f0.table else ()
             cols = {fmap[n].column for n, _ in ks}
+            # declared functional dependencies (connector metadata, e.g.
+            # tpcds i_brand <- i_brand_id): a determined column whose
+            # determinants are all among the keys rides as a passenger
+            fdeps = self.catalog.func_deps(f0.table) if f0.table else {}
+            if fdeps:
+                det = [
+                    (n, e) for n, e in ks
+                    if fmap[n].column in fdeps
+                    and set(fdeps[fmap[n].column]) <= cols
+                ]
+                if det:
+                    passengers.extend(det)
+                    ks = [k for k in ks if k not in det]
+                    cols = {fmap[n].column for n, _ in ks}
+                    if not ks:
+                        continue
             chosen = None
             for uk in uks:
                 if set(uk) <= cols and all(
@@ -1064,21 +1079,22 @@ class Analyzer:
                 grouping.extend(ks)
                 continue
             # hidden-PK grouping (only when a wide BYTES key forces it):
-            # a narrow unique key of the same relation instance exists
-            # in the child scope (even if not grouped on) — group by
-            # it, demote the named keys to passengers. Finer-than-named
-            # grouping is equivalent because the named keys are
-            # functionally determined by the unique key.
+            # the named keys COVER some unique key of the relation (so
+            # row groups == named-key groups, a bijection), but that key
+            # is wide — substitute a narrow unique key from the child
+            # scope and demote every named key to a passenger.
+            covered = any(set(uk) <= cols for uk in uks)
             hidden = None
-            for uk in uks:
-                fs = [
-                    f for c in uk
-                    for f in scope.fields
-                    if f.binding == b and f.column == c
-                ]
-                if len(fs) == len(uk) and all(narrow(f.dtype) for f in fs):
-                    hidden = fs
-                    break
+            if covered:
+                for uk in uks:
+                    fs = [
+                        f for c in uk
+                        for f in scope.fields
+                        if f.binding == b and f.column == c
+                    ]
+                    if len(fs) == len(uk) and all(narrow(f.dtype) for f in fs):
+                        hidden = fs
+                        break
             if hidden is not None:
                 for f in hidden:
                     grouping.append((f.name, InputRef(f.dtype, f.name)))
@@ -1227,7 +1243,12 @@ class Analyzer:
         if w.name == "sum":
             t = self._sum_type(arg.dtype)
             return [AggSpec("sum", arg, nm, t)], InputRef(t, nm)
-        # min / max
+        # min / max: numeric and dictionary VARCHAR (order-preserving
+        # codes); raw byte strings have no 1-D scan representation
+        if arg.dtype.kind is TypeKind.BYTES:
+            raise AnalysisError(
+                f"{w.name}() window over byte-string columns is not supported"
+            )
         return [AggSpec(w.name, arg, nm, arg.dtype)], InputRef(arg.dtype, nm)
 
     # ------------------------------------------------------------------
@@ -1357,6 +1378,20 @@ class Analyzer:
             if n.name in ("year", "month", "day"):
                 v = self._expr(n.args[0], scope, outer, ctes, scalar_binds, agg_map, key_map)
                 return Call(INTEGER, n.name, (v,))
+            if n.name == "abs":
+                v = self._expr(n.args[0], scope, outer, ctes, scalar_binds, agg_map, key_map)
+                return Call(v.dtype, "abs", (v,))
+            if n.name == "coalesce":
+                args = tuple(
+                    self._expr(a, scope, outer, ctes, scalar_binds, agg_map, key_map)
+                    for a in n.args
+                )
+                from presto_tpu.types import common_super_type
+
+                t = args[0].dtype
+                for a in args[1:]:
+                    t = common_super_type(t, a.dtype)
+                return Call(t, "coalesce", args)
             raise AnalysisError(f"unknown function {n.name}")
         if isinstance(n, A.ScalarSubquery):
             # scalar subquery in a value position (uncorrelated only)

@@ -282,6 +282,23 @@ def test_wide_bytes_window_keys(env):
     compare(got, s[["s_suppkey", "c"]], "wide_bytes_partition_key")
 
 
+def test_window_minmax_dictionary_and_bytes(env):
+    from presto_tpu.sql.analyzer import AnalysisError
+
+    session, t = env
+    got = session.sql(
+        "select c_custkey, max(c_mktsegment) over (partition by c_nationkey) mx "
+        "from customer"
+    )
+    c = t["customer"].copy()
+    c["mx"] = c.groupby("c_nationkey")["c_mktsegment"].transform("max")
+    compare(got, c[["c_custkey", "mx"]], "window_max_dict")
+    with pytest.raises(AnalysisError):
+        session.plan(
+            "select min(s_name) over (partition by s_nationkey) from supplier"
+        )
+
+
 def test_window_agg_without_args_rejected(env):
     from presto_tpu.sql.analyzer import AnalysisError
 
