@@ -1,0 +1,3 @@
+from presto_tpu.connectors.ssb.connector import SsbConnector
+
+__all__ = ["SsbConnector"]

@@ -1,0 +1,95 @@
+"""The built-in SSB connector (generated data, never read from disk).
+
+SURVEY §6 config 5 requires an SSB generator the reference does not
+ship — same connector contract as the TPC-H/TPC-DS connectors.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Sequence
+
+import numpy as np
+
+from presto_tpu.batch import Batch, Dictionary
+from presto_tpu.connectors.ssb import schema as S
+from presto_tpu.connectors.ssb.generator import SsbGenerator
+from presto_tpu.spi import Split, batch_capacity
+
+
+class SsbConnector:
+    name = "ssb"
+
+    DEFAULT_UNITS_PER_SPLIT = 1 << 17
+
+    def __init__(self, sf: float = 1.0, seed: int = 19940607,
+                 units_per_split: int | None = None):
+        self.sf = sf
+        self.gen = SsbGenerator(sf, seed)
+        self.units_per_split = units_per_split or self.DEFAULT_UNITS_PER_SPLIT
+
+    # ---- metadata -------------------------------------------------------
+    def tables(self) -> Sequence[str]:
+        return list(S.TABLES)
+
+    def schema(self, table: str):
+        return S.TABLES[table]
+
+    def dictionaries(self, table: str) -> Mapping[str, Dictionary]:
+        return S.table_dicts(table)
+
+    def row_count(self, table: str) -> int:
+        return S.row_count(table, self.sf)
+
+    def unique_keys(self, table: str):
+        return S.UNIQUE_KEYS.get(table, ())
+
+    # ---- splits ---------------------------------------------------------
+    def splits(self, table: str, target_splits: int = 0) -> Sequence[Split]:
+        units = self.gen.base_rows(table)
+        per = self.units_per_split
+        if target_splits:
+            per = max(1, -(-units // target_splits))
+        out = []
+        for chunk, lo in enumerate(range(0, units, per)):
+            hi = min(lo + per, units)
+            out.append(Split(table, chunk, lo, hi, hi - lo))
+        return out
+
+    # ---- data -----------------------------------------------------------
+    def scan_numpy(
+        self, split: Split, columns: Sequence[str] | None = None
+    ) -> Mapping[str, np.ndarray]:
+        return self.gen.generate(split.table, split.chunk, split.lo, split.hi, columns)
+
+    def scan(
+        self,
+        split: Split,
+        columns: Sequence[str] | None = None,
+        capacity: int | None = None,
+    ) -> Batch:
+        arrays = dict(self.scan_numpy(split, columns))
+        n = len(next(iter(arrays.values())))
+        cap = capacity or batch_capacity(n)
+        types = {c: S.TABLES[split.table][c] for c in arrays}
+        dicts = {c: d for c, d in S.table_dicts(split.table).items() if c in arrays}
+        return Batch.from_numpy(arrays, types, capacity=cap, dictionaries=dicts)
+
+    # ---- whole-table convenience (tests / oracle) -----------------------
+    def table_numpy(self, table: str, columns: Sequence[str] | None = None):
+        parts = [self.scan_numpy(s, columns) for s in self.splits(table)]
+        return {c: np.concatenate([p[c] for p in parts]) for c in parts[0]}
+
+    def table_pandas(self, table: str, columns: Sequence[str] | None = None):
+        import pandas as pd
+
+        from presto_tpu.batch import decode_values
+
+        arrays = self.table_numpy(table, columns)
+        types = S.TABLES[table]
+        dicts = S.table_dicts(table)
+        return pd.DataFrame(
+            {
+                c: decode_values(v, None, types[c], dicts.get(c))
+                for c, v in arrays.items()
+            }
+        )

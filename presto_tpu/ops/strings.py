@@ -19,6 +19,20 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def use_pallas() -> bool:
+    """Route BYTES string predicates through the fused Pallas kernels
+    (ops.pallas_strings). Default: on for TPU backends; override with
+    PRESTO_TPU_PALLAS=1/0."""
+    import os
+
+    import jax
+
+    v = os.environ.get("PRESTO_TPU_PALLAS")
+    if v is not None:
+        return v.strip().lower() not in ("0", "false", "off", "no", "")
+    return jax.default_backend() == "tpu"
+
+
 def encode_needle(s: str) -> np.ndarray:
     return np.frombuffer(s.encode("latin1"), dtype=np.uint8)
 
@@ -35,6 +49,17 @@ def row_lengths(data) -> jnp.ndarray:
     return jnp.sum((data != 0).astype(jnp.int32), axis=1)
 
 
+def hits_matrix(data, needle: np.ndarray) -> jnp.ndarray:
+    """[n, nshift] bool: needle matches at shift s of each row."""
+    width = data.shape[1]
+    L = len(needle)
+    nshift = width - L + 1
+    return jnp.stack(
+        [jnp.all(data[:, s : s + L] == jnp.asarray(needle), axis=1) for s in range(nshift)],
+        axis=1,
+    )
+
+
 def find_from(data, needle: np.ndarray, min_pos):
     """Earliest occurrence index of ``needle`` at position >= min_pos
     per row; returns (found_pos, ok)."""
@@ -44,18 +69,40 @@ def find_from(data, needle: np.ndarray, min_pos):
         z = jnp.zeros(n, jnp.int32)
         return z, jnp.zeros(n, jnp.bool_)
     nshift = width - L + 1
-    hits = jnp.stack(
-        [jnp.all(data[:, s : s + L] == jnp.asarray(needle), axis=1) for s in range(nshift)],
-        axis=1,
+    valid = hits_matrix(data, needle) & (
+        jnp.arange(nshift)[None, :] >= min_pos[:, None]
     )
-    valid = hits & (jnp.arange(nshift)[None, :] >= min_pos[:, None])
     ok = jnp.any(valid, axis=1)
     found = jnp.argmax(valid, axis=1).astype(jnp.int32)
     return found, ok
 
 
+def ends_at_length(data, needle: np.ndarray, min_pos) -> jnp.ndarray:
+    """True when ``needle`` occurs at exactly the end of the logical row
+    (position == row_length - len) at a position >= min_pos."""
+    n, width = data.shape
+    L = len(needle)
+    if L > width:
+        return jnp.zeros(n, jnp.bool_)
+    nshift = width - L + 1
+    lens = row_lengths(data)
+    s_idx = jnp.arange(nshift)
+    valid = (
+        hits_matrix(data, needle)
+        & (s_idx[None, :] >= min_pos[:, None])
+        & (s_idx[None, :] + L == lens[:, None])
+    )
+    return jnp.any(valid, axis=1)
+
+
 def like_mask(data, pattern: str) -> jnp.ndarray:
-    """SQL LIKE on byte rows. Supports '%' wildcards (not '_')."""
+    """SQL LIKE on byte rows. Supports '%' wildcards (not '_').
+
+    Greedy earliest-occurrence matching for interior segments (the
+    classic %-pattern algorithm); the final segment of an
+    end-anchored pattern is matched as a SUFFIX at the logical row
+    length (earliest-occurrence is wrong there: '%1' must match
+    '...011' even though a '1' occurs earlier)."""
     if "_" in pattern:
         raise NotImplementedError("LIKE '_' wildcard on byte columns")
     n, width = data.shape
@@ -63,9 +110,18 @@ def like_mask(data, pattern: str) -> jnp.ndarray:
     anchored_start = segs[0] != ""
     anchored_end = segs[-1] != ""
     segs_nonempty = [s for s in segs if s != ""]
+    if not segs_nonempty:
+        if pattern == "":  # LIKE '' matches only empty strings
+            return row_lengths(data) == 0
+        return jnp.ones(n, jnp.bool_)  # all wildcards
+    if len(segs) == 1:  # no '%': exact equality (padding included)
+        if len(pattern) > width:
+            return jnp.zeros(n, jnp.bool_)
+        return bytes_eq_literal(data, pattern)
     ok = jnp.ones(n, jnp.bool_)
     pos = jnp.zeros(n, jnp.int32)
-    for i, seg in enumerate(segs_nonempty):
+    inner = segs_nonempty[:-1] if anchored_end else segs_nonempty
+    for i, seg in enumerate(inner):
         needle = encode_needle(seg)
         if i == 0 and anchored_start:
             L = len(needle)
@@ -76,10 +132,12 @@ def like_mask(data, pattern: str) -> jnp.ndarray:
             continue
         found, hit = find_from(data, needle, pos)
         ok = ok & hit
-        pos = found + np.int32(len(needle))
+        pos = found + np.int32(len(seg))
     if anchored_end:
-        # last segment must END at the logical row length
-        ok = ok & (pos == row_lengths(data))
+        # (anchored_start implies the prefix segment was consumed from
+        # `inner` above — a no-'%' pattern never reaches here)
+        last = encode_needle(segs_nonempty[-1])
+        ok = ok & ends_at_length(data, last, pos)
     return ok
 
 
