@@ -1,0 +1,144 @@
+"""System tables: live engine state as SQL.
+
+Reference parity: ``presto-main`` ``connector.system`` —
+``system.runtime.queries`` / ``system.runtime.nodes`` — plus the JMX
+connector's metrics-as-SQL role [SURVEY §2.2, §5.5; reference tree
+unavailable]. Backed directly by the session's QueryTracker and the
+process MetricsRegistry; data is materialized at scan time.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Sequence
+
+import numpy as np
+
+from presto_tpu.batch import Batch, Dictionary
+from presto_tpu.spi import Split, batch_capacity
+from presto_tpu.types import BIGINT, DOUBLE, DataType, fixed_bytes, varchar
+
+_QUERY_STATES = ["FAILED", "FINISHED", "QUEUED", "RUNNING"]
+STATE_DICT = Dictionary(_QUERY_STATES)
+
+SCHEMAS: dict[str, dict[str, DataType]] = {
+    "runtime_queries": {
+        "query_id": fixed_bytes(24),
+        "state": varchar(),
+        "query": fixed_bytes(256),
+        "elapsed_s": DOUBLE,
+        "output_rows": BIGINT,
+    },
+    "runtime_metrics": {
+        "name": fixed_bytes(64),
+        "value": DOUBLE,
+    },
+    "runtime_nodes": {
+        "node_id": fixed_bytes(32),
+        "platform": fixed_bytes(16),
+    },
+}
+
+
+def _bytes_col(strings: Sequence[str], width: int) -> np.ndarray:
+    out = np.zeros((len(strings), width), np.uint8)
+    for i, s in enumerate(strings):
+        b = s.encode("utf-8", "replace")[:width]
+        out[i, : len(b)] = np.frombuffer(b, dtype=np.uint8)
+    return out
+
+
+class SystemConnector:
+    """Registered automatically by every Session under catalog name
+    'system'."""
+
+    name = "system"
+
+    def __init__(self, session):
+        self._session = session
+
+    # ---- metadata -------------------------------------------------------
+    def tables(self) -> Sequence[str]:
+        return list(SCHEMAS)
+
+    def schema(self, table: str) -> Mapping[str, DataType]:
+        return SCHEMAS[table]
+
+    def dictionaries(self, table: str) -> Mapping[str, Dictionary]:
+        return {"state": STATE_DICT} if table == "runtime_queries" else {}
+
+    def row_count(self, table: str) -> int:
+        return len(self._rows(table)[0]) if self._rows(table) else 0
+
+    def unique_keys(self, table: str):
+        return ()
+
+    # ---- data -----------------------------------------------------------
+    def _rows(self, table: str):
+        if table == "runtime_queries":
+            infos = list(self._session.query_history)
+            return (
+                [i.query_id for i in infos],
+                [i.state for i in infos],
+                [" ".join(i.sql.split()) for i in infos],
+                [i.elapsed_s for i in infos],
+                [i.output_rows for i in infos],
+            )
+        if table == "runtime_metrics":
+            from presto_tpu.runtime.metrics import REGISTRY
+
+            snap = REGISTRY.snapshot()
+            names = sorted(snap)
+            return names, [snap[n] for n in names]
+        if table == "runtime_nodes":
+            import jax
+
+            devs = jax.devices()
+            return (
+                [str(d.id) for d in devs],
+                [d.platform for d in devs],
+            )
+        raise KeyError(table)
+
+    def scan_numpy(self, split: Split, columns=None) -> Mapping[str, np.ndarray]:
+        table = split.table
+        rows = self._rows(table)
+        arrays: dict[str, np.ndarray] = {}
+        if table == "runtime_queries":
+            qid, state, sql, elapsed, outrows = rows
+            arrays = {
+                "query_id": _bytes_col(qid, 24),
+                "state": STATE_DICT.encode(state).astype(np.int32),
+                "query": _bytes_col(sql, 256),
+                "elapsed_s": np.asarray(elapsed, np.float64),
+                "output_rows": np.asarray(outrows, np.int64),
+            }
+        elif table == "runtime_metrics":
+            names, values = rows
+            arrays = {
+                "name": _bytes_col(names, 64),
+                "value": np.asarray(values, np.float64),
+            }
+        elif table == "runtime_nodes":
+            ids, platforms = rows
+            arrays = {
+                "node_id": _bytes_col(ids, 32),
+                "platform": _bytes_col(platforms, 16),
+            }
+        arrays = {c: v[split.lo : split.hi] for c, v in arrays.items()}
+        if columns is not None:
+            arrays = {c: arrays[c] for c in columns}
+        return arrays
+
+    def splits(self, table: str, target_splits: int = 0) -> Sequence[Split]:
+        n = self.row_count(table)
+        return [Split(table, 0, 0, n, max(n, 1))]
+
+    def scan(self, split: Split, columns=None, capacity=None) -> Batch:
+        arrays = dict(self.scan_numpy(split, columns))
+        n = len(next(iter(arrays.values()))) if arrays else 0
+        cap = capacity or batch_capacity(max(n, 1))
+        types = {c: SCHEMAS[split.table][c] for c in arrays}
+        dicts = {
+            c: d for c, d in self.dictionaries(split.table).items() if c in arrays
+        }
+        return Batch.from_numpy(arrays, types, capacity=cap, dictionaries=dicts)

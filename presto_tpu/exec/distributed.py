@@ -105,6 +105,8 @@ class DistributedExecutor:
         self.mesh = mesh
         self.nworkers = int(mesh.devices.size)
         self.broadcast_limit = broadcast_limit
+        #: optional StatsRecorder for the current query (see LocalExecutor)
+        self.recorder = None
 
     # ------------------------------------------------------------------
     def run(self, plan: N.PlanNode):
@@ -125,7 +127,19 @@ class DistributedExecutor:
         m = getattr(self, f"_exec_{type(node).__name__.lower()}", None)
         if m is None:
             raise NotImplementedError(f"no distributed executor for {type(node).__name__}")
-        return m(node, scalars)
+        rec = self.recorder
+        if rec is None:
+            return m(node, scalars)
+        import time as _time
+
+        t0 = _time.perf_counter()
+        out = m(node, scalars)
+        wall = _time.perf_counter() - t0  # inclusive of children
+        rows = -1
+        if rec.measure_rows and isinstance(out, DistBatch):
+            rows = live_count(out.batch)
+        rec.record(node, wall, rows)
+        return out
 
     def _replicate(self, d: DistBatch) -> DistBatch:
         """Reshard rows -> fully replicated (the gather/broadcast

@@ -88,6 +88,9 @@ def pick_group_strategy(keys, pax, child: list[Batch]):
 class LocalExecutor:
     def __init__(self, catalog: Catalog):
         self.catalog = catalog
+        #: optional StatsRecorder for the current query (set by the
+        #: Session; powers QueryInfo node stats and EXPLAIN ANALYZE)
+        self.recorder = None
 
     # ------------------------------------------------------------------
     def run(self, plan: N.PlanNode):
@@ -122,7 +125,19 @@ class LocalExecutor:
         m = getattr(self, f"_exec_{type(node).__name__.lower()}", None)
         if m is None:
             raise NotImplementedError(f"no executor for {type(node).__name__}")
-        return m(node, scalars)
+        rec = self.recorder
+        if rec is None:
+            return m(node, scalars)
+        import time as _time
+
+        t0 = _time.perf_counter()
+        out = m(node, scalars)
+        wall = _time.perf_counter() - t0  # inclusive of children
+        rows = -1
+        if rec.measure_rows and isinstance(out, list):
+            rows = sum(live_count(b) for b in out)
+        rec.record(node, wall, rows)
+        return out
 
     # ---- leaves ----------------------------------------------------------
     def _exec_tablescan(self, node: N.TableScan, scalars):

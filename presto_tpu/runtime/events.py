@@ -1,0 +1,48 @@
+"""Query event pipeline.
+
+Reference parity: ``QueryMonitor`` building ``QueryCreatedEvent`` /
+``QueryCompletedEvent`` and fanning out to registered ``EventListener``
+plugins — the SPI hook for audit logs, history stores, lineage
+[SURVEY §5.5; reference tree unavailable]. Listeners receive the same
+``QueryInfo`` the tracker stores; listener failures never fail the
+query (logged and swallowed, as the reference does).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Protocol
+
+from presto_tpu.runtime.stats import QueryInfo
+
+log = logging.getLogger("presto_tpu.events")
+
+
+class EventListener(Protocol):
+    def query_created(self, info: QueryInfo) -> None: ...
+
+    def query_completed(self, info: QueryInfo) -> None: ...
+
+
+class EventDispatcher:
+    def __init__(self, listeners=()):
+        self.listeners = list(listeners)
+
+    def add(self, listener: EventListener):
+        self.listeners.append(listener)
+
+    def _fire(self, method: str, info: QueryInfo):
+        for l in self.listeners:
+            fn = getattr(l, method, None)
+            if fn is None:
+                continue
+            try:
+                fn(info)
+            except Exception:  # listener bugs never fail queries
+                log.exception("event listener %r failed in %s", l, method)
+
+    def query_created(self, info: QueryInfo):
+        self._fire("query_created", info)
+
+    def query_completed(self, info: QueryInfo):
+        self._fire("query_completed", info)

@@ -1,0 +1,92 @@
+"""Process-wide metrics registry.
+
+Reference parity: Airlift's ``@Managed`` JMX beans — ``CounterStat``,
+``TimeStat``, ``DistributionStat`` — exported by every subsystem and
+queryable live through the JMX connector [SURVEY §5.5; reference tree
+unavailable]. Single-process, single-controller: a flat registry of
+named counters/timers, exposed as the ``system.runtime_metrics`` table
+and snapshot-able as JSON.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class CounterStat:
+    name: str
+    total: float = 0.0
+
+    def add(self, v: float = 1.0):
+        self.total += v
+
+
+@dataclass
+class TimeStat:
+    """Wall-time accumulator with count/total/min/max (the digest role
+    of Airlift's TimeStat, without decaying percentiles)."""
+
+    name: str
+    count: int = 0
+    total_s: float = 0.0
+    min_s: float = float("inf")
+    max_s: float = 0.0
+
+    def add(self, seconds: float):
+        self.count += 1
+        self.total_s += seconds
+        self.min_s = min(self.min_s, seconds)
+        self.max_s = max(self.max_s, seconds)
+
+    def time(self):
+        return _Timer(self)
+
+
+class _Timer:
+    def __init__(self, stat: TimeStat):
+        self.stat = stat
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.stat.add(time.perf_counter() - self.t0)
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counters: dict[str, CounterStat] = {}
+        self.timers: dict[str, TimeStat] = {}
+
+    def counter(self, name: str) -> CounterStat:
+        with self._lock:
+            if name not in self.counters:
+                self.counters[name] = CounterStat(name)
+            return self.counters[name]
+
+    def timer(self, name: str) -> TimeStat:
+        with self._lock:
+            if name not in self.timers:
+                self.timers[name] = TimeStat(name)
+            return self.timers[name]
+
+    def snapshot(self) -> dict:
+        out: dict[str, float] = {}
+        for c in self.counters.values():
+            out[c.name] = c.total
+        for t in self.timers.values():
+            out[t.name + ".count"] = float(t.count)
+            out[t.name + ".total_s"] = t.total_s
+            if t.count:
+                out[t.name + ".min_s"] = t.min_s
+                out[t.name + ".max_s"] = t.max_s
+        return out
+
+
+#: the process registry (reference: the JMX MBean server)
+REGISTRY = MetricsRegistry()

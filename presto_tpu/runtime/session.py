@@ -1,35 +1,62 @@
 """Session: the client-facing query surface.
 
 Reference parity: ``Session`` + the statement execution path
-(``SqlQueryExecution``: parse -> analyze -> plan -> execute)
-[SURVEY §2.1, §3.1; reference tree unavailable, paths reconstructed].
-Single-controller: there is no dispatch/queueing tier; ``sql()`` drives
-the full pipeline synchronously and returns a DataFrame.
+(``SqlQueryExecution``: parse -> analyze -> plan -> execute), the
+``QueryTracker``/``QueryStateMachine`` lifecycle (QUEUED -> RUNNING ->
+FINISHED/FAILED), ``QueryMonitor`` events, and EXPLAIN / EXPLAIN
+ANALYZE [SURVEY §2.1, §3.1, §5.1, §5.5; reference tree unavailable,
+paths reconstructed]. Single-controller: there is no dispatch/queueing
+tier; ``sql()`` drives the full pipeline synchronously and returns a
+DataFrame.
+
+Every session auto-registers the ``system`` catalog
+(system.runtime_queries / runtime_metrics / runtime_nodes) backed by
+its own query history and the process metrics registry.
 """
 
 from __future__ import annotations
 
-from typing import Mapping
+import itertools
+import time
+import uuid
+from typing import Mapping, Optional
 
 from presto_tpu.exec.local_planner import LocalExecutor
 from presto_tpu.plan.catalog import Catalog
 from presto_tpu.plan.nodes import PlanNode, plan_tree_str
 from presto_tpu.plan.prune import prune
+from presto_tpu.runtime.events import EventDispatcher
+from presto_tpu.runtime.metrics import REGISTRY
+from presto_tpu.runtime.stats import (
+    QueryInfo,
+    StatsRecorder,
+    render_analyzed_plan,
+)
 from presto_tpu.sql.analyzer import Analyzer
 from presto_tpu.sql.parser import parse
 
+_query_seq = itertools.count(1)
+
 
 class Session:
-    def __init__(self, connectors: Mapping[str, object], properties=None, mesh=None):
+    def __init__(self, connectors: Mapping[str, object], properties=None,
+                 mesh=None, trace_token: Optional[str] = None):
         """``mesh=None`` runs single-device (the LocalQueryRunner shape);
         passing a ``jax.sharding.Mesh`` runs every query distributed
         over its ``workers`` axis (the DistributedQueryRunner shape).
         Session properties override engine defaults per query, the
         reference's SystemSessionProperties rule [SURVEY §5.6]."""
-        self.catalog = Catalog(connectors)
+        from presto_tpu.connectors.system import SystemConnector
+
+        conns = dict(connectors)
+        conns.setdefault("system", SystemConnector(self))
+        self.catalog = Catalog(conns)
         self.analyzer = Analyzer(self.catalog)
         self.properties = dict(properties or {})
         self.mesh = mesh
+        self.trace_token = trace_token
+        self.events = EventDispatcher()
+        self.query_history: list[QueryInfo] = []
         if mesh is None:
             self.executor = LocalExecutor(self.catalog)
         else:
@@ -43,6 +70,11 @@ class Session:
                 ),
             )
 
+    # ------------------------------------------------------------------
+    def add_event_listener(self, listener):
+        """Register an EventListener (reference: EventListener SPI)."""
+        self.events.add(listener)
+
     def plan(self, sql: str) -> PlanNode:
         ast = parse(sql)
         logical = self.analyzer.analyze(ast)
@@ -51,6 +83,61 @@ class Session:
     def explain(self, sql: str) -> str:
         return plan_tree_str(self.plan(sql))
 
+    def explain_analyze(self, sql: str) -> str:
+        """Execute and render the plan annotated with actuals
+        (reference: EXPLAIN ANALYZE)."""
+        recorder = StatsRecorder()
+        plan = self.plan(sql)
+        self._run_tracked(sql, plan, recorder)
+        return render_analyzed_plan(plan, recorder)
+
     def sql(self, sql: str):
         """Execute and return a pandas DataFrame."""
-        return self.executor.run(self.plan(sql))
+        recorder = (
+            StatsRecorder()
+            if self.properties.get("collect_node_stats")
+            else None
+        )
+        df, _info = self._run_tracked(sql, self.plan(sql), recorder)
+        return df
+
+    def execute(self, sql: str):
+        """Execute returning (DataFrame, QueryInfo)."""
+        recorder = StatsRecorder()
+        return self._run_tracked(sql, self.plan(sql), recorder)
+
+    # ------------------------------------------------------------------
+    def _run_tracked(self, sql: str, plan: PlanNode, recorder):
+        info = QueryInfo(
+            query_id=f"q_{next(_query_seq)}_{uuid.uuid4().hex[:8]}",
+            sql=sql,
+            state="QUEUED",
+            created_at=time.time(),
+            trace_token=self.trace_token,
+        )
+        self.query_history.append(info)
+        REGISTRY.counter("query.started").add()
+        self.events.query_created(info)
+        info.state = "RUNNING"
+        info.started_at = time.time()
+        self.executor.recorder = recorder
+        try:
+            with REGISTRY.timer("query.execution").time():
+                df = self.executor.run(plan)
+            info.state = "FINISHED"
+            info.output_rows = len(df)
+            REGISTRY.counter("query.completed").add()
+        except Exception as e:
+            info.state = "FAILED"
+            info.error = f"{type(e).__name__}: {e}"
+            REGISTRY.counter("query.failed").add()
+            raise
+        finally:
+            info.finished_at = time.time()
+            self.executor.recorder = None
+            if recorder is not None:
+                info.node_stats = [
+                    s.to_dict() for s in recorder.nodes.values()
+                ]
+            self.events.query_completed(info)
+        return df, info

@@ -1,0 +1,133 @@
+"""Per-query execution statistics.
+
+Reference parity: ``OperatorStats`` accumulated in ``OperatorContext``,
+rolled up Driver->Pipeline->Task->``QueryStats`` and shipped in
+``QueryInfo`` JSON; rendered by EXPLAIN ANALYZE [SURVEY §5.1;
+reference tree unavailable, paths reconstructed].
+
+TPU-first shape: the single-controller executors have one dispatch
+choke point per plan node, so stats attach to *plan nodes* (the logical
+operators) rather than worker-side operator instances. Device-compute
+inside a fused step is opaque to host timers by design — XLA owns the
+schedule; per-node wall time measures the host-observed latency of the
+node's dispatch including its device work (jax profiler traces cover
+the intra-step timeline, SURVEY §5.1 TPU mapping).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+@dataclass
+class NodeStats:
+    """Actuals for one plan node (reference: OperatorStats)."""
+
+    node_type: str
+    detail: str = ""
+    wall_s: float = 0.0
+    output_rows: int = -1  # -1: not measured
+    invocations: int = 0
+
+    def to_dict(self):
+        return {
+            "node": self.node_type,
+            "detail": self.detail,
+            "wall_s": round(self.wall_s, 6),
+            "output_rows": self.output_rows,
+            "invocations": self.invocations,
+        }
+
+
+class StatsRecorder:
+    """Collects NodeStats keyed by plan-node identity during one query."""
+
+    def __init__(self, measure_rows: bool = True):
+        self.nodes: dict[int, NodeStats] = {}
+        self.measure_rows = measure_rows
+
+    def record(self, node, wall_s: float, output_rows: int = -1):
+        key = id(node)
+        st = self.nodes.get(key)
+        if st is None:
+            st = NodeStats(type(node).__name__)
+            self.nodes[key] = st
+        st.wall_s += wall_s
+        st.invocations += 1
+        if output_rows >= 0:
+            st.output_rows = output_rows
+
+    def stats_for(self, node) -> Optional[NodeStats]:
+        return self.nodes.get(id(node))
+
+
+@dataclass
+class QueryInfo:
+    """One executed query's full record (reference: QueryInfo JSON).
+
+    ``trace_token`` propagates from the session for cross-system
+    correlation [SURVEY §5.1]."""
+
+    query_id: str
+    sql: str
+    state: str  # QUEUED -> RUNNING -> FINISHED | FAILED
+    created_at: float
+    trace_token: Optional[str] = None
+    started_at: Optional[float] = None
+    finished_at: Optional[float] = None
+    error: Optional[str] = None
+    output_rows: int = -1
+    node_stats: list = field(default_factory=list)  # list[NodeStats.to_dict()]
+
+    @property
+    def elapsed_s(self) -> float:
+        if self.started_at is None:
+            return 0.0
+        end = self.finished_at if self.finished_at is not None else time.time()
+        return end - self.started_at
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "queryId": self.query_id,
+                "sql": self.sql,
+                "state": self.state,
+                "traceToken": self.trace_token,
+                "createdAt": self.created_at,
+                "startedAt": self.started_at,
+                "finishedAt": self.finished_at,
+                "elapsedS": round(self.elapsed_s, 6),
+                "error": self.error,
+                "outputRows": self.output_rows,
+                "nodeStats": self.node_stats,
+            }
+        )
+
+
+def render_analyzed_plan(plan, recorder: StatsRecorder) -> str:
+    """EXPLAIN ANALYZE rendering: the plan tree annotated with actuals
+    (reference: PlanPrinter.textDistributedPlan with stats)."""
+    from presto_tpu.plan.nodes import plan_tree_str
+
+    lines = []
+
+    def walk(node, indent):
+        pad = "  " * indent
+        name = type(node).__name__
+        st = recorder.stats_for(node)
+        if st is not None:
+            rows = "?" if st.output_rows < 0 else f"{st.output_rows:,}"
+            lines.append(
+                f"{pad}{name}  [wall {st.wall_s * 1e3:.1f}ms, rows {rows}, "
+                f"calls {st.invocations}]"
+            )
+        else:
+            lines.append(f"{pad}{name}  [not executed]")
+        for c in node.children:
+            walk(c, indent + 1)
+
+    walk(plan, 0)
+    return "\n".join(lines) + "\n"
