@@ -9,22 +9,16 @@ Env vars must be set before jax is imported anywhere.
 """
 
 import os
+import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "1")
 
-import jax  # noqa: E402
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from __graft_entry__ import _provision_virtual_mesh  # noqa: E402
 
-# A site hook may pre-register an accelerator plugin and force
-# jax_platforms via config (overriding the env var), which then blocks
-# on hardware init. Pin the config value itself before any backend
-# initializes: tests always run on the virtual CPU mesh.
-jax.config.update("jax_platforms", "cpu")
+_provision_virtual_mesh(8)
+
+import jax  # noqa: E402
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
