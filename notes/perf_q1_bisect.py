@@ -1,0 +1,85 @@
+"""Bisect the real q1_fused_step on TPU: which stage eats the time?
+
+python notes/perf_q1_bisect.py [sf]
+"""
+
+import sys
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from presto_tpu.connectors.tpch import TpchConnector
+from presto_tpu.expr import evaluate, evaluate_predicate
+from presto_tpu.ops.groupby import group_ids_direct, segment_agg
+from presto_tpu.spi import batch_capacity
+from presto_tpu.workloads import Q1_COLS, Q1_GROUPS, q1_exprs, q1_fused_step
+
+sf = float(sys.argv[1]) if len(sys.argv) > 1 else 0.1
+
+conn = TpchConnector(sf=sf, units_per_split=1 << 18)
+splits = list(conn.splits("lineitem"))
+cap = batch_capacity(max(s.row_hint for s in splits))
+dev = jax.devices()[0]
+print(f"device={dev.platform} splits={len(splits)} cap={cap}", flush=True)
+
+b = jax.device_put(conn.scan(splits[0], Q1_COLS, cap), dev)
+n = int(b.count())
+print(f"rows in batch: {n}", flush=True)
+
+pred, disc_price, charge = q1_exprs()
+
+
+def timeit(name, fn, *args):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    iters = 5
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+    print(f"{name:34s} {dt*1e3:9.3f} ms  {n/dt/1e6:9.1f} Mrows/s", flush=True)
+
+
+timeit("full q1_fused_step", jax.jit(q1_fused_step), b)
+timeit("predicate only", jax.jit(lambda bb: bb.live & evaluate_predicate(pred, bb)), b)
+timeit(
+    "gids only",
+    jax.jit(
+        lambda bb: group_ids_direct(
+            [bb["l_returnflag"].data, bb["l_linestatus"].data],
+            (0, 0), (2, 1), bb.live, Q1_GROUPS,
+        )
+    ),
+    b,
+)
+timeit("disc_price expr", jax.jit(lambda bb: evaluate(disc_price, bb).data), b)
+timeit("charge expr", jax.jit(lambda bb: evaluate(charge, bb).data), b)
+
+
+@jax.jit
+def aggs_only(bb):
+    live = bb.live
+    gids, present = group_ids_direct(
+        [bb["l_returnflag"].data, bb["l_linestatus"].data],
+        (0, 0), (2, 1), live, Q1_GROUPS,
+    )
+    qty = bb["l_quantity"].data
+    seg = partial(segment_agg, gids=gids, max_groups=Q1_GROUPS, kind="sum")
+    return seg(qty, live)
+
+
+timeit("one segment_agg (no exprs)", aggs_only, b)
+
+
+@jax.jit
+def charge_nodiv(bb):
+    ep = bb["l_extendedprice"].data
+    d = bb["l_discount"].data
+    t = bb["l_tax"].data
+    return ep * (100 - d) * (100 + t)  # scale 6, no rescale division
+
+
+timeit("charge w/o rescale div", charge_nodiv, b)

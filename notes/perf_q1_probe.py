@@ -1,0 +1,177 @@
+"""Q1 kernel microbenchmark: find the fastest exact 6-group aggregation.
+
+Run on the real TPU chip:  python notes/perf_q1_probe.py [nrows_log2]
+
+Variants (all compute the same 4 sums + count over 6 groups):
+  A  current engine path: int64 values, jax.ops.segment_sum (scatter)
+  B  int32 values, per-chunk int32 segment_sum, int64 cross-chunk combine
+  C  int32 values, per-group masked reductions (chunked, lane-split)
+  D  int32 values, one-hot f32 matmul with 15-bit lane split (MXU)
+  R  roofline: just sum every input column (pure bandwidth)
+
+Exactness: B/C/D split values into 15-bit lanes so every in-chunk
+accumulation stays within int32 / exact-f32 range; the cross-chunk
+combine runs in int64 over [nchunks, groups] only.
+"""
+
+import sys
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+LOG2 = int(sys.argv[1]) if len(sys.argv) > 1 else 22
+N = 1 << LOG2
+G = 6
+CHUNK = 1 << 15
+NCH = N // CHUNK
+
+rng = np.random.default_rng(0)
+# Value magnitudes mirror TPC-H Q1: qty ~ 5e3, ep ~ 1e7, dp/charge ~ 1.2e9.
+cols64 = {
+    "qty": rng.integers(100, 5100, N, dtype=np.int64),
+    "ep": rng.integers(100000, 10**7, N, dtype=np.int64),
+    "dp": rng.integers(10**6, 10**9, N, dtype=np.int64),
+    "ch": rng.integers(10**6, 12 * 10**8, N, dtype=np.int64),
+}
+gid_np = rng.integers(0, G, N, dtype=np.int32)
+live_np = rng.random(N) < 0.98
+
+dev = jax.devices()[0]
+print("device:", dev.platform, flush=True)
+cols64_d = {k: jax.device_put(jnp.asarray(v), dev) for k, v in cols64.items()}
+cols32_d = {
+    k: jax.device_put(jnp.asarray(v.astype(np.int32)), dev) for k, v in cols64.items()
+}
+gid = jax.device_put(jnp.asarray(gid_np), dev)
+live = jax.device_put(jnp.asarray(live_np), dev)
+
+
+def timeit(name, fn, *args):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    iters = 5
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+    print(f"{name:40s} {dt*1e3:9.3f} ms   {N/dt/1e6:10.1f} Mrows/s", flush=True)
+    return out
+
+
+# --- A: current path ---------------------------------------------------------
+@jax.jit
+def variant_a(cols, gid, live):
+    g = jnp.where(live, gid, G)
+    out = {}
+    for k, v in cols.items():
+        vals = jnp.where(live, v, 0)
+        out[k] = jax.ops.segment_sum(vals, g, num_segments=G + 1)[:G]
+    out["count"] = jax.ops.segment_sum(
+        live.astype(jnp.int64), g, num_segments=G + 1
+    )[:G]
+    return out
+
+
+# --- B: chunked int32 segment_sum -------------------------------------------
+@jax.jit
+def variant_b(cols, gid, live):
+    g = jnp.where(live, gid, G).reshape(NCH, CHUNK)
+    out = {}
+    for k, v in cols.items():
+        v = jnp.where(live, v, 0).reshape(NCH, CHUNK)
+        lo = v & 0x7FFF
+        hi = v >> 15
+        f = jax.vmap(lambda vv, gg: jax.ops.segment_sum(vv, gg, num_segments=G + 1))
+        slo = f(lo, g)[:, :G].astype(jnp.int64).sum(0)
+        shi = f(hi, g)[:, :G].astype(jnp.int64).sum(0)
+        out[k] = slo + (shi << 15)
+    cnt = jax.vmap(lambda gg: jnp.zeros(G + 1, jnp.int32).at[gg].add(1))(g)
+    out["count"] = cnt[:, :G].astype(jnp.int64).sum(0)
+    return out
+
+
+# --- C: per-group masked reductions ------------------------------------------
+@jax.jit
+def variant_c(cols, gid, live):
+    g = jnp.where(live, gid, G).reshape(NCH, CHUNK)
+    out = {}
+    for k, v in cols.items():
+        v = jnp.where(live, v, 0).reshape(NCH, CHUNK)
+        lo = v & 0x7FFF
+        hi = v >> 15
+        acc_lo = jnp.stack(
+            [jnp.sum(jnp.where(g == i, lo, 0), axis=1) for i in range(G)], axis=1
+        )  # [NCH, G] int32
+        acc_hi = jnp.stack(
+            [jnp.sum(jnp.where(g == i, hi, 0), axis=1) for i in range(G)], axis=1
+        )
+        out[k] = acc_lo.astype(jnp.int64).sum(0) + (
+            acc_hi.astype(jnp.int64).sum(0) << 15
+        )
+    cnt = jnp.stack(
+        [jnp.sum((g == i).astype(jnp.int32), axis=1) for i in range(G)], axis=1
+    )
+    out["count"] = cnt.astype(jnp.int64).sum(0)
+    return out
+
+
+# --- D: one-hot f32 matmul ---------------------------------------------------
+@jax.jit
+def variant_d(cols, gid, live):
+    g = jnp.where(live, gid, G).reshape(NCH, CHUNK)
+    onehot = (g[..., None] == jnp.arange(G)[None, None, :]).astype(jnp.float32)
+    out = {}
+    for k, v in cols.items():
+        v = jnp.where(live, v, 0).reshape(NCH, CHUNK)
+        lo = (v & 0x7FFF).astype(jnp.float32)
+        hi = (v >> 15).astype(jnp.float32)
+        # [NCH, CHUNK] @ [NCH, CHUNK, G] -> [NCH, G]; f32 accum exact while
+        # per-chunk lane sums < 2^24? NO: 32768 * 32767 ~ 2^30 > 2^24.
+        # Use CHUNK=2^15 but split into 2^9-row microtiles via reshape.
+        T = 1 << 9
+        lo = lo.reshape(NCH, CHUNK // T, T)
+        hi = hi.reshape(NCH, CHUNK // T, T)
+        oh = onehot.reshape(NCH, CHUNK // T, T, G)
+        slo = jnp.einsum("nct,nctg->ng", lo, oh)  # exact: 512*32767 < 2^24
+        shi = jnp.einsum("nct,nctg->ng", hi, oh)
+        out[k] = slo.astype(jnp.int64).sum(0) + (shi.astype(jnp.int64).sum(0) << 15)
+    out["count"] = (
+        jnp.einsum("nctg->ng", onehot.reshape(NCH, CHUNK // T, T, G))
+        .astype(jnp.int64)
+        .sum(0)
+    )
+    return out
+
+
+# --- R: roofline -------------------------------------------------------------
+@jax.jit
+def roofline32(cols, gid, live):
+    tot = live.astype(jnp.int32).sum()
+    for v in cols.values():
+        tot = tot + v.sum(dtype=jnp.int32)
+    return tot + gid.sum()
+
+
+@jax.jit
+def roofline64(cols, gid, live):
+    tot = live.astype(jnp.int64).sum()
+    for v in cols.values():
+        tot = tot + v.sum(dtype=jnp.int64)
+    return tot + gid.sum().astype(jnp.int64)
+
+
+ref = timeit("A  int64 segment_sum (current)", variant_a, cols64_d, gid, live)
+b = timeit("B  chunked int32 segment_sum", variant_b, cols32_d, gid, live)
+c = timeit("C  per-group masked reductions", variant_c, cols32_d, gid, live)
+d = timeit("D  one-hot f32 matmul", variant_d, cols32_d, gid, live)
+timeit("R32 roofline int32 read+sum", roofline32, cols32_d, gid, live)
+timeit("R64 roofline int64 read+sum", roofline64, cols64_d, gid, live)
+
+for name, out in (("B", b), ("C", c), ("D", d)):
+    for k in ref:
+        np.testing.assert_array_equal(np.asarray(ref[k]), np.asarray(out[k]), err_msg=f"{name}:{k}")
+print("exactness: B, C, D all match A bit-for-bit")

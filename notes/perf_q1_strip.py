@@ -1,0 +1,117 @@
+"""Strip q1_fused_step piece by piece on the real batch, in ONE process."""
+
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from presto_tpu.batch import Batch, Column
+from presto_tpu.connectors.tpch import TpchConnector
+from presto_tpu.expr import evaluate, evaluate_predicate
+from presto_tpu.ops.groupby import group_ids_direct, segment_agg
+from presto_tpu.types import DATE, decimal, varchar
+from presto_tpu.workloads import Q1_COLS, Q1_GROUPS, q1_exprs, q1_fused_step
+
+dev = jax.devices()[0]
+CAP = 1 << 21
+
+conn = TpchConnector(sf=0.5, units_per_split=1 << 18)
+real = jax.device_put(conn.scan(conn.splits("lineitem")[0], Q1_COLS, CAP), dev)
+jax.block_until_ready(real)
+n = int(real.count())
+print(f"device={dev.platform} rows={n} cap={CAP}", flush=True)
+for name in real:
+    c = real[name]
+    print(f"  {name}: {c.data.dtype} valid={c.valid is not None and bool((~c.valid).sum()==0)}")
+
+# synthetic clone: same shapes/dtypes, fresh random data
+rng = np.random.default_rng(0)
+cols = {}
+for name in real:
+    c = real[name]
+    data = jnp.asarray(rng.integers(0, 100, CAP).astype(c.data.dtype))
+    cols[name] = Column(jax.device_put(data, dev), c.valid, c.dtype, c.dictionary)
+synth = Batch(cols, real.live)
+jax.block_until_ready(synth)
+
+
+def timeit(name, fn, *args, iters=5):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+    print(f"{name:44s} {dt*1e3:9.3f} ms  {n/dt/1e6:9.1f} Mrows/s", flush=True)
+
+
+pred, disc_price, charge = q1_exprs()
+
+step = jax.jit(q1_fused_step)
+timeit("full step (real batch)", step, real)
+timeit("full step (synthetic batch)", step, synth)
+
+
+def no_present(batch):
+    live = batch.live & evaluate_predicate(pred, batch)
+    gids = jnp.where(
+        live,
+        (batch["l_returnflag"].data.astype(jnp.int32)) * 2
+        + batch["l_linestatus"].data.astype(jnp.int32),
+        Q1_GROUPS,
+    )
+    qty = batch["l_quantity"].data
+    ep = batch["l_extendedprice"].data
+    dp = evaluate(disc_price, batch).data
+    ch = evaluate(charge, batch).data
+    seg = partial(segment_agg, gids=gids, max_groups=Q1_GROUPS, kind="sum")
+    return {
+        "sum_qty": seg(qty, live),
+        "sum_base_price": seg(ep, live),
+        "sum_disc_price": seg(dp, live),
+        "sum_charge": seg(ch, live),
+        "count_order": segment_agg(live.astype(jnp.int32), live, gids, Q1_GROUPS, "count"),
+    }
+
+
+timeit("step w/o present scatter, no ones_like", jax.jit(no_present), real)
+
+
+def aggs_only_4(batch):
+    live = batch.live
+    gids = jnp.where(live, batch["l_returnflag"].data * 2 + batch["l_linestatus"].data, Q1_GROUPS)
+    seg = partial(segment_agg, gids=gids, max_groups=Q1_GROUPS, kind="sum")
+    return (
+        seg(batch["l_quantity"].data, live),
+        seg(batch["l_extendedprice"].data, live),
+    )
+
+
+timeit("2 segment_aggs only (real)", jax.jit(aggs_only_4), real)
+timeit("2 segment_aggs only (synth)", jax.jit(aggs_only_4), synth)
+
+
+def one_seg(batch):
+    live = batch.live
+    gids = jnp.where(live, batch["l_returnflag"].data * 2 + batch["l_linestatus"].data, Q1_GROUPS)
+    return segment_agg(batch["l_quantity"].data, live, gids, Q1_GROUPS, "sum")
+
+
+timeit("1 segment_agg (real)", jax.jit(one_seg), real)
+
+
+def sums_only(batch):
+    return (
+        batch["l_quantity"].data.sum(),
+        batch["l_extendedprice"].data.sum(),
+        batch["l_discount"].data.sum(),
+        batch["l_tax"].data.sum(),
+        batch["l_shipdate"].data.sum(),
+    )
+
+
+timeit("plain col sums (real)", jax.jit(sums_only), real)
+timeit("full step again (real)", step, real)

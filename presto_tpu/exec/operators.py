@@ -119,6 +119,11 @@ class AggSpec:
     input: Expr | None
     name: str
     dtype: DataType
+    # Static bound on bit-width of |input values| (NOT of the running
+    # sum). Lets the scatter-free small-group sum use fewer 15-bit lane
+    # passes; 63 is always safe. Only per-batch per-row values see this
+    # bound — merge stages aggregate accumulated sums and always use 63.
+    value_bits: int = 63
 
     @property
     def merge_kind(self) -> str:
@@ -249,7 +254,12 @@ class HashAggregationOperator(Operator):
         new["present"] = state["present"] | present
         for a, (vals, contrib) in zip(self.aggs, inputs):
             kind = self._agg_kind(a)
-            part = segment_agg(vals, contrib, gids, st.num_groups, kind)
+            # merge stages aggregate accumulated sums, not per-row values:
+            # the per-row bound only applies in the partial phase
+            bits = a.value_bits if self.phase != "final" else 63
+            part = segment_agg(
+                vals, contrib, gids, st.num_groups, kind, value_bits=bits
+            )
             prev = state[a.name]
             if kind == "sum":
                 new[a.name] = prev + part

@@ -55,7 +55,13 @@ def group_ids_direct(key_cols, mins, strides, live, num_groups: int):
         gid = t if gid is None else gid + t
     gid = jnp.clip(gid, 0, num_groups - 1)
     gid = jnp.where(live, gid, num_groups)
-    present = jnp.zeros(num_groups + 1, dtype=jnp.bool_).at[gid].set(True)[:num_groups]
+    if num_groups <= SMALL_GROUP_LIMIT:
+        # scatter-free presence: one any-reduction per group
+        present = jnp.stack([jnp.any(gid == g) for g in range(num_groups)])
+    else:
+        present = (
+            jnp.zeros(num_groups + 1, dtype=jnp.bool_).at[gid].set(True)[:num_groups]
+        )
     return gid, present
 
 
@@ -116,15 +122,117 @@ def _identity(kind: str, dtype):
     return jnp.asarray(0, dtype)
 
 
-def segment_agg(values, contrib, gids, max_groups: int, kind: str):
+# Below this group count, aggregation avoids scatters entirely (measured
+# ~25x faster on TPU: scatter-add serializes, masked reductions ride the
+# VPU at memory bandwidth — notes/perf_q1_probe.py variant C).
+SMALL_GROUP_LIMIT = 32
+
+# Chunk length for the lane-split accumulators: 15-bit lanes x 2^16-row
+# chunks keep every in-chunk partial sum < 2^31 (32767 * 65536 < 2^31),
+# so the hot loop runs entirely in native int32; only the [nchunks,
+# groups] combine widens to int64.
+_LANE_BITS = 15
+_LANE_CHUNK = 1 << 16
+
+
+def _chunked(x, cap: int, fill):
+    """Reshape [cap] -> [nchunks, <=2^16] (zero-padding to a chunk
+    multiple when needed, so per-chunk int32 sums can never overflow)."""
+    if cap <= _LANE_CHUNK:
+        return x.reshape(1, cap)
+    if cap % _LANE_CHUNK:
+        pad = _LANE_CHUNK - cap % _LANE_CHUNK
+        x = jnp.concatenate([x, jnp.full(pad, fill, dtype=x.dtype)])
+        cap = cap + pad
+    return x.reshape(cap // _LANE_CHUNK, _LANE_CHUNK)
+
+
+def _masked_group_sums(vals2d, gids2d, num_groups: int):
+    """[nch, chunk] int32 values -> [num_groups] int32 per-chunk-summed.
+
+    Scatter-free: one masked reduction per group (VPU-native). Caller
+    guarantees per-chunk sums cannot overflow int32.
+    """
+    per_chunk = jnp.stack(
+        [
+            jnp.sum(jnp.where(gids2d == g, vals2d, 0), axis=1, dtype=jnp.int32)
+            for g in range(num_groups)
+        ],
+        axis=1,
+    )  # [nch, G] int32
+    return per_chunk
+
+
+def _small_sum_int(values, contrib, gids, max_groups: int, value_bits: int):
+    """Exact integer sum per group without scatters.
+
+    Splits each value into ceil(value_bits/15)-many 15-bit lanes,
+    accumulates each lane per 2^16-row chunk in int32 (provably no
+    overflow), then recombines in int64 over the tiny [nch, G] partials.
+    """
+    cap = values.shape[0]
+    v = jnp.where(contrib, values, 0)
+    neg = v < 0
+    mag = jnp.abs(v)
+    g2 = _chunked(jnp.where(contrib, gids, max_groups), cap, max_groups)
+    # lanes never exceed what the value dtype can hold (shift >= width
+    # is undefined); int32 inputs cap at 31 bits -> 3 lanes
+    value_bits = min(value_bits, jnp.iinfo(values.dtype).bits - 1)
+    nlanes = max(1, -(-value_bits // _LANE_BITS))
+    total = jnp.zeros(max_groups, dtype=jnp.int64)
+    for lane in range(nlanes):
+        lane_vals = ((mag >> (lane * _LANE_BITS)) & ((1 << _LANE_BITS) - 1)).astype(
+            jnp.int32
+        )
+        lane_vals = jnp.where(neg, -lane_vals, lane_vals)
+        per_chunk = _masked_group_sums(_chunked(lane_vals, cap, 0), g2, max_groups)
+        total = total + (per_chunk.astype(jnp.int64).sum(axis=0) << (lane * _LANE_BITS))
+    return total
+
+
+def _small_agg(values, contrib, gids, max_groups: int, kind: str, value_bits: int):
+    cap = contrib.shape[0]
+    g2 = _chunked(jnp.where(contrib, gids, max_groups), cap, max_groups)
+    if kind == "count":
+        per_chunk = _masked_group_sums(
+            _chunked(contrib.astype(jnp.int32), cap, 0), g2, max_groups
+        )
+        return per_chunk.astype(jnp.int64).sum(axis=0)
+    if kind == "sum":
+        if jnp.issubdtype(values.dtype, jnp.floating):
+            v = _chunked(jnp.where(contrib, values, 0), cap, 0)
+            per_chunk = jnp.stack(
+                [jnp.sum(jnp.where(g2 == g, v, 0), axis=1) for g in range(max_groups)],
+                axis=1,
+            )
+            return per_chunk.sum(axis=0)
+        out = _small_sum_int(values, contrib, gids, max_groups, value_bits)
+        return out.astype(values.dtype) if values.dtype != jnp.int64 else out
+    # min/max: plain masked reductions per group (no overflow concern).
+    ident = _identity(kind, values.dtype)
+    v = _chunked(jnp.where(contrib, values, ident), cap, ident)
+    red = jnp.min if kind == "min" else jnp.max
+    return jnp.stack(
+        [red(jnp.where(g2 == g, v, ident)) for g in range(max_groups)]
+    )
+
+
+def segment_agg(
+    values, contrib, gids, max_groups: int, kind: str, value_bits: int = 63
+):
     """Aggregate ``values`` per group.
 
     contrib: bool mask of rows that contribute (live AND value-valid).
     kind: 'sum' | 'count' | 'min' | 'max'.
+    value_bits: static bound on bit-width of |values| (callers with
+    typed columns can pass a tighter bound to cut lane passes; 63 is
+    always safe for int64).
     Returns array [max_groups] (trash segment sliced off). Groups with
     no contributing rows yield the kind's identity — pair with a count
     to rebuild SQL NULL semantics.
     """
+    if max_groups <= SMALL_GROUP_LIMIT:
+        return _small_agg(values, contrib, gids, max_groups, kind, value_bits)
     nseg = max_groups + 1
     g = jnp.where(contrib, gids, max_groups)
     if kind == "count":

@@ -6,7 +6,10 @@ reference tree unavailable].
 
 TPU-first (SURVEY §7.1): the "hash table" is a *sorted key array* —
 build compacts live rows and sorts them by key; probe is
-``searchsorted`` (log2(B) gathers, fully vectorized, no scatter).
+``searchsorted(method="sort")``, i.e. sort-merge: the probe keys are
+sorted and merged against the build keys (binary-search probing is
+~17x slower on TPU — its log2(B) dependent gathers serialize, while
+sorts ride the native sort unit; measured in notes/PERF.md).
 Duplicate build keys are handled by (lo, hi) range probes plus a
 prefix-sum expansion with a static output capacity and an overflow
 flag. FK->PK joins (unique build keys: most TPC-H joins) take the
@@ -69,7 +72,7 @@ def probe_unique(build: BuildSide, probe_keys, probe_live) -> UniqueProbe:
     (left outer).
     """
     pk = probe_keys.astype(jnp.int64)
-    pos = jnp.searchsorted(build.sorted_keys, pk)
+    pos = jnp.searchsorted(build.sorted_keys, pk, method="sort")
     hit_key = gather_padded(build.sorted_keys, pos, _I64_MAX)
     matched = (hit_key == pk) & probe_live & (pk != _I64_MAX)
     build_row = jnp.where(matched, gather_padded(build.row_idx, pos, 0), build.row_idx.shape[0])
@@ -97,8 +100,8 @@ def probe_expand(
     """
     probe_cap = probe_keys.shape[0]
     pk = jnp.where(probe_live, probe_keys.astype(jnp.int64), _I64_MAX)
-    lo = jnp.searchsorted(build.sorted_keys, pk, side="left")
-    hi = jnp.searchsorted(build.sorted_keys, pk, side="right")
+    lo = jnp.searchsorted(build.sorted_keys, pk, side="left", method="sort")
+    hi = jnp.searchsorted(build.sorted_keys, pk, side="right", method="sort")
     matches = jnp.where(probe_live & (pk != _I64_MAX), hi - lo, 0)
     counts = jnp.where(probe_live & (matches == 0), 1, matches) if left else matches
     offsets = jnp.cumsum(counts) - counts  # exclusive prefix
@@ -106,7 +109,7 @@ def probe_expand(
 
     j = jnp.arange(out_capacity)
     # probe row owning output slot j: last i with offsets[i] <= j
-    probe_row = jnp.searchsorted(offsets, j, side="right") - 1
+    probe_row = jnp.searchsorted(offsets, j, side="right", method="sort") - 1
     probe_row = jnp.clip(probe_row, 0, probe_cap - 1)
     rank = j - offsets[probe_row]
     valid = (j < total) & (rank >= 0) & (rank < counts[probe_row])
@@ -123,7 +126,7 @@ def probe_exists(build: BuildSide, probe_keys, probe_live):
     """Semi-join membership: True where the probe key exists in build.
     (reference: SetBuilderOperator / HashSemiJoinOperator)."""
     pk = probe_keys.astype(jnp.int64)
-    pos = jnp.searchsorted(build.sorted_keys, pk)
+    pos = jnp.searchsorted(build.sorted_keys, pk, method="sort")
     hit_key = gather_padded(build.sorted_keys, pos, _I64_MAX)
     return (hit_key == pk) & probe_live & (pk != _I64_MAX)
 

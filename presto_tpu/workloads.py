@@ -49,13 +49,24 @@ def q1_exprs():
     return pred, disc_price, charge
 
 
+# Per-row |value| bit bounds from the TPC-H spec (§4.2.3 data ranges):
+# quantity <= 50.00 (scaled 5e3 -> 13 bits), extendedprice <= ~105k
+# (scaled ~1.05e7 -> 24 bits), disc_price/charge at scale 4 <= ~1.2e9
+# (31 bits). Bounds feed the lane-split aggregation (fewer passes).
+Q1_BITS = {"sum_qty": 13, "sum_base_price": 24, "sum_disc_price": 31, "sum_charge": 31}
+
+
 def q1_aggs():
     _, disc_price, charge = q1_exprs()
     return [
-        AggSpec("sum", col("l_quantity", dec2), "sum_qty", decimal(38, 2)),
-        AggSpec("sum", col("l_extendedprice", dec2), "sum_base_price", decimal(38, 2)),
-        AggSpec("sum", disc_price, "sum_disc_price", dec4),
-        AggSpec("sum", charge, "sum_charge", dec4),
+        AggSpec("sum", col("l_quantity", dec2), "sum_qty", decimal(38, 2),
+                value_bits=Q1_BITS["sum_qty"]),
+        AggSpec("sum", col("l_extendedprice", dec2), "sum_base_price",
+                decimal(38, 2), value_bits=Q1_BITS["sum_base_price"]),
+        AggSpec("sum", disc_price, "sum_disc_price", dec4,
+                value_bits=Q1_BITS["sum_disc_price"]),
+        AggSpec("sum", charge, "sum_charge", dec4,
+                value_bits=Q1_BITS["sum_charge"]),
         AggSpec("count_star", None, "count_order", BIGINT),
     ]
 
@@ -105,13 +116,11 @@ def q1_fused_step(batch: Batch):
     seg = partial(segment_agg, gids=gids, max_groups=Q1_GROUPS, kind="sum")
     return {
         "present": present,
-        "sum_qty": seg(qty, live),
-        "sum_base_price": seg(ep, live),
-        "sum_disc_price": seg(dp, live),
-        "sum_charge": seg(ch, live),
-        "count_order": segment_agg(
-            jnp.ones_like(qty), live, gids, Q1_GROUPS, "count"
-        ),
+        "sum_qty": seg(qty, live, value_bits=Q1_BITS["sum_qty"]),
+        "sum_base_price": seg(ep, live, value_bits=Q1_BITS["sum_base_price"]),
+        "sum_disc_price": seg(dp, live, value_bits=Q1_BITS["sum_disc_price"]),
+        "sum_charge": seg(ch, live, value_bits=Q1_BITS["sum_charge"]),
+        "count_order": segment_agg(qty, live, gids, Q1_GROUPS, "count"),
     }
 
 
