@@ -24,6 +24,20 @@ Methodology notes (hard-won; see notes/PERF.md):
 - The result state is validated against the independent pandas oracle
   AFTER timing; a wrong answer aborts the bench rather than scoring.
 
+Wall-clock discipline (the round-2 lesson: BENCH_r02 was rc:124 with
+no parsed line because setup work blew the driver's timeout):
+
+- every table is generated ONCE; the scan batches and the pandas oracle
+  frames are built from the *same* arrays;
+- host->device transfer is dtype-narrowed (TPC-H values mostly fit
+  int8/int16/int32); columns are widened back to their canonical
+  physical dtype on-device, so the ~100-200 MB/s tunnel moves ~4x
+  fewer bytes;
+- the Q3/shuffle extras run only while wall-clock budget remains
+  (PRESTO_TPU_BENCH_BUDGET seconds, default 150), with a SIGALRM
+  backstop — the primary validated Q1 line prints no matter what the
+  extras do.
+
 vs_baseline: BASELINE.json sets the north star at >=10x rows/sec vs the
 Java operators on equal-cost CPUs. The Java engine's Q1 aggregation
 throughput on a CPU node cost-equivalent to one v5e chip (~24 vCPU) is
@@ -36,10 +50,19 @@ vs_baseline >= 10 means the north star is met.
 from __future__ import annotations
 
 import json
+import os
+import signal
 import sys
 import time
 
 BASELINE_ROWS_PER_SEC = 1.9e8  # equal-cost CPU estimate (see docstring)
+
+T0 = time.monotonic()
+BUDGET = float(os.environ.get("PRESTO_TPU_BENCH_BUDGET", "150"))
+
+
+def _remaining() -> float:
+    return BUDGET - (time.monotonic() - T0)
 
 
 def _chunk() -> int:
@@ -52,7 +75,7 @@ def _chunk() -> int:
 
 def _cap(n: int) -> int:
     c = _chunk()
-    return (n + c - 1) // c * c
+    return max(1, (n + c - 1) // c) * c
 
 
 def _time_dispatches(fn, *args, iters: int = 5):
@@ -67,26 +90,78 @@ def _time_dispatches(fn, *args, iters: int = 5):
     return (time.perf_counter() - t0) / iters, out
 
 
-def bench_q1(conn, dev):
+# ---------------------------------------------------------------------------
+# Narrow-transfer device loading: pad host arrays, ship the narrowest
+# integer dtype that holds the values, widen on-device in one jit.
+# ---------------------------------------------------------------------------
+
+
+def _narrowest(arr):
+    import numpy as np
+
+    if arr.dtype.kind not in "iu" or arr.dtype.itemsize == 1 or arr.ndim != 1:
+        return arr
+    lo, hi = int(arr.min()), int(arr.max())
+    for dt in (np.int8, np.int16, np.int32):
+        info = np.iinfo(dt)
+        if info.min <= lo and hi <= info.max:
+            return arr.astype(dt)
+    return arr
+
+
+def put_table(table, arrays, dev):
+    """Host columnar arrays -> canonical device Batch, minimal transfer.
+
+    Values cross the tunnel in the narrowest integer dtype that holds
+    them; a single on-device jit widens to the canonical physical dtype
+    and materializes the validity/live masks (all-true for generated
+    TPC-H data — never transferred). 2-D BYTES columns ship as-is.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from presto_tpu.batch import Batch, Column
+    from presto_tpu.connectors.tpch import schema as S
+
+    types = S.TABLES[table]
+    dicts = S.table_dicts(table)
+    n = len(next(iter(arrays.values())))
+    cap = _cap(n)
+    wire = {}
+    for c, a in arrays.items():
+        a = np.asarray(a)
+        padded = np.zeros((cap,) + a.shape[1:], dtype=a.dtype)
+        padded[:n] = a
+        wire[c] = jax.device_put(_narrowest(padded), dev)
+    jax.block_until_ready(wire)
+
+    def widen(wire):
+        live = jnp.arange(cap, dtype=jnp.int32) < n
+        cols = {
+            c: Column(w.astype(types[c].jnp_dtype), live, types[c], dicts.get(c))
+            for c, w in wire.items()
+        }
+        return Batch(cols, live)
+
+    batch = jax.jit(widen)(wire)
+    jax.block_until_ready(batch)
+    return batch, n
+
+
+def bench_q1(li_batch, n_rows, li_df):
     import jax
     import numpy as np
 
-    from presto_tpu.workloads import Q1_COLS, q1_fused_step
-
-    (split,) = conn.splits("lineitem")
-    batch = conn.scan(split, Q1_COLS, _cap(split.row_hint + _chunk()))
-    batch = jax.device_put(batch, dev)
-    jax.block_until_ready(batch)
-    n_rows = int(np.asarray(batch.live).sum())
+    from presto_tpu.workloads import q1_fused_step
 
     step = jax.jit(q1_fused_step)
-    secs, state = _time_dispatches(step, batch)
+    secs, state = _time_dispatches(step, li_batch)
 
     # -- validate vs the independent pandas oracle ------------------------
     from presto_tpu.oracle.tpch_oracle import q1 as oracle_q1
 
-    li = conn.table_pandas("lineitem", Q1_COLS)
-    want = oracle_q1({"lineitem": li})
+    want = oracle_q1({"lineitem": li_df})
     got = {k: np.asarray(v) for k, v in state.items()}
     present = got["present"]
     assert int(present.sum()) == len(want), "Q1 group count mismatch"
@@ -113,7 +188,7 @@ def bench_q1(conn, dev):
     return n_rows / secs
 
 
-def bench_q3_join(conn, dev):
+def bench_q3_join(li_batch, n_li, orders_batch, li_df, o_df):
     """Join-probe throughput: filtered orders build, lineitem probe.
 
     The Q3 core join (o_orderkey unique build -> l_orderkey probe) with
@@ -126,31 +201,14 @@ def bench_q3_join(conn, dev):
     from presto_tpu.ops.join import build_lookup, probe_unique
 
     cutoff = 9204  # date '1995-03-15' as days since epoch
-
-    (osplit,) = conn.splits("orders")
-    orders = jax.device_put(
-        conn.scan(osplit, ["o_orderkey", "o_orderdate"], _cap(osplit.row_hint + _chunk())),
-        dev,
-    )
-    (lsplit,) = conn.splits("lineitem")
-    li = jax.device_put(
-        conn.scan(
-            lsplit,
-            ["l_orderkey", "l_shipdate", "l_extendedprice", "l_discount"],
-            _cap(lsplit.row_hint + _chunk()),
-        ),
-        dev,
-    )
-    jax.block_until_ready((orders, li))
-    n_probe = int(np.asarray(li.live).sum())
-    build_cap = orders.capacity
+    build_cap = orders_batch.capacity
 
     @jax.jit
     def build(ob):
         live = ob.live & (ob["o_orderdate"].data < cutoff)
         return build_lookup(ob["o_orderkey"].data, live, build_cap)
 
-    side = build(orders)
+    side = build(orders_batch)
     jax.block_until_ready(side)
 
     @jax.jit
@@ -158,18 +216,14 @@ def bench_q3_join(conn, dev):
         live = lb.live & (lb["l_shipdate"].data > cutoff)
         res = probe_unique(side, lb["l_orderkey"].data, live)
         rev = lb["l_extendedprice"].data * (100 - lb["l_discount"].data)
-        matched_rev = jnp.where(res.matched, rev, 0).sum()
-        return res.matched.sum(), matched_rev
+        matched_rev = jnp.where(res.matched & live, rev, 0).sum()
+        return (res.matched & live).sum(), matched_rev
 
-    secs, (n_matched, rev) = _time_dispatches(probe_step, side, li)
+    secs, (n_matched, rev) = _time_dispatches(probe_step, side, li_batch)
 
-    # -- validate vs pandas ----------------------------------------------
-    odf = conn.table_pandas("orders", ["o_orderkey", "o_orderdate"])
-    ldf = conn.table_pandas(
-        "lineitem", ["l_orderkey", "l_shipdate", "l_extendedprice", "l_discount"]
-    )
-    odf = odf[odf.o_orderdate < np.datetime64("1995-03-15")]
-    ldf = ldf[ldf.l_shipdate > np.datetime64("1995-03-15")]
+    # -- validate vs pandas (frames shared with generation) ---------------
+    odf = o_df[o_df.o_orderdate < np.datetime64("1995-03-15")]
+    ldf = li_df[li_df.l_shipdate > np.datetime64("1995-03-15")]
     j = ldf.merge(odf, left_on="l_orderkey", right_on="o_orderkey")
     assert int(n_matched) == len(j), (
         f"Q3 bench validation failed: {int(n_matched)} matches vs oracle {len(j)}"
@@ -179,7 +233,7 @@ def bench_q3_join(conn, dev):
         float(rev) / 10_000.0, want_rev, rtol=1e-6,
         err_msg="Q3 bench validation failed: revenue",
     )
-    return n_probe / secs
+    return n_li / secs
 
 
 def bench_shuffle(devices):
@@ -188,10 +242,9 @@ def bench_shuffle(devices):
     import jax.numpy as jnp
     import numpy as np
 
+    from presto_tpu.batch import Batch, Column
     from presto_tpu.parallel.exchange import make_shuffle_step
     from presto_tpu.parallel.mesh import make_mesh, row_sharding
-
-    from presto_tpu.batch import Batch, Column
     from presto_tpu.types import BIGINT
 
     n = len(devices)
@@ -215,9 +268,11 @@ def bench_shuffle(devices):
     return moved_bytes / secs / 1e9
 
 
-def main() -> None:
-    import os
+class _ExtrasTimeout(Exception):
+    pass
 
+
+def main() -> None:
     import jax
 
     sf = float(sys.argv[1]) if len(sys.argv) > 1 else 1.0
@@ -236,22 +291,62 @@ def main() -> None:
 
     conn = TpchConnector(sf=sf, units_per_split=1 << 26)
 
-    q1_rows = bench_q1(conn, dev)
-    extra = {"tpch_q3_join_probe_rows_per_sec": round(bench_q3_join(conn, dev))}
-    if len(devices) > 1:
-        extra["ici_shuffle_gbps"] = round(bench_shuffle(devices), 2)
+    # ---- generate each table ONCE; share arrays with the oracle --------
+    from presto_tpu.workloads import Q1_COLS
 
-    print(
-        json.dumps(
-            {
-                "metric": f"tpch_q1_rows_per_sec_per_chip_sf{sf:g}",
-                "value": round(q1_rows),
-                "unit": "rows/s",
-                "vs_baseline": round(q1_rows / BASELINE_ROWS_PER_SEC, 3),
-                "extra": extra,
-            }
-        )
-    )
+    li_cols = list(Q1_COLS) + ["l_orderkey"]  # Q1 cols + the Q3 probe key
+    li_arrays = conn.table_numpy("lineitem", li_cols)
+    o_arrays = conn.table_numpy("orders", ["o_orderkey", "o_orderdate"])
+    li_df = conn.table_pandas("lineitem", arrays=li_arrays)
+    o_df = conn.table_pandas("orders", arrays=o_arrays)
+
+    li_batch, n_li = put_table("lineitem", li_arrays, dev)
+    q1_rows = bench_q1(li_batch, n_li, li_df)
+    result = {
+        "metric": f"tpch_q1_rows_per_sec_per_chip_sf{sf:g}",
+        "value": round(q1_rows),
+        "unit": "rows/s",
+        "vs_baseline": round(q1_rows / BASELINE_ROWS_PER_SEC, 3),
+    }
+
+    # ---- extras: only while budget remains; SIGALRM backstop -----------
+    def _on_alarm(signum, frame):
+        raise _ExtrasTimeout()
+
+    # Nothing below may prevent the validated primary line from printing:
+    # any extras failure (timeout, OOM, validation assert) is recorded in
+    # extra["note"] instead of propagating.
+    extra = {}
+    try:
+        rem = _remaining()
+        if rem > 45:  # Q3 adds two jit compiles + an orders transfer
+            old = signal.signal(signal.SIGALRM, _on_alarm)
+            signal.alarm(max(5, int(rem)))
+            try:
+                orders_batch, _ = put_table("orders", o_arrays, dev)
+                extra["tpch_q3_join_probe_rows_per_sec"] = round(
+                    bench_q3_join(li_batch, n_li, orders_batch, li_df, o_df)
+                )
+                if len(devices) > 1:
+                    if _remaining() > 20:
+                        extra["ici_shuffle_gbps"] = round(bench_shuffle(devices), 2)
+                    else:
+                        extra["note"] = "shuffle skipped: budget exhausted"
+            except _ExtrasTimeout:
+                extra["note"] = "extras skipped: wall-clock budget exhausted"
+            except Exception as e:  # noqa: BLE001 — primary line must print
+                extra["note"] = f"extras failed: {type(e).__name__}: {e}"[:300]
+            finally:
+                signal.alarm(0)
+                signal.signal(signal.SIGALRM, old)
+        else:
+            extra["note"] = "extras skipped: wall-clock budget exhausted"
+    except Exception as e:  # noqa: BLE001 — e.g. alarm raced into finally
+        extra.setdefault("note", f"extras failed: {type(e).__name__}")
+    if extra:
+        result["extra"] = extra
+
+    print(json.dumps(result), flush=True)
 
 
 if __name__ == "__main__":

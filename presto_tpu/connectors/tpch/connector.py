@@ -91,13 +91,26 @@ class TpchConnector:
             c: np.concatenate([p[c] for p in parts]) for c in parts[0]
         }
 
-    def table_pandas(self, table: str, columns: Sequence[str] | None = None):
-        """Decoded logical-value DataFrame — the oracle's input."""
+    def table_pandas(
+        self,
+        table: str,
+        columns: Sequence[str] | None = None,
+        arrays: Mapping[str, np.ndarray] | None = None,
+    ):
+        """Decoded logical-value DataFrame — the oracle's input.
+
+        ``arrays``: pre-generated columnar arrays for ``table`` (e.g. the
+        same ones fed to ``Batch.from_numpy``); when given, generation is
+        skipped entirely — the scan input and the oracle input are then
+        *literally* the same data, and a full-SF bench run pays for
+        generation once instead of twice.
+        """
         import pandas as pd
 
         from presto_tpu.batch import decode_values
 
-        arrays = self.table_numpy(table, columns)
+        if arrays is None:
+            arrays = self.table_numpy(table, columns)
         types = S.TABLES[table]
         dicts = S.table_dicts(table)
         return pd.DataFrame(
