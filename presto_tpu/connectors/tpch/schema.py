@@ -255,6 +255,16 @@ def column_stats(table: str, column: str, sf: float) -> ColumnStats:
         ("lineitem", "l_partkey"): ColumnStats(200_000 * sf, 1, 200_000 * sf),
         ("lineitem", "l_suppkey"): ColumnStats(10_000 * sf, 1, 10_000 * sf),
         ("lineitem", "l_quantity"): ColumnStats(50, 1, 50),
+        # money columns: bounds from the generator formulas
+        # (retail_price_cents in [90000, 209900]; qty in [1, 50];
+        # totalprice <= 7 lines * max charge; balances in cents)
+        ("lineitem", "l_extendedprice"): ColumnStats(950_000, 900.0, 104_950.0),
+        ("orders", "o_totalprice"): ColumnStats(1_500_000 * sf, 810.0, 800_000.0),
+        ("part", "p_retailprice"): ColumnStats(20_000, 900.0, 2_099.0),
+        ("partsupp", "ps_supplycost"): ColumnStats(100_000, 1.0, 1_000.01),
+        ("customer", "c_acctbal"): ColumnStats(1_000_000, -999.99, 10_000.0),
+        ("supplier", "s_acctbal"): ColumnStats(1_000_000, -999.99, 10_000.0),
+        ("partsupp", "ps_availqty"): ColumnStats(9_999, 1, 9_999),
         ("lineitem", "l_discount"): ColumnStats(11, 0.0, 0.10),
         ("lineitem", "l_tax"): ColumnStats(9, 0.0, 0.08),
         ("lineitem", "l_shipdate"): ColumnStats(2526, STARTDATE, ENDDATE),

@@ -192,13 +192,19 @@ class DistributedExecutor:
 
     # ---- aggregation -----------------------------------------------------
     def _exec_aggregate(self, node: N.Aggregate, scalars) -> DistBatch:
+        from presto_tpu.ops.groupby import ValueBitsOverflow
+        from presto_tpu.plan.bounds import agg_value_bits
+
         d = self._exec(node.child, scalars)
         keys = [(n, bind_scalars(e, scalars)) for n, e in node.keys]
         pax = [(n, bind_scalars(e, scalars)) for n, e in node.passengers]
+        # stats-derived |value| bounds (see plan/bounds.py); violated
+        # bounds trip value_overflow and retry on the 63-bit path
+        bits = agg_value_bits(node, self.catalog)
         aggs = [
             AggSpec(a.kind, bind_scalars(a.input, scalars) if a.input is not None else None,
-                    a.name, a.dtype)
-            for a in node.aggs
+                    a.name, a.dtype, value_bits=b)
+            for a, b in zip(node.aggs, bits)
         ]
         if not keys and not pax:
             # global agg: jnp reductions over the sharded rows — XLA
@@ -213,8 +219,13 @@ class DistributedExecutor:
         if isinstance(strategy, DirectStrategy):
             # small dense group domain: per-shard segment_sum + XLA
             # auto-reduction (the psum path of the Q1 fragment)
-            op = HashAggregationOperator(keys, aggs, strategy)
-            out = Pipeline(BatchSource([d.batch]), [op]).run()
+            try:
+                op = HashAggregationOperator(keys, aggs, strategy)
+                out = Pipeline(BatchSource([d.batch]), [op]).run()
+            except ValueBitsOverflow:
+                aggs = [AggSpec(a.kind, a.input, a.name, a.dtype) for a in aggs]
+                op = HashAggregationOperator(keys, aggs, strategy)
+                out = Pipeline(BatchSource([d.batch]), [op]).run()
             return DistBatch(out[0], sharded=False)
         if not d.sharded:
             for _ in range(MAX_RETRIES):

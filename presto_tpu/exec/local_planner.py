@@ -173,13 +173,19 @@ class LocalExecutor:
 
     # ---- aggregation ----------------------------------------------------
     def _exec_aggregate(self, node: N.Aggregate, scalars):
+        from presto_tpu.ops.groupby import ValueBitsOverflow
+        from presto_tpu.plan.bounds import agg_value_bits
+
         child = self._exec(node.child, scalars)
         keys = [(n, bind_scalars(e, scalars)) for n, e in node.keys]
         pax = [(n, bind_scalars(e, scalars)) for n, e in node.passengers]
+        # stats-derived |value| bounds cut the fused segment-sum's lane
+        # count; a violated bound trips value_overflow and retries at 63
+        bits = agg_value_bits(node, self.catalog)
         aggs = [
             AggSpec(a.kind, bind_scalars(a.input, scalars) if a.input is not None else None,
-                    a.name, a.dtype)
-            for a in node.aggs
+                    a.name, a.dtype, value_bits=b)
+            for a, b in zip(node.aggs, bits)
         ]
         if not keys and not pax:
             from presto_tpu.exec.operators import GlobalAggregationOperator
@@ -191,6 +197,10 @@ class LocalExecutor:
             op = HashAggregationOperator(keys, aggs, strategy, passengers=pax)
             try:
                 return Pipeline(BatchSource(child), [op]).run()
+            except ValueBitsOverflow:
+                aggs = [
+                    AggSpec(a.kind, a.input, a.name, a.dtype) for a in aggs
+                ]
             except CapacityOverflow:
                 if not isinstance(strategy, SortStrategy):
                     raise

@@ -36,6 +36,8 @@ import numpy as np
 from presto_tpu.batch import Batch, Column, Dictionary
 from presto_tpu.expr import Expr, Val, evaluate, evaluate_predicate
 from presto_tpu.ops.groupby import (
+    ValueBitsOverflow,
+    fused_small_sums,
     gather_padded,
     group_ids_direct,
     group_ids_sort,
@@ -244,22 +246,66 @@ class HashAggregationOperator(Operator):
     # -- direct-addressed path -------------------------------------------
 
     def _direct_update(self, state, batch: Batch):
+        """One-pass direct-addressed update.
+
+        All integer sums, every per-aggregate count, and group presence
+        ride a single ``fused_small_sums`` einsum (the MXU one-hot
+        segment-sum — one read of the data instead of G x lanes masked
+        reductions). Only min/max and float sums take the per-aggregate
+        masked-reduction path.
+        """
         st: DirectStrategy = self.strategy
         keys = [v.data for v in self._eval_keys(batch)]
-        gids, present = group_ids_direct(
+        gids, _ = group_ids_direct(
             keys, st.mins, st.strides, batch.live, st.num_groups
         )
         inputs = self._eval_inputs(batch)
+        kinds = [self._agg_kind(a) for a in self.aggs]
+        # count-kind partials sum all-ones columns: their sum IS their
+        # count — no value lanes needed for them.
+        is_count = [
+            a.kind in ("count", "count_star") and self.phase != "final"
+            for a in self.aggs
+        ]
+        fused = [
+            i
+            for i, (k, c) in enumerate(zip(kinds, is_count))
+            if k == "sum" and not c
+            and not jnp.issubdtype(inputs[i][0].dtype, jnp.floating)
+        ]
+        # merge stages aggregate accumulated sums, not per-row values:
+        # the per-row bound only applies before the final phase
+        bits = [
+            self.aggs[i].value_bits if self.phase != "final" else 63
+            for i in fused
+        ]
+        rest = [i for i in range(len(self.aggs)) if i not in fused and not is_count[i]]
+        unfused = [i for i in range(len(self.aggs)) if i not in fused]
+        sums, fcounts, extras, oflow = fused_small_sums(
+            [inputs[i][0] for i in fused],
+            bits,
+            [inputs[i][1] for i in fused],
+            gids,
+            st.num_groups,
+            extra_count_masks=[batch.live] + [inputs[i][1] for i in unfused],
+        )
+        counts: list = [None] * len(self.aggs)
+        for j, i in enumerate(fused):
+            counts[i] = fcounts[j]
+        for j, i in enumerate(unfused):
+            counts[i] = extras[1 + j]
         new = dict(state)
-        new["present"] = state["present"] | present
-        for a, (vals, contrib) in zip(self.aggs, inputs):
-            kind = self._agg_kind(a)
-            # merge stages aggregate accumulated sums, not per-row values:
-            # the per-row bound only applies in the partial phase
-            bits = a.value_bits if self.phase != "final" else 63
-            part = segment_agg(
-                vals, contrib, gids, st.num_groups, kind, value_bits=bits
-            )
+        new["present"] = state["present"] | (extras[0] > 0)
+        new["value_overflow"] = state["value_overflow"] | oflow
+        for j, i in enumerate(fused):
+            new[self.aggs[i].name] = state[self.aggs[i].name] + sums[j]
+        for i in range(len(self.aggs)):
+            if is_count[i]:
+                new[self.aggs[i].name] = state[self.aggs[i].name] + counts[i]
+        for i in rest:
+            a, kind = self.aggs[i], kinds[i]
+            vals, contrib = inputs[i]
+            part = segment_agg(vals, contrib, gids, st.num_groups, kind)
             prev = state[a.name]
             if kind == "sum":
                 new[a.name] = prev + part
@@ -267,14 +313,17 @@ class HashAggregationOperator(Operator):
                 new[a.name] = jnp.minimum(prev, part)
             else:
                 new[a.name] = jnp.maximum(prev, part)
-            ccount = segment_agg(vals, contrib, gids, st.num_groups, "count")
-            new[a.name + "$n"] = state[a.name + "$n"] + ccount
+        for a, cnt in zip(self.aggs, counts):
+            new[a.name + "$n"] = state[a.name + "$n"] + cnt
         return new
 
     def _direct_init(self):
         st: DirectStrategy = self.strategy
         g = st.num_groups
-        state: dict[str, Any] = {"present": jnp.zeros(g, jnp.bool_)}
+        state: dict[str, Any] = {
+            "present": jnp.zeros(g, jnp.bool_),
+            "value_overflow": jnp.zeros((), jnp.bool_),
+        }
         for a in self.aggs:
             kind = self._agg_kind(a)
             dt = _phys_dtype(a)
@@ -390,6 +439,12 @@ class HashAggregationOperator(Operator):
         st = self.state
         if isinstance(self.strategy, SortStrategy) and bool(st["overflow"]):
             raise CapacityOverflow("HashAggregation", self.strategy.max_groups)
+        if isinstance(self.strategy, DirectStrategy) and bool(st["value_overflow"]):
+            raise ValueBitsOverflow(
+                "a declared AggSpec.value_bits bound was exceeded at "
+                f"runtime in {[a.name for a in self.aggs]} — the planner "
+                "retries with the unbounded 63-bit path"
+            )
         cols: dict[str, Column] = {}
         if isinstance(self.strategy, DirectStrategy):
             g = self.strategy.num_groups

@@ -24,7 +24,7 @@ from presto_tpu.exec.operators import (
 )
 from presto_tpu.exec.pipeline import Pipeline, ScanSource
 from presto_tpu.expr import Call, col, evaluate, evaluate_predicate, lit
-from presto_tpu.ops.groupby import group_ids_direct, segment_agg
+from presto_tpu.ops.groupby import fused_small_sums, group_ids_direct
 from presto_tpu.types import BIGINT, BOOLEAN, DATE, decimal, varchar
 
 dec2 = decimal(12, 2)
@@ -101,11 +101,15 @@ def q1_fused_step(batch: Batch):
     """One fully-fused Q1 partial-aggregation step over a batch.
 
     Returns a dict of [6]-arrays: sums per (returnflag x linestatus)
-    group plus the group-present mask and row count.
+    group plus the group-present mask and row count. All four sums, the
+    count, and presence ride ONE ``fused_small_sums`` einsum — a single
+    pass over the data (the MXU one-hot segment-sum), replacing the
+    G x lanes masked-reduction passes of round 2. ``value_overflow``
+    guards the declared Q1_BITS bounds at runtime.
     """
     pred, disc_price, charge = q1_exprs()
     live = batch.live & evaluate_predicate(pred, batch)
-    gids, present = group_ids_direct(
+    gids, _ = group_ids_direct(
         [batch["l_returnflag"].data, batch["l_linestatus"].data],
         (0, 0), (2, 1), live, Q1_GROUPS,
     )
@@ -113,20 +117,26 @@ def q1_fused_step(batch: Batch):
     ep = batch["l_extendedprice"].data
     dp = evaluate(disc_price, batch).data
     ch = evaluate(charge, batch).data
-    seg = partial(segment_agg, gids=gids, max_groups=Q1_GROUPS, kind="sum")
-    return {
-        "present": present,
-        "sum_qty": seg(qty, live, value_bits=Q1_BITS["sum_qty"]),
-        "sum_base_price": seg(ep, live, value_bits=Q1_BITS["sum_base_price"]),
-        "sum_disc_price": seg(dp, live, value_bits=Q1_BITS["sum_disc_price"]),
-        "sum_charge": seg(ch, live, value_bits=Q1_BITS["sum_charge"]),
-        "count_order": segment_agg(qty, live, gids, Q1_GROUPS, "count"),
-    }
+    names = ["sum_qty", "sum_base_price", "sum_disc_price", "sum_charge"]
+    sums, counts, _, oflow = fused_small_sums(
+        [qty, ep, dp, ch],
+        [Q1_BITS[n] for n in names],
+        [live] * 4,
+        gids,
+        Q1_GROUPS,
+    )
+    out = dict(zip(names, sums))
+    out["present"] = counts[0] > 0
+    out["count_order"] = counts[0]
+    out["value_overflow"] = oflow
+    return out
 
 
 def combine_q1_states(a: dict, b: dict) -> dict:
-    out = {k: a[k] + b[k] for k in a if k != "present"}
-    out["present"] = a["present"] | b["present"]
+    bool_keys = ("present", "value_overflow")
+    out = {k: a[k] + b[k] for k in a if k not in bool_keys}
+    for k in bool_keys:
+        out[k] = a[k] | b[k]
     return out
 
 

@@ -237,3 +237,75 @@ def test_pack_key_columns():
     b = jnp.asarray(np.array([0, 1, 0], dtype=np.int64))
     packed = pack_key_columns([a, b], [8, 1])
     np.testing.assert_array_equal(np.asarray(packed), [2, 5, 6])
+
+
+# ---------------------------------------------------------------------------
+# fused one-pass segment sums (the MXU one-hot matmul path)
+# ---------------------------------------------------------------------------
+
+
+def test_fused_small_sums_vs_numpy(rng):
+    from presto_tpu.ops.groupby import fused_small_sums
+
+    n, G = 70_001, 7
+    gids = rng.integers(0, G + 1, n)  # includes the trash segment
+    v1 = rng.integers(-5000, 5000, n)
+    v2 = rng.integers(0, 1 << 24, n)
+    v3 = rng.integers(-(1 << 31) + 1, 1 << 31, n)
+    c1 = rng.random(n) < 0.9
+    c2 = np.ones(n, bool)
+    c3 = rng.random(n) < 0.5
+    live = gids < G
+    sums, counts, extras, of = fused_small_sums(
+        [jnp.asarray(v1), jnp.asarray(v2), jnp.asarray(v3)],
+        [13, 24, 31],
+        [jnp.asarray(c1), jnp.asarray(c2), jnp.asarray(c3)],
+        jnp.asarray(gids), G, extra_count_masks=(jnp.asarray(live),),
+    )
+    for i, (v, c) in enumerate([(v1, c1), (v2, c2), (v3, c3)]):
+        want_s = np.array([v[(gids == g) & c].sum() for g in range(G)])
+        want_n = np.array([((gids == g) & c).sum() for g in range(G)])
+        np.testing.assert_array_equal(np.asarray(sums[i]), want_s)
+        np.testing.assert_array_equal(np.asarray(counts[i]), want_n)
+    np.testing.assert_array_equal(
+        np.asarray(extras[0]), np.array([(gids == g).sum() for g in range(G)])
+    )
+    assert not bool(of)
+
+
+def test_fused_small_sums_overflow_guard(rng):
+    """A contributing |value| above the declared bound trips the flag;
+    non-contributing rows never do."""
+    from presto_tpu.ops.groupby import fused_small_sums
+
+    n, G = 1024, 4
+    gids = jnp.asarray(rng.integers(0, G, n))
+    v = np.full(n, 100, np.int64)
+    contrib = np.ones(n, bool)
+    v[5] = 1 << 20  # exceeds 13 bits
+    *_, of = fused_small_sums(
+        [jnp.asarray(v)], [13], [jnp.asarray(contrib)], gids, G
+    )
+    assert bool(of)
+    contrib[5] = False  # masked out -> no trip
+    *_, of2 = fused_small_sums(
+        [jnp.asarray(v)], [13], [jnp.asarray(contrib)], gids, G
+    )
+    assert not bool(of2)
+
+
+def test_fused_small_sums_multichunk(rng, monkeypatch):
+    import presto_tpu.ops.groupby as gb
+
+    monkeypatch.setattr(gb, "_MM_CHUNK", 1 << 10)
+    n, G = 5000, 3
+    gids = rng.integers(0, G + 1, n)
+    v = rng.integers(-(1 << 30), 1 << 30, n)
+    c = rng.random(n) < 0.7
+    sums, counts, _, _ = gb.fused_small_sums(
+        [jnp.asarray(v)], [31], [jnp.asarray(c)], jnp.asarray(gids), G
+    )
+    np.testing.assert_array_equal(
+        np.asarray(sums[0]),
+        np.array([v[(gids == g) & c].sum() for g in range(G)]),
+    )
