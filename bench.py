@@ -269,6 +269,51 @@ def bench_shuffle(devices):
     return moved_bytes / secs / 1e9
 
 
+def bench_q1_resident(sf_big: float, dev):
+    """Q1 on a device-RESIDENT SF<sf_big> batch: amortizes the per-
+    dispatch latency floor (~15 ms over the tunnel — notes/PERF.md §2)
+    that caps the SF1 number at ~4e8 rows/s regardless of kernel speed.
+    Same fused step, same validation rigor: checked against an
+    independent host-side numpy recomputation (exact int64, mirroring
+    the documented decimal rounding semantics of expr.py).
+    """
+    import jax
+    import numpy as np
+
+    from presto_tpu.connectors.tpch import TpchConnector
+    from presto_tpu.workloads import Q1_COLS, q1_fused_step
+
+    conn = TpchConnector(sf=sf_big, units_per_split=1 << 28)
+    arrays = conn.table_numpy("lineitem", Q1_COLS)
+    batch, n = put_table("lineitem", arrays, dev)
+    step = jax.jit(q1_fused_step)
+    secs, state = _time_dispatches(step, batch)
+    got = {k: np.asarray(v) for k, v in state.items()}
+    assert not bool(got["value_overflow"])
+
+    # independent numpy recomputation (int64-exact, no pandas)
+    m = arrays["l_shipdate"] <= 10471  # date '1998-09-02'
+    gid = (arrays["l_returnflag"].astype(np.int64) * 2
+           + arrays["l_linestatus"].astype(np.int64))[m]
+    qty = arrays["l_quantity"][m]
+    ep = arrays["l_extendedprice"][m]
+    dp = ep * (100 - arrays["l_discount"][m])  # scale 4, exact
+    prod = dp * (100 + arrays["l_tax"][m])  # scale 6
+    ch = (np.abs(prod) + 50) // 100  # round half away; all values >= 0
+
+    def seg(v):
+        out = np.zeros(6, np.int64)
+        np.add.at(out, gid, v)
+        return out
+
+    np.testing.assert_array_equal(got["sum_qty"], seg(qty))
+    np.testing.assert_array_equal(got["sum_base_price"], seg(ep))
+    np.testing.assert_array_equal(got["sum_disc_price"], seg(dp))
+    np.testing.assert_array_equal(got["sum_charge"], seg(ch))
+    np.testing.assert_array_equal(got["count_order"], np.bincount(gid, minlength=6))
+    return n / secs
+
+
 class _ExtrasTimeout(Exception):
     pass
 
@@ -335,6 +380,12 @@ def main() -> None:
                         extra["ici_shuffle_gbps"] = round(bench_shuffle(devices), 2)
                     else:
                         extra["note"] = "shuffle skipped: budget exhausted"
+                if _remaining() > 60:
+                    # device-resident big-batch Q1: the dispatch-floor-
+                    # amortized per-chip number (validated independently)
+                    extra["tpch_q1_rows_per_sec_per_chip_sf10_resident"] = round(
+                        bench_q1_resident(10.0, dev)
+                    )
             except _ExtrasTimeout:
                 extra["note"] = "extras skipped: wall-clock budget exhausted"
             except Exception as e:  # noqa: BLE001 — primary line must print

@@ -223,7 +223,9 @@ class DistributedExecutor:
                 op = HashAggregationOperator(keys, aggs, strategy)
                 out = Pipeline(BatchSource([d.batch]), [op]).run()
             except ValueBitsOverflow:
-                aggs = [AggSpec(a.kind, a.input, a.name, a.dtype) for a in aggs]
+                import dataclasses
+
+                aggs = [dataclasses.replace(a, value_bits=63) for a in aggs]
                 op = HashAggregationOperator(keys, aggs, strategy)
                 out = Pipeline(BatchSource([d.batch]), [op]).run()
             return DistBatch(out[0], sharded=False)

@@ -198,9 +198,9 @@ class LocalExecutor:
             try:
                 return Pipeline(BatchSource(child), [op]).run()
             except ValueBitsOverflow:
-                aggs = [
-                    AggSpec(a.kind, a.input, a.name, a.dtype) for a in aggs
-                ]
+                import dataclasses
+
+                aggs = [dataclasses.replace(a, value_bits=63) for a in aggs]
             except CapacityOverflow:
                 if not isinstance(strategy, SortStrategy):
                     raise

@@ -206,8 +206,8 @@ def _small_agg(values, contrib, gids, max_groups: int, kind: str, value_bits: in
                 axis=1,
             )
             return per_chunk.sum(axis=0)
-        out = _small_sum_int(values, contrib, gids, max_groups, value_bits)
-        return out.astype(values.dtype) if values.dtype != jnp.int64 else out
+        # int64 always: running sums outgrow narrow input dtypes
+        return _small_sum_int(values, contrib, gids, max_groups, value_bits)
     # min/max: plain masked reductions per group (no overflow concern).
     ident = _identity(kind, values.dtype)
     v = _chunked(jnp.where(contrib, values, ident), cap, ident)
@@ -302,7 +302,9 @@ def fused_small_sums(values, bits_list, contribs, gids, max_groups: int,
         s = jnp.zeros(max_groups, jnp.int64)
         for k in range(nlanes):
             s = s + (tot[:, start + k] << (_MM_LANE_BITS * k))
-        sums.append(s if v.dtype == jnp.int64 else s.astype(v.dtype))
+        # always int64: a running sum of narrow ints overflows its input
+        # dtype long before int64 (SQL types sum(int) as bigint)
+        sums.append(s)
     base = len(lane_cols)
     counts = [tot[:, base + slot[i]] for i in range(len(contribs))]
     extra = [
@@ -326,9 +328,11 @@ def segment_agg(
     value_bits: static bound on bit-width of |values| (callers with
     typed columns can pass a tighter bound to cut lane passes; 63 is
     always safe for int64).
-    Returns array [max_groups] (trash segment sliced off). Groups with
-    no contributing rows yield the kind's identity — pair with a count
-    to rebuild SQL NULL semantics.
+    Returns array [max_groups] (trash segment sliced off). Integer sums
+    come back int64 regardless of input dtype (running sums outgrow
+    narrow inputs; SQL types sum(int) as bigint). Groups with no
+    contributing rows yield the kind's identity — pair with a count to
+    rebuild SQL NULL semantics.
     """
     if max_groups <= SMALL_GROUP_LIMIT:
         return _small_agg(values, contrib, gids, max_groups, kind, value_bits)
@@ -340,6 +344,8 @@ def segment_agg(
         )[:max_groups]
     if kind == "sum":
         vals = jnp.where(contrib, values, _identity("sum", values.dtype))
+        if not jnp.issubdtype(values.dtype, jnp.floating):
+            vals = vals.astype(jnp.int64)  # running sums outgrow int32
         return jax.ops.segment_sum(vals, g, num_segments=nseg)[:max_groups]
     if kind == "min":
         vals = jnp.where(contrib, values, _identity("min", values.dtype))

@@ -7,14 +7,13 @@ segment-sum's lane count, with a runtime overflow guard + 63-bit retry
 making wrong stats harmless.
 """
 
-import numpy as np
 import pytest
 
 from presto_tpu.connectors.tpch import TpchConnector
 from presto_tpu.plan.bounds import agg_value_bits, expr_interval, node_intervals
 from presto_tpu.runtime.session import Session
 from presto_tpu.expr import Call, col, lit
-from presto_tpu.types import BIGINT, BOOLEAN, DATE, decimal
+from presto_tpu.types import BIGINT, BOOLEAN, decimal
 
 
 dec2 = decimal(12, 2)

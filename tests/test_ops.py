@@ -309,3 +309,25 @@ def test_fused_small_sums_multichunk(rng, monkeypatch):
         np.asarray(sums[0]),
         np.array([v[(gids == g) & c].sum() for g in range(G)]),
     )
+
+
+def test_integer_sum_never_wraps_input_dtype(rng):
+    """sum(int32 column) must accumulate in int64 (SQL types sum(int)
+    as bigint): a group whose sum exceeds 2^31 must not wrap."""
+    from presto_tpu.ops.groupby import fused_small_sums, segment_agg
+
+    n = 300_000
+    v32 = np.full(n, 9_999, np.int32)  # sum ~3e9 > 2^31
+    gids = jnp.zeros(n, jnp.int32)
+    contrib = jnp.ones(n, bool)
+    want = np.int64(9_999) * n
+
+    s = segment_agg(jnp.asarray(v32), contrib, gids, 2, "sum", value_bits=14)
+    assert s.dtype == jnp.int64 and int(s[0]) == want
+    # large-G scatter path
+    s2 = segment_agg(jnp.asarray(v32), contrib, gids, 64, "sum")
+    assert s2.dtype == jnp.int64 and int(s2[0]) == want
+    (s3,), _, _, of = fused_small_sums(
+        [jnp.asarray(v32)], [14], [contrib], gids, 2
+    )
+    assert s3.dtype == jnp.int64 and int(s3[0]) == want and not bool(of)
