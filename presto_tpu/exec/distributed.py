@@ -33,6 +33,7 @@ surfaces as a flag; the host retries the step with doubled capacity.
 
 from __future__ import annotations
 
+import dataclasses
 from dataclasses import dataclass
 from functools import partial
 from typing import Any, Sequence
@@ -223,8 +224,6 @@ class DistributedExecutor:
                 op = HashAggregationOperator(keys, aggs, strategy)
                 out = Pipeline(BatchSource([d.batch]), [op]).run()
             except ValueBitsOverflow:
-                import dataclasses
-
                 aggs = [dataclasses.replace(a, value_bits=63) for a in aggs]
                 op = HashAggregationOperator(keys, aggs, strategy)
                 out = Pipeline(BatchSource([d.batch]), [op]).run()

@@ -37,10 +37,14 @@ from presto_tpu.expr import Expr, evaluate
 from presto_tpu.ops.groupby import gather_padded
 from presto_tpu.ops.join import (
     BuildSide,
+    DenseSide,
+    build_dense,
     build_lookup,
     probe_exists,
+    probe_exists_dense,
     probe_expand,
     probe_unique,
+    probe_unique_dense,
 )
 from presto_tpu.spi import batch_capacity
 
@@ -61,11 +65,23 @@ class JoinBuildOperator(CollectingOperator):
     source (sorted keys + payload batch). The downstream probe operator
     holds a reference — the LookupSourceFactory seam."""
 
-    def __init__(self, key: Expr, capacity: int | None = None):
+    def __init__(
+        self,
+        key: Expr,
+        capacity: int | None = None,
+        dense_domain: tuple[int, int] | None = None,
+    ):
+        """``dense_domain``: optional (key_min, domain) from planner
+        stats — builds a dense direct-address table alongside the sorted
+        keys so unique/semi probes become a single gather (no probe
+        sort). Stats are advisory: a key outside the domain at runtime
+        just discards the dense side and keeps the sorted fallback."""
         super().__init__()
         self.key = key
         self.capacity = capacity
+        self.dense_domain = dense_domain
         self.build_side: BuildSide | None = None
+        self.dense_side: DenseSide | None = None
         self.payload: Batch | None = None
 
     def finish(self) -> list[Batch]:
@@ -74,17 +90,22 @@ class JoinBuildOperator(CollectingOperator):
             raise RuntimeError("empty build side not yet supported")
         batch = concat_batches(self.batches)
         cap = self.capacity or batch_capacity(batch.capacity, minimum=16)
+        dd = self.dense_domain
 
         @jax.jit
         def build(b: Batch):
             v = evaluate(self.key, b)
             live = b.live & v.valid
-            return build_lookup(v.data, live, cap)
+            side = build_lookup(v.data, live, cap)
+            dense = build_dense(v.data, live, dd[0], dd[1]) if dd else None
+            return side, dense
 
-        side = build(batch)
+        side, dense = build(batch)
         if bool(side.overflow):
             raise CapacityOverflow("JoinBuild", cap, int(side.n_rows))
         self.build_side = side
+        if dense is not None and not bool(dense.overflow):
+            self.dense_side = dense
         self.payload = batch
         return []
 
@@ -130,13 +151,18 @@ class LookupJoinOperator(Operator):
         jt, unique = self.join_type, self.unique
         outs = self.build_outputs
         key = self.probe_key
+        # the dense direct-address probe (one gather, no probe sort)
+        # applies whenever the build published a dense side; trace-time
+        # choice, so each compiled step contains exactly one kernel
+        use_dense = self.build.dense_side is not None
 
         if jt in ("semi", "anti"):
 
             @jax.jit
-            def step(side: BuildSide, payload: Batch, batch: Batch) -> Batch:
+            def step(side, payload: Batch, batch: Batch) -> Batch:
                 v = evaluate(key, batch)
-                exists = probe_exists(side, v.data, batch.live & v.valid)
+                probe = probe_exists_dense if use_dense else probe_exists
+                exists = probe(side, v.data, batch.live & v.valid)
                 keep = exists if jt == "semi" else batch.live & ~exists
                 return batch.with_live(batch.live & keep)
 
@@ -146,9 +172,10 @@ class LookupJoinOperator(Operator):
         if unique:
 
             @jax.jit
-            def step(side: BuildSide, payload: Batch, batch: Batch) -> Batch:
+            def step(side, payload: Batch, batch: Batch) -> Batch:
                 v = evaluate(key, batch)
-                res = probe_unique(side, v.data, batch.live & v.valid)
+                probe = probe_unique_dense if use_dense else probe_unique
+                res = probe(side, v.data, batch.live & v.valid)
                 cols = dict(batch.columns)
                 for bo in outs:
                     src = payload[bo.source]
@@ -193,7 +220,12 @@ class LookupJoinOperator(Operator):
         assert self.build.build_side is not None, "build side not finished"
         self._ensure_step()
         if self.unique or self.join_type in ("semi", "anti"):
-            return [self._step(self.build.build_side, self.build.payload, batch)]
+            side = (
+                self.build.dense_side
+                if self.build.dense_side is not None
+                else self.build.build_side
+            )
+            return [self._step(side, self.build.payload, batch)]
         out, overflow = self._step(self.build.build_side, self.build.payload, batch)
         if bool(overflow):
             raise CapacityOverflow("LookupJoin", self.out_capacity)

@@ -19,6 +19,7 @@ the optimizer + operator factories):
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Any, Sequence
 
 import jax
@@ -198,8 +199,6 @@ class LocalExecutor:
             try:
                 return Pipeline(BatchSource(child), [op]).run()
             except ValueBitsOverflow:
-                import dataclasses
-
                 aggs = [dataclasses.replace(a, value_bits=63) for a in aggs]
             except CapacityOverflow:
                 if not isinstance(strategy, SortStrategy):
@@ -248,13 +247,36 @@ class LocalExecutor:
 
         return pack(lkeys), pack(rkeys)
 
+    def _dense_domain(self, node_right, right_keys, right_batches):
+        """(key_min, domain) when connector stats bound a single build
+        key tightly enough for a dense direct-address table — the
+        planner's stats-driven probe-kernel choice (one gather vs a
+        probe-side sort). None falls back to the sorted build."""
+        if len(right_keys) != 1:
+            return None
+        from presto_tpu.plan.bounds import expr_interval, node_intervals
+
+        iv = expr_interval(right_keys[0], node_intervals(node_right, self.catalog))
+        if iv is None:
+            return None
+        domain = iv[1] - iv[0] + 1
+        rows = sum(live_count(b) for b in right_batches)
+        if 0 < domain <= max(1 << 20, 16 * rows):
+            return (iv[0], int(domain))
+        return None
+
     def _exec_join(self, node: N.Join, scalars):
         left = self._exec(node.left, scalars)
         right = self._exec(node.right, scalars)
         lkey, rkey = self._join_key_exprs(
             node.left_keys, node.right_keys, left, right, scalars
         )
-        build = JoinBuildOperator(rkey)
+        dense = (
+            self._dense_domain(node.right, node.right_keys, right)
+            if node.unique
+            else None
+        )
+        build = JoinBuildOperator(rkey, dense_domain=dense)
         Pipeline(BatchSource(right), [build]).run()
         outs = [BuildOutput(n, n) for n in node.output_right]
         if node.unique:
@@ -281,7 +303,8 @@ class LocalExecutor:
         lkey, rkey = self._join_key_exprs(
             node.left_keys, node.right_keys, left, right, scalars
         )
-        build = JoinBuildOperator(rkey)
+        dense = self._dense_domain(node.right, node.right_keys, right)
+        build = JoinBuildOperator(rkey, dense_domain=dense)
         Pipeline(BatchSource(right), [build]).run()
         op = LookupJoinOperator(
             build, lkey, (), "anti" if node.negated else "semi"

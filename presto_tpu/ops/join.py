@@ -122,6 +122,65 @@ def probe_expand(
     return ExpandedProbe(probe_row, build_row, valid, total, total > out_capacity)
 
 
+# ---------------------------------------------------------------------------
+# Dense-domain direct lookup: when connector stats bound the build key
+# domain [key_min, key_min + domain), the "hash table" is a dense
+# row-index array — probe is ONE gather (no probe-side sort at all).
+# The TPU trade: one build-time scatter (build side is the small side)
+# buys gather-only probes; measured on the sorted path, probe cost was
+# dominated by the probe sort + two gathers (notes/PERF.md §5).
+# ---------------------------------------------------------------------------
+
+
+class DenseSide(NamedTuple):
+    """Dense direct-address lookup table over a bounded key domain."""
+
+    table: jnp.ndarray  # [domain] int32: build row idx, sentinel = miss
+    key_min: jnp.ndarray  # 0-d int64
+    sentinel: jnp.ndarray  # 0-d int32 (the build batch capacity)
+    n_rows: jnp.ndarray  # traced scalar
+    overflow: jnp.ndarray  # traced bool: a live key fell outside the domain
+
+
+def build_dense(keys, live, key_min: int, domain: int) -> DenseSide:
+    """One scatter builds the table; duplicate keys keep one row
+    (callers must only use the row payload when build keys are unique —
+    existence tests are correct regardless)."""
+    cap = keys.shape[0]
+    k = keys.astype(jnp.int64)
+    slot = k - jnp.int64(key_min)
+    in_range = (slot >= 0) & (slot < domain)
+    ok = live & in_range
+    table = (
+        jnp.full(domain, cap, jnp.int32)
+        .at[jnp.where(ok, slot, domain)]
+        .set(jnp.arange(cap, dtype=jnp.int32), mode="drop")
+    )
+    oob = jnp.any(live & ~in_range)
+    return DenseSide(
+        table,
+        jnp.asarray(key_min, jnp.int64),
+        jnp.asarray(cap, jnp.int32),
+        jnp.sum(live.astype(jnp.int32)),
+        oob,
+    )
+
+
+def probe_unique_dense(dense: DenseSide, probe_keys, probe_live) -> UniqueProbe:
+    """FK->PK probe against a dense table: one gather, no sort."""
+    domain = dense.table.shape[0]
+    slot = probe_keys.astype(jnp.int64) - dense.key_min
+    inr = (slot >= 0) & (slot < domain) & probe_live
+    row = jnp.where(inr, dense.table[jnp.clip(slot, 0, domain - 1)], dense.sentinel)
+    matched = row != dense.sentinel
+    return UniqueProbe(jnp.where(matched, row, dense.sentinel), matched)
+
+
+def probe_exists_dense(dense: DenseSide, probe_keys, probe_live):
+    """Semi-join membership via the dense table (duplicate-safe)."""
+    return probe_unique_dense(dense, probe_keys, probe_live).matched
+
+
 def probe_exists(build: BuildSide, probe_keys, probe_live):
     """Semi-join membership: True where the probe key exists in build.
     (reference: SetBuilderOperator / HashSemiJoinOperator)."""

@@ -126,3 +126,96 @@ def test_null_probe_keys_never_match():
     out = Pipeline(BatchSource([pb]), [j]).run()
     df = pd.concat([o.to_pandas() for o in out])
     assert df["pval"].tolist() == [1]
+
+
+# ---------------------------------------------------------------------------
+# dense-domain direct lookup
+# ---------------------------------------------------------------------------
+
+
+def test_dense_probe_matches_sorted_probe(rng):
+    import jax.numpy as jnp
+    import numpy as np
+
+    from presto_tpu.ops.join import (
+        build_dense, build_lookup, probe_exists_dense, probe_unique,
+        probe_unique_dense,
+    )
+
+    bcap, pcap, key_min, domain = 512, 2048, 100, 1500
+    bkeys = rng.choice(np.arange(key_min, key_min + domain), 400, replace=False)
+    bkeys = np.concatenate([bkeys, np.zeros(bcap - 400, np.int64)])
+    blive = np.arange(bcap) < 400
+    pkeys = rng.integers(key_min - 50, key_min + domain + 50, pcap)
+    plive = rng.random(pcap) < 0.9
+
+    dense = build_dense(jnp.asarray(bkeys), jnp.asarray(blive), key_min, domain)
+    assert not bool(dense.overflow)
+    sorted_side = build_lookup(jnp.asarray(bkeys), jnp.asarray(blive), bcap)
+    got = probe_unique_dense(dense, jnp.asarray(pkeys), jnp.asarray(plive))
+    want = probe_unique(sorted_side, jnp.asarray(pkeys), jnp.asarray(plive))
+    np.testing.assert_array_equal(np.asarray(got.matched), np.asarray(want.matched))
+    # matched rows must point at the same original build row
+    m = np.asarray(got.matched)
+    np.testing.assert_array_equal(
+        np.asarray(got.build_row)[m], np.asarray(want.build_row)[m]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(probe_exists_dense(dense, jnp.asarray(pkeys), jnp.asarray(plive))),
+        np.asarray(got.matched),
+    )
+
+
+def test_dense_build_flags_out_of_domain_keys():
+    import jax.numpy as jnp
+    import numpy as np
+
+    from presto_tpu.ops.join import build_dense
+
+    keys = jnp.asarray(np.array([5, 6, 99], np.int64))
+    live = jnp.asarray(np.ones(3, bool))
+    dense = build_dense(keys, live, 0, 10)  # 99 outside [0, 10)
+    assert bool(dense.overflow)
+    dead = build_dense(keys, jnp.asarray(np.array([True, True, False])), 0, 10)
+    assert not bool(dead.overflow)
+
+
+def test_sql_join_uses_dense_when_stats_bound_the_key():
+    """The planner must pick the dense direct-address build for an
+    FK->PK join whose build key has tight connector stats, and the
+    result must match the sorted path exactly."""
+    import pandas as pd
+
+    from presto_tpu.connectors.tpch import TpchConnector
+    from presto_tpu.exec import joins as J
+    from presto_tpu.runtime.session import Session
+
+    q = ("select o_orderpriority, count(*) as n from orders, customer "
+         "where o_custkey = c_custkey and c_mktsegment = 'BUILDING' "
+         "group by o_orderpriority order by o_orderpriority")
+    s = Session({"tpch": TpchConnector(sf=0.01)})
+
+    built_domains = []
+    orig = J.JoinBuildOperator.__init__
+
+    def spy(self, key, capacity=None, dense_domain=None):
+        built_domains.append(dense_domain)
+        orig(self, key, capacity, dense_domain)
+
+    J.JoinBuildOperator.__init__ = spy
+    try:
+        got = s.sql(q)
+    finally:
+        J.JoinBuildOperator.__init__ = orig
+    assert any(d is not None for d in built_domains), built_domains
+
+    # same query with stats disabled -> sorted path; answers must agree
+    import presto_tpu.exec.local_planner as LP
+
+    orig_dd = LP.LocalExecutor._dense_domain
+    LP.LocalExecutor._dense_domain = lambda self, *a: None
+    try:
+        want = Session({"tpch": TpchConnector(sf=0.01)}).sql(q)
+    finally:
+        LP.LocalExecutor._dense_domain = orig_dd
+    pd.testing.assert_frame_equal(got, want)
