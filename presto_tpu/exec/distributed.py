@@ -101,11 +101,22 @@ class DistributedExecutor:
     explicit shard_map fragment step with the exchange inside.
     """
 
-    def __init__(self, catalog: Catalog, mesh, broadcast_limit: int = 1 << 21):
+    def __init__(
+        self,
+        catalog: Catalog,
+        mesh,
+        broadcast_limit: int = 1 << 21,
+        gather_limit: int = 1 << 22,
+    ):
         self.catalog = catalog
         self.mesh = mesh
         self.nworkers = int(mesh.devices.size)
         self.broadcast_limit = broadcast_limit
+        #: row guard on replicate-everything fallbacks (window/sort/
+        #: limit v1 paths): gathering N rows to EVERY device multiplies
+        #: memory by the mesh size — fail fast with a clear message
+        #: instead of silently exploding HBM (round-1 advisor finding)
+        self.gather_limit = gather_limit
         #: optional StatsRecorder for the current query (see LocalExecutor)
         self.recorder = None
 
@@ -142,11 +153,27 @@ class DistributedExecutor:
         rec.record(node, wall, rows)
         return out
 
-    def _replicate(self, d: DistBatch) -> DistBatch:
+    def _replicate(self, d: DistBatch, guard: str | None = None) -> DistBatch:
         """Reshard rows -> fully replicated (the gather/broadcast
-        exchange; XLA lowers the resharding copy to an all_gather)."""
+        exchange; XLA lowers the resharding copy to an all_gather).
+
+        ``guard``: name of the replicate-everything fallback invoking
+        this (window/sort/topN/limit v1 paths) — enforces
+        ``gather_limit`` so a large input fails fast with a clear
+        message instead of multiplying HBM use by the mesh size.
+        """
         if not d.sharded:
             return d
+        if guard is not None:
+            rows = live_count(d.batch)
+            if rows > self.gather_limit:
+                raise CapacityOverflow(
+                    f"{guard}: replicating {rows} rows to every device "
+                    f"exceeds gather_limit={self.gather_limit}; raise the "
+                    "limit or restructure the query (partition-parallel "
+                    f"{guard} not yet implemented)",
+                    self.gather_limit,
+                )
         b = jax.device_put(d.batch, replicated(self.mesh))
         return DistBatch(b, sharded=False)
 
@@ -398,7 +425,11 @@ class DistributedExecutor:
         """REPLICATED distribution: all_gather the build side, probe
         stays sharded (probe's binary-search gathers hit the local
         replica — no collective in the probe step)."""
-        rb = self._replicate(right).batch
+        # the build replicate is a gather fallback like window/sort:
+        # when chosen because a side is unsharded (not because the build
+        # is small), an oversized build must fail fast, not silently
+        # multiply HBM by the mesh size
+        rb = self._replicate(right, guard="BroadcastJoinBuild").batch
         build = JoinBuildOperator(rkey)
         build.process(rb)
         build.finish()
@@ -517,7 +548,7 @@ class DistributedExecutor:
             or not right.sharded
             or not left.sharded
         ):
-            rb = self._replicate(right).batch
+            rb = self._replicate(right, guard="SemiJoinBuild").batch
             build = JoinBuildOperator(rkey)
             build.process(rb)
             build.finish()
@@ -536,28 +567,28 @@ class DistributedExecutor:
         windows device-local) is the planned upgrade."""
         from presto_tpu.exec.operators import window_operator_from_node
 
-        d = self._replicate(self._exec(node.child, scalars))
+        d = self._replicate(self._exec(node.child, scalars), guard="Window")
         op = window_operator_from_node(node, scalars)
         out = Pipeline(BatchSource([d.batch]), [op]).run()
         return DistBatch(out[0], sharded=False)
 
     # ---- ordering / limiting (gather exchanges: outputs are small) -------
     def _exec_sort(self, node: N.Sort, scalars) -> DistBatch:
-        d = self._replicate(self._exec(node.child, scalars))
+        d = self._replicate(self._exec(node.child, scalars), guard="Sort")
         keys = [SortKey(bind_scalars(k.expr, scalars), k.descending, k.nulls_first)
                 for k in node.keys]
         out = Pipeline(BatchSource([d.batch]), [OrderByOperator(keys)]).run()
         return DistBatch(out[0], sharded=False)
 
     def _exec_topn(self, node: N.TopN, scalars) -> DistBatch:
-        d = self._replicate(self._exec(node.child, scalars))
+        d = self._replicate(self._exec(node.child, scalars), guard="TopN")
         keys = [SortKey(bind_scalars(k.expr, scalars), k.descending, k.nulls_first)
                 for k in node.keys]
         out = Pipeline(BatchSource([d.batch]), [TopNOperator(keys, node.count)]).run()
         return DistBatch(out[0], sharded=False)
 
     def _exec_limit(self, node: N.Limit, scalars) -> DistBatch:
-        d = self._replicate(self._exec(node.child, scalars))
+        d = self._replicate(self._exec(node.child, scalars), guard="Limit")
         out = Pipeline(BatchSource([d.batch]), [LimitOperator(node.count)]).run()
         return DistBatch(out[0], sharded=False)
 

@@ -216,7 +216,17 @@ def _probe(kind: str, pattern: str, width: int, fn) -> bool:
                 dummy = np.zeros((_ROW_TILE, width), np.uint8)
                 jax.block_until_ready(fn(dummy, pattern))
                 _PROBE_CACHE[key] = True
-            except Exception:
+            except Exception as e:  # noqa: BLE001 — see module comment:
+                # the remote Mosaic compile helper crashes on some valid
+                # programs; queries fall back to the jnp kernel, but the
+                # fallback must be VISIBLE, not silent
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "pallas %s kernel probe failed for pattern=%r width=%d "
+                    "(falling back to the jnp kernel): %s: %s",
+                    kind, pattern, width, type(e).__name__, e,
+                )
                 _PROBE_CACHE[key] = False
     return _PROBE_CACHE[key]
 
@@ -240,6 +250,10 @@ def _prefix_kernel(prefix: bytes, data_ref, out_ref):
 
 def starts_with_pallas(data, prefix: str) -> jnp.ndarray:
     pb = prefix.encode("latin1")
+    if not pb:
+        # every string starts with the empty prefix; _match_at over an
+        # empty needle would return None and crash the kernel wrapper
+        return jnp.ones(data.shape[0], jnp.bool_)
     if len(pb) > data.shape[1]:
         return jnp.zeros(data.shape[0], jnp.bool_)
     return _run_rowwise(partial(_prefix_kernel, pb), data)

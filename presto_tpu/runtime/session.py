@@ -57,18 +57,32 @@ class Session:
         self.trace_token = trace_token
         self.events = EventDispatcher()
         self.query_history: list[QueryInfo] = []
-        if mesh is None:
-            self.executor = LocalExecutor(self.catalog)
-        else:
-            from presto_tpu.exec.distributed import DistributedExecutor
+    @property
+    def executor(self):
+        """A freshly-configured executor reflecting current session
+        properties. Queries never share one: ``_run_tracked`` builds its
+        own per query (this accessor exists for introspection)."""
+        return self._make_executor()
 
-            self.executor = DistributedExecutor(
-                self.catalog,
-                mesh,
-                broadcast_limit=int(
-                    self.properties.get("broadcast_join_row_limit", 1 << 21)
-                ),
-            )
+    def _make_executor(self):
+        """A fresh executor per query: per-query state (the stats
+        recorder) must never live on a shared object, or concurrent /
+        nested queries cross-contaminate each other's stats
+        (reference parity: per-query SqlQueryExecution objects)."""
+        if self.mesh is None:
+            return LocalExecutor(self.catalog)
+        from presto_tpu.exec.distributed import DistributedExecutor
+
+        return DistributedExecutor(
+            self.catalog,
+            self.mesh,
+            broadcast_limit=int(
+                self.properties.get("broadcast_join_row_limit", 1 << 21)
+            ),
+            gather_limit=int(
+                self.properties.get("gather_row_limit", 1 << 22)
+            ),
+        )
 
     # ------------------------------------------------------------------
     def add_event_listener(self, listener):
@@ -120,10 +134,11 @@ class Session:
         self.events.query_created(info)
         info.state = "RUNNING"
         info.started_at = time.time()
-        self.executor.recorder = recorder
+        executor = self._make_executor()
+        executor.recorder = recorder
         try:
             with REGISTRY.timer("query.execution").time():
-                df = self.executor.run(plan)
+                df = executor.run(plan)
             info.state = "FINISHED"
             info.output_rows = len(df)
             REGISTRY.counter("query.completed").add()
@@ -134,7 +149,6 @@ class Session:
             raise
         finally:
             info.finished_at = time.time()
-            self.executor.recorder = None
             if recorder is not None:
                 info.node_stats = [
                     s.to_dict() for s in recorder.nodes.values()

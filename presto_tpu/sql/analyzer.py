@@ -1049,14 +1049,27 @@ class Analyzer:
             # determinants are all among the keys rides as a passenger
             fdeps = self.catalog.func_deps(f0.table) if f0.table else {}
             if fdeps:
-                det = [
-                    (n, e) for n, e in ks
-                    if fmap[n].column in fdeps
-                    and set(fdeps[fmap[n].column]) <= cols
-                ]
+                # iterative demotion with a guard: a key becomes a
+                # passenger only while its determinants stay among the
+                # REMAINING grouped keys — naive one-shot demotion with
+                # cyclic declared deps (b<-c, c<-b) would demote every
+                # key and collapse the grouping entirely
+                remaining = list(ks)
+                det = []
+                changed = True
+                while changed and len(remaining) > 1:
+                    changed = False
+                    rem_cols = {fmap[n].column for n, _ in remaining}
+                    for k in list(remaining):
+                        c = fmap[k[0]].column
+                        if c in fdeps and set(fdeps[c]) <= (rem_cols - {c}):
+                            remaining.remove(k)
+                            det.append(k)
+                            changed = True
+                            break
                 if det:
                     passengers.extend(det)
-                    ks = [k for k in ks if k not in det]
+                    ks = remaining
                     cols = {fmap[n].column for n, _ in ks}
                     if not ks:
                         continue

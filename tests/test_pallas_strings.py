@@ -119,3 +119,33 @@ def monkeypatch_module():
     mp = MonkeyPatch()
     yield mp
     mp.undo()
+
+
+def test_empty_prefix_matches_everything():
+    """starts_with('') is vacuously true; the kernel wrapper used to
+    crash on an empty needle (round-1 advisor finding)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from presto_tpu.ops.pallas_strings import starts_with_pallas
+
+    data = jnp.asarray(np.zeros((8, 12), np.uint8))
+    out = np.asarray(starts_with_pallas(data, ""))
+    assert out.all()
+
+
+def test_probe_failure_is_logged(monkeypatch, caplog):
+    import logging
+
+    import presto_tpu.ops.pallas_strings as ps
+
+    monkeypatch.setattr(ps, "_PROBE_CACHE", {})
+    monkeypatch.setattr(ps, "_interpret", lambda: False)
+
+    def boom(data, pattern):
+        raise RuntimeError("mosaic compile crashed")
+
+    with caplog.at_level(logging.WARNING, logger="presto_tpu.ops.pallas_strings"):
+        ok = ps._probe("like", "x%", 12, boom)
+    assert not ok
+    assert any("falling back" in r.message for r in caplog.records)

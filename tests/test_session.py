@@ -1,0 +1,52 @@
+"""Session runtime behaviors (query lifecycle, stats isolation).
+
+Reference parity: per-query execution objects (SqlQueryExecution) —
+per-query state like the stats recorder must not live on shared
+machinery [SURVEY §3.1; round-1 advisor finding]."""
+
+from presto_tpu.connectors.tpch import TpchConnector
+from presto_tpu.runtime.session import Session
+
+
+def test_each_query_gets_a_fresh_executor(monkeypatch):
+    s = Session({"tpch": TpchConnector(sf=0.01)})
+    seen = []
+    orig = Session._make_executor
+
+    def spy(self):
+        ex = orig(self)
+        seen.append(ex)
+        return ex
+
+    monkeypatch.setattr(Session, "_make_executor", spy)
+    s.sql("select count(*) c from nation")
+    out = s.explain_analyze("select count(*) c from region")
+    assert "rows" in out or "Output" in out
+    assert len(seen) == 2
+    assert seen[0] is not seen[1]
+    # the session's template executor never carries a recorder
+    assert s.executor.recorder is None
+
+
+def test_nested_query_from_event_listener_keeps_outer_stats():
+    """A listener that issues its own query mid-lifecycle must not
+    clobber the outer query's recorded node stats."""
+    s = Session({"tpch": TpchConnector(sf=0.01)})
+    nested_df = []
+
+    running = []
+
+    class Listener:
+        def query_created(self, info):
+            pass
+
+        def query_completed(self, info):
+            if not running:  # re-entrancy guard
+                running.append(True)
+                nested_df.append(s.sql("select count(*) c from region"))
+
+    s.add_event_listener(Listener())
+    df, info = s.execute("select count(*) c from nation")
+    assert int(df["c"][0]) == 25
+    assert info.node_stats, "outer query lost its recorded stats"
+    assert len(nested_df) == 1
