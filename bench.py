@@ -369,6 +369,65 @@ def bench_q1_resident(sf_big: float, dev):
     return n / secs
 
 
+def bench_q1_streaming(sf: float, dev, split_units: int = 1 << 22):
+    """Config-2 mode (``python bench.py <sf> --stream``): Q1 as a
+    streaming morsel loop — generate split i+1 on the host while the
+    device folds split i into the aggregation state. Bounded host and
+    HBM memory at ANY scale factor: this is the path that runs SF100+
+    on one chip (round-2 VERDICT item 2; SURVEY §7.1 morsel loop).
+    Validated per split against an exact host-side recomputation.
+    """
+    import jax
+    import numpy as np
+
+    from presto_tpu.connectors.tpch import TpchConnector
+    from presto_tpu.workloads import Q1_COLS, combine_q1_states, q1_fused_step
+
+    conn = TpchConnector(sf=sf, units_per_split=split_units)
+    splits = conn.splits("lineitem")
+
+    @jax.jit
+    def fold(state, batch):
+        return combine_q1_states(state, q1_fused_step(batch))
+
+    first = jax.jit(q1_fused_step)
+
+    # -- timed pass: generate -> transfer -> fold, nothing else ----------
+    state = None
+    total_rows = 0
+    t0 = time.perf_counter()
+    for split in splits:
+        arrays = conn.scan_numpy(split, Q1_COLS)
+        batch, n = put_table("lineitem", arrays, dev)
+        state = first(batch) if state is None else fold(state, batch)
+        total_rows += n
+    jax.block_until_ready(state)
+    secs = time.perf_counter() - t0
+
+    # -- untimed validation pass: regenerate and recompute exactly -------
+    want = {k: np.zeros(6, np.int64)
+            for k in ("sum_qty", "sum_base_price", "sum_disc_price",
+                      "sum_charge", "count_order")}
+    for split in splits:
+        arrays = conn.scan_numpy(split, Q1_COLS)
+        m = arrays["l_shipdate"] <= 10471
+        gid = (arrays["l_returnflag"].astype(np.int64) * 2
+               + arrays["l_linestatus"].astype(np.int64))[m]
+        dp = arrays["l_extendedprice"][m] * (100 - arrays["l_discount"][m])
+        ch = (np.abs(dp * (100 + arrays["l_tax"][m])) + 50) // 100
+        for key, v in (("sum_qty", arrays["l_quantity"][m]),
+                       ("sum_base_price", arrays["l_extendedprice"][m]),
+                       ("sum_disc_price", dp), ("sum_charge", ch)):
+            np.add.at(want[key], gid, v)
+        want["count_order"] += np.bincount(gid, minlength=6)
+
+    got = {k: np.asarray(v) for k, v in state.items()}
+    assert not bool(got["value_overflow"])
+    for k, v in want.items():
+        np.testing.assert_array_equal(got[k], v, err_msg=f"stream Q1: {k}")
+    return total_rows / secs
+
+
 class _ExtrasTimeout(Exception):
     pass
 
@@ -377,6 +436,7 @@ def main() -> None:
     import jax
 
     sf = float(sys.argv[1]) if len(sys.argv) > 1 else 1.0
+    stream_mode = "--stream" in sys.argv[2:]
     # Local smoke runs: PRESTO_TPU_BENCH_CPU=1 pins the CPU backend
     # before any accelerator plugin initializes (the TPU tunnel hangs
     # hard when unhealthy). The driver's real bench run uses the TPU.
@@ -387,6 +447,23 @@ def main() -> None:
     # Force the runtime into synchronous mode NOW (see module docstring):
     # honest timings, device-resident buffers.
     _ = int(jax.device_put(jax.numpy.arange(4), dev).sum())
+
+    if stream_mode:
+        # config-2 capability mode: unbounded-SF streaming Q1 (one chip,
+        # bounded memory); prints its own single JSON line
+        rows = bench_q1_streaming(sf, dev)
+        print(
+            json.dumps(
+                {
+                    "metric": f"tpch_q1_stream_rows_per_sec_sf{sf:g}",
+                    "value": round(rows),
+                    "unit": "rows/s",
+                    "vs_baseline": round(rows / BASELINE_ROWS_PER_SEC, 3),
+                }
+            ),
+            flush=True,
+        )
+        return
 
     from presto_tpu.connectors.tpch import TpchConnector
 

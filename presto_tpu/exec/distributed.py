@@ -93,6 +93,24 @@ def _sortable(v):
     return HashAggregationOperator._sortable(v)
 
 
+def _compact_local(b: Batch, out_cap: int) -> Batch:
+    """Gather live rows into a smaller-capacity batch (one nonzero +
+    per-column gather). Caller guarantees live_count <= out_cap."""
+    from presto_tpu.ops.compact import compact_indices
+
+    idx, _, _ = compact_indices(b.live, out_cap)
+    cols = {
+        n: Column(
+            gather_rows(c.data, idx, 0),
+            gather_padded(c.valid, idx, False),
+            c.dtype,
+            c.dictionary,
+        )
+        for n, c in b.columns.items()
+    }
+    return Batch(cols, gather_padded(b.live, idx, False))
+
+
 class DistributedExecutor:
     """Single-controller distributed executor over a worker mesh.
 
@@ -164,8 +182,9 @@ class DistributedExecutor:
         """
         if not d.sharded:
             return d
+        b = d.batch
         if guard is not None:
-            rows = live_count(d.batch)
+            rows = live_count(b)
             if rows > self.gather_limit:
                 raise CapacityOverflow(
                     f"{guard}: replicating {rows} rows to every device "
@@ -174,7 +193,20 @@ class DistributedExecutor:
                     f"{guard} not yet implemented)",
                     self.gather_limit,
                 )
-        b = jax.device_put(d.batch, replicated(self.mesh))
+            # replication cost is CAPACITY, not live rows: compact a
+            # mostly-dead batch per-device (shard_map — no global
+            # gather) so the all_gather moves live data, not padding
+            cap2 = batch_capacity(max(rows, 16), minimum=16)
+            if self.nworkers * cap2 < b.capacity:
+                step = partial(
+                    shard_map,
+                    mesh=self.mesh,
+                    in_specs=(P(WORKERS),),
+                    out_specs=P(WORKERS),
+                    check_vma=False,
+                )(lambda local: _compact_local(local, cap2))
+                b = jax.jit(step)(b)
+        b = jax.device_put(b, replicated(self.mesh))
         return DistBatch(b, sharded=False)
 
     def _shard(self, b: Batch) -> Batch:
@@ -182,25 +214,77 @@ class DistributedExecutor:
 
     # ---- leaves ----------------------------------------------------------
     def _exec_tablescan(self, node: N.TableScan, scalars) -> DistBatch:
-        """Data-parallel scan: splits stream to host-columnar arrays and
-        land row-sharded on the mesh (the SOURCE_DISTRIBUTION stage)."""
+        """Data-parallel scan: splits round-robin onto devices; each
+        device's shard is generated, padded, and placed independently,
+        then the global sharded Batch is assembled from the per-device
+        pieces (``make_array_from_single_device_arrays``) — the host
+        never materializes the whole table, only one device's shard at
+        a time (round-2 VERDICT item 2; SURVEY §2.4 DP row)."""
         conn = self.catalog.connector(node.connector)
         src_cols = [s for _, s in node.columns]
-        parts = [conn.scan_numpy(s, src_cols) for s in conn.splits(node.table)]
-        cat = {c: np.concatenate([p[c] for p in parts]) for c in parts[0]}
-        from presto_tpu.spi import split_valids
-
-        arrays, valids = split_valids(cat)
-        rows = len(next(iter(arrays.values())))
-        cap_dev = batch_capacity(-(-max(rows, 1) // self.nworkers), minimum=128)
+        splits = list(conn.splits(node.table))
+        n = self.nworkers
+        assign = [splits[i::n] for i in range(n)]
+        cap_dev = batch_capacity(
+            max(max(sum(s.row_hint for s in sp) for sp in assign), 1),
+            minimum=128,
+        )
         types = {c: conn.schema(node.table)[c] for c in src_cols}
         dicts = {c: d for c, d in conn.dictionaries(node.table).items() if c in types}
-        host = Batch.from_numpy(
-            arrays, types, count=rows, capacity=self.nworkers * cap_dev,
-            dictionaries=dicts, valids=valids,
-        )
-        rename = {s: n for n, s in node.columns}
-        b = self._shard(host.rename(rename))
+        devices = list(self.mesh.devices.flat)
+        from presto_tpu.spi import split_valids
+
+        data_shards: dict[str, list] = {c: [] for c in src_cols}
+        valid_shards: dict[str, list] = {c: [] for c in src_cols}
+        live_shards: list = []
+        for d, sp in enumerate(assign):
+            if sp:
+                parts = [conn.scan_numpy(s, src_cols) for s in sp]
+                cat = {c: np.concatenate([p[c] for p in parts]) for c in parts[0]}
+            else:
+                cat = {}
+            arrays, valids = split_valids(cat)
+            rows = len(next(iter(arrays.values()))) if arrays else 0
+            if rows > cap_dev:
+                raise CapacityOverflow("TableScan shard", cap_dev, rows)
+            for c in src_cols:
+                t = types[c]
+                a = arrays.get(c)
+                tail = (t.width,) if t.kind is TypeKind.BYTES else ()
+                padded = np.zeros((cap_dev,) + tail, dtype=t.np_dtype)
+                if a is not None:
+                    if tail:  # BYTES rows may be narrower than the
+                        padded[:rows, : a.shape[1]] = a  # schema width
+                    else:
+                        padded[:rows] = a
+                v = np.zeros(cap_dev, np.bool_)
+                if rows:
+                    vm = valids.get(c)
+                    v[:rows] = True if vm is None else vm
+                data_shards[c].append(jax.device_put(padded, devices[d]))
+                valid_shards[c].append(jax.device_put(v, devices[d]))
+            lv = np.zeros(cap_dev, np.bool_)
+            lv[:rows] = True
+            live_shards.append(jax.device_put(lv, devices[d]))
+
+        sh = row_sharding(self.mesh)
+
+        def assemble(pieces):
+            tail = tuple(pieces[0].shape[1:])
+            return jax.make_array_from_single_device_arrays(
+                (n * cap_dev,) + tail, sh, pieces
+            )
+
+        cols = {
+            c: Column(
+                assemble(data_shards[c]), assemble(valid_shards[c]),
+                types[c], dicts.get(c),
+            )
+            for c in src_cols
+        }
+        b = Batch(cols, assemble(live_shards))
+        rename = {s: nn for nn, s in node.columns}
+        b = b.rename(rename)
         if node.predicate is not None:
             op = FilterProjectOperator(bind_scalars(node.predicate, scalars), None)
             b = op.process(b)[0]
@@ -243,7 +327,14 @@ class DistributedExecutor:
 
         from presto_tpu.exec.local_planner import pick_group_strategy
 
-        strategy = pick_group_strategy(keys, pax, [d.batch])
+        first = d.batch
+
+        def dict_len(name: str):
+            if name in first and first[name].dictionary is not None:
+                return len(first[name].dictionary)
+            return None
+
+        strategy = pick_group_strategy(keys, pax, dict_len, live_count(first))
         if isinstance(strategy, DirectStrategy):
             # small dense group domain: per-shard segment_sum + XLA
             # auto-reduction (the psum path of the Q1 fragment)

@@ -39,7 +39,7 @@ from presto_tpu.exec.operators import (
     SortStrategy,
     TopNOperator,
 )
-from presto_tpu.exec.pipeline import BatchSource, Pipeline, ScanSource
+from presto_tpu.exec.pipeline import BatchSource, BatchStream, Pipeline, ScanSource
 from presto_tpu.expr import BIGINT, Call, Expr, InputRef, Literal, bind_scalars
 from presto_tpu.plan import nodes as N
 from presto_tpu.plan.catalog import Catalog
@@ -51,27 +51,30 @@ MAX_GROUP_CAP = 1 << 20
 MAX_RETRIES = 6
 
 
-def pick_group_strategy(keys, pax, child: list[Batch]):
+def pick_group_strategy(keys, pax, dict_len, est_rows: int):
     """Grouping-strategy choice shared by the local and distributed
     executors: direct addressing for small dictionary-key domains,
-    bounded merge-by-sort otherwise (see module docstring)."""
-    if not child:
-        return SortStrategy(1024)
+    bounded merge-by-sort otherwise (see module docstring).
+
+    ``dict_len``: name -> ordered-dictionary domain size (None when
+    unknown) — metadata-only, so streaming inputs are never scanned or
+    drained to make this decision; ``est_rows``: stats-estimated input
+    row count sizing the sort strategy's group capacity, backed by
+    overflow-retry doubling.
+    """
     if not pax and keys:
-        first = child[0]
         domains = []
         ok = True
         for _, e in keys:
-            if (
-                isinstance(e, InputRef)
-                and e.dtype.kind is TypeKind.VARCHAR
-                and e.name in first
-                and first[e.name].dictionary is not None
-            ):
-                domains.append(len(first[e.name].dictionary))
-            else:
+            d = (
+                dict_len(e.name)
+                if isinstance(e, InputRef) and e.dtype.kind is TypeKind.VARCHAR
+                else None
+            )
+            if d is None:
                 ok = False
                 break
+            domains.append(d)
         if ok and domains and int(np.prod(domains)) <= DIRECT_LIMIT:
             strides = []
             acc = 1
@@ -82,8 +85,7 @@ def pick_group_strategy(keys, pax, child: list[Batch]):
             return DirectStrategy(
                 tuple(0 for _ in domains), tuple(strides), int(np.prod(domains))
             )
-    total = sum(live_count(b) for b in child)
-    return SortStrategy(min(batch_capacity(max(total, 16)), MAX_GROUP_CAP))
+    return SortStrategy(min(batch_capacity(max(est_rows, 16)), MAX_GROUP_CAP))
 
 
 class LocalExecutor:
@@ -122,7 +124,16 @@ class LocalExecutor:
         return out, list(plan.names)
 
     # ------------------------------------------------------------------
-    def _exec(self, node: N.PlanNode, scalars: dict) -> list[Batch]:
+    def _exec(self, node: N.PlanNode, scalars: dict) -> BatchStream:
+        """Execute a node to a replayable lazy BatchStream.
+
+        Lazy nodes (scan/filter/project/probe) defer work to the
+        consumer, so per-node wall times in EXPLAIN ANALYZE attribute
+        streamed work to the draining (pipeline-breaking) node; with a
+        recorder attached, streams are materialized per node so row
+        counts stay exact (EXPLAIN ANALYZE trades the streaming memory
+        bound for observability).
+        """
         m = getattr(self, f"_exec_{type(node).__name__.lower()}", None)
         if m is None:
             raise NotImplementedError(f"no executor for {type(node).__name__}")
@@ -133,19 +144,24 @@ class LocalExecutor:
 
         t0 = _time.perf_counter()
         out = m(node, scalars)
-        wall = _time.perf_counter() - t0  # inclusive of children
         rows = -1
-        if rec.measure_rows and isinstance(out, list):
-            rows = sum(live_count(b) for b in out)
+        if rec.measure_rows and isinstance(out, BatchStream):
+            batches = out.materialize()
+            rows = sum(live_count(b) for b in batches)
+            out = BatchStream.of(batches)
+        wall = _time.perf_counter() - t0  # inclusive of children
         rec.record(node, wall, rows)
         return out
 
     # ---- leaves ----------------------------------------------------------
-    def _exec_tablescan(self, node: N.TableScan, scalars):
+    def _exec_tablescan(self, node: N.TableScan, scalars) -> BatchStream:
+        """Streaming scan: one device batch per split, yielded lazily —
+        the whole table is never resident at once (SURVEY §7.4 #5; the
+        morsel loop of §7.1). The host generates split i+1 while the
+        device processes split i (XLA dispatches are async)."""
         conn = self.catalog.connector(node.connector)
         src_cols = [s for _, s in node.columns]
         rename = {s: n for n, s in node.columns}
-        out = []
         ops = []
         if node.predicate is not None:
             ops.append(
@@ -153,24 +169,27 @@ class LocalExecutor:
             )
         splits = list(conn.splits(node.table))
         cap = batch_capacity(max(s.row_hint for s in splits))
-        for split in splits:
-            b = conn.scan(split, src_cols, cap).rename(rename)
-            for op in ops:
-                b = op.process(b)[0]
-            out.append(b)
-        return out
+
+        def make():
+            for split in splits:
+                b = conn.scan(split, src_cols, cap).rename(rename)
+                for op in ops:
+                    b = op.process(b)[0]
+                yield b
+
+        return BatchStream(make)
 
     # ---- streaming transforms -------------------------------------------
-    def _exec_filter(self, node: N.Filter, scalars):
+    def _exec_filter(self, node: N.Filter, scalars) -> BatchStream:
         child = self._exec(node.child, scalars)
         op = FilterProjectOperator(bind_scalars(node.predicate, scalars), None)
-        return [op.process(b)[0] for b in child]
+        return child.map(lambda b: op.process(b)[0])
 
-    def _exec_project(self, node: N.Project, scalars):
+    def _exec_project(self, node: N.Project, scalars) -> BatchStream:
         child = self._exec(node.child, scalars)
         projs = {n: bind_scalars(e, scalars) for n, e in node.exprs}
         op = FilterProjectOperator(None, projs)
-        return [op.process(b)[0] for b in child]
+        return child.map(lambda b: op.process(b)[0])
 
     # ---- aggregation ----------------------------------------------------
     def _exec_aggregate(self, node: N.Aggregate, scalars):
@@ -192,12 +211,14 @@ class LocalExecutor:
             from presto_tpu.exec.operators import GlobalAggregationOperator
 
             op = GlobalAggregationOperator(aggs)
-            return Pipeline(BatchSource(child), [op]).run()
-        strategy = self._pick_group_strategy(keys, pax, child)
+            return BatchStream.of(Pipeline(child, [op]).run())
+        strategy = self._pick_group_strategy(keys, pax, node, child)
         for attempt in range(MAX_RETRIES):
             op = HashAggregationOperator(keys, aggs, strategy, passengers=pax)
             try:
-                return Pipeline(BatchSource(child), [op]).run()
+                # draining the (replayable) child stream folds one morsel
+                # at a time into device-resident state — bounded memory
+                return BatchStream.of(Pipeline(child, [op]).run())
             except ValueBitsOverflow:
                 aggs = [dataclasses.replace(a, value_bits=63) for a in aggs]
             except CapacityOverflow:
@@ -206,16 +227,26 @@ class LocalExecutor:
                 strategy = SortStrategy(strategy.max_groups * 2)
         raise CapacityOverflow("Aggregate", strategy.max_groups)
 
-    def _pick_group_strategy(self, keys, pax, child: list[Batch]):
-        return pick_group_strategy(keys, pax, child)
+    def _pick_group_strategy(self, keys, pax, node: N.Aggregate, child: BatchStream):
+        from presto_tpu.plan.bounds import estimate_rows, key_dictionary
+
+        def dict_len(name: str):
+            d = key_dictionary(node.child, name, self.catalog)
+            return len(d) if d is not None else None
+
+        return pick_group_strategy(
+            keys, pax, dict_len, estimate_rows(node.child, self.catalog)
+        )
 
     # ---- joins -----------------------------------------------------------
     def _join_key_exprs(
         self, lkeys: Sequence[Expr], rkeys: Sequence[Expr],
-        left: list[Batch], right: list[Batch], scalars,
+        left, right, scalars,
     ):
         """Single-key passthrough or multi-key bit-packing using
-        runtime maxima over both sides (keys must be non-negative)."""
+        runtime maxima over both sides (keys must be non-negative).
+        Multi-key joins pay one extra streaming pass over the probe
+        side to find the maxima (the stream replays for the probe)."""
         lkeys = [bind_scalars(k, scalars) for k in lkeys]
         rkeys = [bind_scalars(k, scalars) for k in rkeys]
         if len(lkeys) == 1:
@@ -267,7 +298,9 @@ class LocalExecutor:
 
     def _exec_join(self, node: N.Join, scalars):
         left = self._exec(node.left, scalars)
-        right = self._exec(node.right, scalars)
+        # the build side is inherently materialized (the lookup source
+        # concatenates it); the PROBE side streams batch-by-batch
+        right = self._exec(node.right, scalars).materialize()
         lkey, rkey = self._join_key_exprs(
             node.left_keys, node.right_keys, left, right, scalars
         )
@@ -281,25 +314,41 @@ class LocalExecutor:
         outs = [BuildOutput(n, n) for n in node.output_right]
         if node.unique:
             op = LookupJoinOperator(build, lkey, outs, node.kind, unique=True)
-            return [op.process(b)[0] for b in left]
-        # expansion join with retry-doubling
+            return left.map(lambda b: op.process(b)[0])
+        # expansion join with retry-doubling; the probe stream replays
+        # on overflow (regenerate-rather-than-hold, SURVEY §7.4 #1)
         right_rows = sum(live_count(b) for b in right)
+        first = left.peek()
         out_cap = batch_capacity(
-            max(max((b.capacity for b in left), default=1024), right_rows, 1024)
+            max(first.capacity if first is not None else 1024, right_rows, 1024)
         )
-        for attempt in range(MAX_RETRIES):
-            try:
-                op = LookupJoinOperator(
-                    build, lkey, outs, node.kind, unique=False, out_capacity=out_cap
-                )
-                return [op.process(b)[0] for b in left]
-            except CapacityOverflow:
-                out_cap *= 2
-        raise CapacityOverflow("Join", out_cap)
+
+        # per-batch retry: expansion probing is stateless per batch, so
+        # an overflow re-probes only the offending batch at a doubled
+        # capacity (and keeps the raised capacity for later batches)
+        state = {"cap": out_cap, "ops": {}}
+
+        def probe(b):
+            for _ in range(MAX_RETRIES):
+                c = state["cap"]
+                op = state["ops"].get(c)
+                if op is None:
+                    op = LookupJoinOperator(
+                        build, lkey, outs, node.kind, unique=False,
+                        out_capacity=c,
+                    )
+                    state["ops"][c] = op
+                try:
+                    return op.process(b)[0]
+                except CapacityOverflow:
+                    state["cap"] = c * 2
+            raise CapacityOverflow("Join", state["cap"])
+
+        return left.map(probe)
 
     def _exec_semijoin(self, node: N.SemiJoin, scalars):
         left = self._exec(node.left, scalars)
-        right = self._exec(node.right, scalars)
+        right = self._exec(node.right, scalars).materialize()
         lkey, rkey = self._join_key_exprs(
             node.left_keys, node.right_keys, left, right, scalars
         )
@@ -309,7 +358,7 @@ class LocalExecutor:
         op = LookupJoinOperator(
             build, lkey, (), "anti" if node.negated else "semi"
         )
-        return [op.process(b)[0] for b in left]
+        return left.map(lambda b: op.process(b)[0])
 
     # ---- window functions -----------------------------------------------
     def _exec_window(self, node: N.Window, scalars):
@@ -317,7 +366,7 @@ class LocalExecutor:
         from presto_tpu.exec.operators import window_operator_from_node
 
         op = window_operator_from_node(node, scalars)
-        return Pipeline(BatchSource(child), [op]).run()
+        return BatchStream.of(Pipeline(child, [op]).run())
 
     # ---- ordering / limiting --------------------------------------------
     def _exec_sort(self, node: N.Sort, scalars):
@@ -328,7 +377,7 @@ class LocalExecutor:
             SortKey(bind_scalars(k.expr, scalars), k.descending, k.nulls_first)
             for k in node.keys
         ]
-        return Pipeline(BatchSource(child), [OrderByOperator(keys)]).run()
+        return BatchStream.of(Pipeline(child, [OrderByOperator(keys)]).run())
 
     def _exec_topn(self, node: N.TopN, scalars):
         child = self._exec(node.child, scalars)
@@ -338,11 +387,13 @@ class LocalExecutor:
             SortKey(bind_scalars(k.expr, scalars), k.descending, k.nulls_first)
             for k in node.keys
         ]
-        return Pipeline(BatchSource(child), [TopNOperator(keys, node.count)]).run()
+        return BatchStream.of(
+            Pipeline(child, [TopNOperator(keys, node.count)]).run()
+        )
 
     def _exec_limit(self, node: N.Limit, scalars):
         child = self._exec(node.child, scalars)
-        return Pipeline(BatchSource(child), [LimitOperator(node.count)]).run()
+        return BatchStream.of(Pipeline(child, [LimitOperator(node.count)]).run())
 
     # ---- scalar subqueries ----------------------------------------------
     def _exec_bindscalars(self, node: N.BindScalars, scalars):
@@ -375,4 +426,4 @@ class LocalExecutor:
 
     def _exec_output(self, node: N.Output, scalars):
         batches, names = self.run_batches(node)
-        return batches
+        return BatchStream.of(batches)

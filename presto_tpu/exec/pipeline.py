@@ -74,6 +74,42 @@ class BatchSource:
         return iter(self._batches)
 
 
+class BatchStream:
+    """A REPLAYABLE lazy batch stream — the executor's unit of data flow.
+
+    ``make_iter`` returns a fresh iterator on every call, so retry loops
+    (capacity-overflow doubling) can re-drain the stream; a plain
+    generator would come back empty on the second attempt and silently
+    drop rows. Replaying a scan-rooted stream re-generates the data —
+    the deliberate trade that keeps memory bounded (SURVEY §7.4 #1:
+    overflow retries are rare, whole-table materialization is not).
+
+    Streams rooted at materialized results wrap a list (replay is free).
+    """
+
+    def __init__(self, make_iter: Callable[[], Iterator[Batch]]):
+        self._make = make_iter
+
+    @classmethod
+    def of(cls, batches: Sequence[Batch]) -> "BatchStream":
+        return cls(lambda: iter(batches))
+
+    def __iter__(self) -> Iterator[Batch]:
+        return self._make()
+
+    def map(self, fn: Callable[[Batch], Batch]) -> "BatchStream":
+        return BatchStream(lambda: (fn(b) for b in self))
+
+    def peek(self) -> "Batch | None":
+        """First batch, or None when empty (costs one replayed scan of
+        the first split — used for trace-time decisions like dictionary
+        domains)."""
+        return next(iter(self), None)
+
+    def materialize(self) -> list[Batch]:
+        return list(self)
+
+
 class Pipeline:
     """source -> op chain; run() returns the terminal output batches."""
 

@@ -220,6 +220,85 @@ def node_intervals(node: N.PlanNode, catalog) -> dict[str, Interval]:
     return {f.name: None for f in node.fields}
 
 
+def resolve_source_column(node: N.PlanNode, name: str):
+    """Trace an output column back to its (connector, table, source
+    column) through rename/project/filter/join chains; None when the
+    column is computed. Lets the planner answer metadata questions
+    (dictionary domains, stats) without scanning any data."""
+    if isinstance(node, N.TableScan):
+        for n, src in node.columns:
+            if n == name:
+                return (node.connector, node.table, src)
+        return None
+    if isinstance(node, N.Project):
+        for n, e in node.exprs:
+            if n == name:
+                if isinstance(e, InputRef):
+                    return resolve_source_column(node.child, e.name)
+                return None
+        return None
+    if isinstance(node, N.Aggregate):
+        for n, e in list(node.keys) + list(node.passengers):
+            if n == name:
+                if isinstance(e, InputRef):
+                    return resolve_source_column(node.child, e.name)
+                return None
+        return None
+    if isinstance(node, N.Join):
+        if name in {f.name for f in node.left.fields}:
+            return resolve_source_column(node.left, name)
+        return resolve_source_column(node.right, name)
+    if isinstance(node, N.SemiJoin):
+        return resolve_source_column(node.left, name)
+    children = node.children
+    if len(children) == 1:
+        return resolve_source_column(children[0], name)
+    return None
+
+
+def key_dictionary(node: N.PlanNode, name: str, catalog):
+    """The ordered dictionary behind an output column, via metadata."""
+    src = resolve_source_column(node, name)
+    if src is None:
+        return None
+    connector, table, col = src
+    conn = catalog.connector(connector)
+    if not hasattr(conn, "dictionaries"):
+        return None
+    return conn.dictionaries(table).get(col)
+
+
+def estimate_rows(node: N.PlanNode, catalog) -> int:
+    """Coarse output-row estimate from connector stats (the
+    StatsCalculator role, radically simplified). Used to size sort-
+    strategy group capacities and streaming morsel state up front;
+    always backed by the capacity-overflow retry loop, so a bad
+    estimate costs a replay, never a wrong answer."""
+    if isinstance(node, N.TableScan):
+        conn = catalog.connector(node.connector)
+        rows = int(conn.row_count(node.table)) if hasattr(conn, "row_count") else 1 << 16
+        return max(1, rows // (3 if node.predicate is not None else 1))
+    if isinstance(node, N.Filter):
+        return max(1, estimate_rows(node.child, catalog) // 3)
+    if isinstance(node, N.Aggregate):
+        return max(1, estimate_rows(node.child, catalog) // 8)
+    if isinstance(node, N.Join):
+        left = estimate_rows(node.left, catalog)
+        if node.unique:
+            return left
+        return max(left, estimate_rows(node.right, catalog))
+    if isinstance(node, N.SemiJoin):
+        return estimate_rows(node.left, catalog)
+    if isinstance(node, N.TopN):
+        return node.count
+    if isinstance(node, N.Limit):
+        return node.count
+    children = node.children
+    if children:
+        return max(estimate_rows(c, catalog) for c in children)
+    return 1 << 10
+
+
 def agg_value_bits(agg: N.Aggregate, catalog) -> list[int]:
     """``value_bits`` for each of ``agg.aggs`` (63 when unbounded)."""
     env = node_intervals(agg.child, catalog)

@@ -1049,24 +1049,34 @@ class Analyzer:
             # determinants are all among the keys rides as a passenger
             fdeps = self.catalog.func_deps(f0.table) if f0.table else {}
             if fdeps:
-                # iterative demotion with a guard: a key becomes a
-                # passenger only while its determinants stay among the
-                # REMAINING grouped keys — naive one-shot demotion with
-                # cyclic declared deps (b<-c, c<-b) would demote every
-                # key and collapse the grouping entirely
+                # closure-grounded demotion: a key may become a
+                # passenger only when it is in the functional CLOSURE of
+                # the keys that would remain — sound under transitive
+                # chains (b<-a, c<-b demotes both b and c) AND under
+                # cyclic declared deps (b<-c, c<-b keeps one of them;
+                # naive one-shot demotion collapsed the grouping)
+                def closure(base: set) -> set:
+                    out = set(base)
+                    grew = True
+                    while grew:
+                        grew = False
+                        for c, dets in fdeps.items():
+                            if c not in out and set(dets) <= out:
+                                out.add(c)
+                                grew = True
+                    return out
+
                 remaining = list(ks)
                 det = []
-                changed = True
-                while changed and len(remaining) > 1:
-                    changed = False
-                    rem_cols = {fmap[n].column for n, _ in remaining}
-                    for k in list(remaining):
-                        c = fmap[k[0]].column
-                        if c in fdeps and set(fdeps[c]) <= (rem_cols - {c}):
-                            remaining.remove(k)
-                            det.append(k)
-                            changed = True
-                            break
+                for k in list(remaining):
+                    if len(remaining) == 1:
+                        break
+                    cand_cols = {
+                        fmap[n].column for n, _ in remaining if n != k[0]
+                    }
+                    if fmap[k[0]].column in closure(cand_cols):
+                        remaining = [x for x in remaining if x[0] != k[0]]
+                        det.append(k)
                 if det:
                     passengers.extend(det)
                     ks = remaining
