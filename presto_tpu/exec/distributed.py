@@ -93,6 +93,23 @@ def _sortable(v):
     return HashAggregationOperator._sortable(v)
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=64)
+def _compact_step(mesh, out_cap: int):
+    """Compiled per-device compaction, cached per (mesh, capacity) so
+    repeated guarded replications reuse the XLA program."""
+    step = partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(WORKERS),),
+        out_specs=P(WORKERS),
+        check_vma=False,
+    )(lambda local: _compact_local(local, out_cap))
+    return jax.jit(step)
+
+
 def _compact_local(b: Batch, out_cap: int) -> Batch:
     """Gather live rows into a smaller-capacity batch (one nonzero +
     per-column gather). Caller guarantees live_count <= out_cap."""
@@ -198,14 +215,7 @@ class DistributedExecutor:
             # gather) so the all_gather moves live data, not padding
             cap2 = batch_capacity(max(rows, 16), minimum=16)
             if self.nworkers * cap2 < b.capacity:
-                step = partial(
-                    shard_map,
-                    mesh=self.mesh,
-                    in_specs=(P(WORKERS),),
-                    out_specs=P(WORKERS),
-                    check_vma=False,
-                )(lambda local: _compact_local(local, cap2))
-                b = jax.jit(step)(b)
+                b = _compact_step(self.mesh, cap2)(b)
         b = jax.device_put(b, replicated(self.mesh))
         return DistBatch(b, sharded=False)
 

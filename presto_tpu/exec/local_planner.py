@@ -51,6 +51,18 @@ MAX_GROUP_CAP = 1 << 20
 MAX_RETRIES = 6
 
 
+def _null_column(dtype, cap: int, tail: tuple = ()):
+    """An all-NULL column (zero data, invalid everywhere)."""
+    from presto_tpu.batch import Column
+
+    return Column(
+        jnp.zeros((cap,) + tail, dtype.jnp_dtype if not tail else jnp.uint8),
+        jnp.zeros(cap, jnp.bool_),
+        dtype,
+        None,
+    )
+
+
 def pick_group_strategy(keys, pax, dict_len, est_rows: int):
     """Grouping-strategy choice shared by the local and distributed
     executors: direct addressing for small dictionary-key domains,
@@ -89,11 +101,19 @@ def pick_group_strategy(keys, pax, dict_len, est_rows: int):
 
 
 class LocalExecutor:
-    def __init__(self, catalog: Catalog):
+    def __init__(self, catalog: Catalog, join_build_budget: int | None = None):
         self.catalog = catalog
         #: optional StatsRecorder for the current query (set by the
         #: Session; powers QueryInfo node stats and EXPLAIN ANALYZE)
         self.recorder = None
+        #: L9 capacity planner: estimated build sides above this byte
+        #: budget run as grouped (bucketed) execution with host-RAM
+        #: offload instead of one device-resident lookup source
+        if join_build_budget is None:
+            from presto_tpu.runtime.memory import device_budget_bytes
+
+            join_build_budget = device_budget_bytes() // 4
+        self.join_build_budget = join_build_budget
 
     # ------------------------------------------------------------------
     def run(self, plan: N.PlanNode):
@@ -221,7 +241,13 @@ class LocalExecutor:
                 return BatchStream.of(Pipeline(child, [op]).run())
             except ValueBitsOverflow:
                 aggs = [dataclasses.replace(a, value_bits=63) for a in aggs]
-            except CapacityOverflow:
+            except CapacityOverflow as e:
+                # only THIS aggregation's group overflow is retryable
+                # here — an overflow raised by the lazy child stream
+                # (e.g. a join under it) must propagate to its owner,
+                # not double our group capacity 6 times
+                if e.op != "HashAggregation":
+                    raise
                 if not isinstance(strategy, SortStrategy):
                     raise
                 strategy = SortStrategy(strategy.max_groups * 2)
@@ -298,9 +324,23 @@ class LocalExecutor:
 
     def _exec_join(self, node: N.Join, scalars):
         left = self._exec(node.left, scalars)
+        right_stream = self._exec(node.right, scalars)
+        # L9 capacity planning: a build side whose estimated bytes
+        # exceed the budget runs as grouped (Grace) execution — both
+        # sides hash-bucketed to host RAM, buckets joined sequentially
+        from presto_tpu.runtime.memory import estimate_node_bytes
+
+        est = estimate_node_bytes(node.right, self.catalog)
+        if est > self.join_build_budget:
+            lkey, rkey = self._join_key_exprs(
+                node.left_keys, node.right_keys, left, right_stream, scalars
+            )
+            return self._exec_grouped_join(
+                node, left, right_stream, lkey, rkey, est
+            )
         # the build side is inherently materialized (the lookup source
         # concatenates it); the PROBE side streams batch-by-batch
-        right = self._exec(node.right, scalars).materialize()
+        right = right_stream.materialize()
         lkey, rkey = self._join_key_exprs(
             node.left_keys, node.right_keys, left, right, scalars
         )
@@ -315,20 +355,20 @@ class LocalExecutor:
         if node.unique:
             op = LookupJoinOperator(build, lkey, outs, node.kind, unique=True)
             return left.map(lambda b: op.process(b)[0])
-        # expansion join with retry-doubling; the probe stream replays
-        # on overflow (regenerate-rather-than-hold, SURVEY §7.4 #1)
+        # expansion join with per-batch retry-doubling: probing is
+        # stateless per batch, so an overflow re-probes only the
+        # offending batch at a doubled capacity (and keeps the raised
+        # capacity for later batches). out_cap initializes lazily from
+        # the first probe batch actually processed — no peek pass over
+        # the upstream pipeline.
         right_rows = sum(live_count(b) for b in right)
-        first = left.peek()
-        out_cap = batch_capacity(
-            max(first.capacity if first is not None else 1024, right_rows, 1024)
-        )
-
-        # per-batch retry: expansion probing is stateless per batch, so
-        # an overflow re-probes only the offending batch at a doubled
-        # capacity (and keeps the raised capacity for later batches)
-        state = {"cap": out_cap, "ops": {}}
+        state = {"cap": None, "ops": {}}
 
         def probe(b):
+            if state["cap"] is None:
+                state["cap"] = batch_capacity(
+                    max(b.capacity, right_rows, 1024)
+                )
             for _ in range(MAX_RETRIES):
                 c = state["cap"]
                 op = state["ops"].get(c)
@@ -346,19 +386,149 @@ class LocalExecutor:
 
         return left.map(probe)
 
+    def _exec_grouped_join(self, node: N.Join, left, right_stream, lkey, rkey,
+                           est_bytes: int):
+        """Grouped (bucketed) join: both sides hash-spill to host RAM,
+        then each bucket runs the normal device join — HBM bounded by
+        one bucket's build plus one probe chunk (SURVEY §7.4 #5).
+
+        Compile economy: every bucket's build pads to ONE shared
+        capacity and every probe chunk to one shared capacity, and the
+        lookup operators (whose jitted steps take the build state as an
+        argument) are reused across buckets by swapping the shared
+        JoinBuildOperator's published state — O(distinct capacities)
+        XLA programs, not O(buckets x chunks).
+        """
+        from presto_tpu.exec.grouped import bucket_batches, spill_stream
+        from presto_tpu.runtime.memory import node_row_bytes
+
+        nbuckets = max(2, int(-(-est_bytes // max(self.join_build_budget, 1))))
+        rspill = spill_stream(right_stream, rkey, nbuckets)
+        lspill = spill_stream(left, lkey, nbuckets)
+        outs = [BuildOutput(n, n) for n in node.output_right]
+        rfields = {f.name: f for f in node.right.fields}
+        # probe chunks sized so a chunk stays well under the budget
+        probe_chunk = max(
+            1 << 14,
+            self.join_build_budget // max(node_row_bytes(node.left), 1) // 4,
+        )
+        build_cap = batch_capacity(
+            max(max((rspill.bucket_rows(b) for b in range(nbuckets)), default=0), 16),
+            minimum=16,
+        )
+        probe_cap = batch_capacity(
+            max(probe_chunk, lspill.max_chunk_rows(), 16), minimum=16
+        )
+        build = JoinBuildOperator(rkey, capacity=build_cap)
+        probe_ops: dict[tuple, LookupJoinOperator] = {}
+
+        def probe_op(cap: int | None) -> LookupJoinOperator:
+            key = ("u",) if cap is None else ("e", cap)
+            if key not in probe_ops:
+                probe_ops[key] = LookupJoinOperator(
+                    build, lkey, outs, node.kind,
+                    unique=cap is None, out_capacity=cap,
+                )
+            return probe_ops[key]
+
+        def null_build_cols(b: Batch) -> Batch:
+            cols = dict(b.columns)
+            g = b.capacity
+            for bo in outs:
+                f = rfields[bo.source]
+                tail = (f.dtype.width,) if f.dtype.kind is TypeKind.BYTES else ()
+                cols[bo.name] = _null_column(f.dtype, g, tail)
+            return Batch(cols, b.live)
+
+        state = {"cap": batch_capacity(max(build_cap, probe_cap, 1024))}
+
+        def make():
+            for bk in range(nbuckets):
+                build_batch = rspill.bucket_batch(bk, capacity=build_cap)
+                probe_chunks = bucket_batches(lspill, bk, probe_chunk, probe_cap)
+                if build_batch is None:
+                    if node.kind == "left":
+                        for pb in probe_chunks:
+                            yield null_build_cols(pb)
+                    continue
+                build.batches = [build_batch]
+                build.build_side = None
+                build.finish()
+                for pb in probe_chunks:
+                    if node.unique:
+                        yield probe_op(None).process(pb)[0]
+                        continue
+                    for _ in range(MAX_RETRIES):
+                        try:
+                            out = probe_op(state["cap"]).process(pb)[0]
+                            break
+                        except CapacityOverflow:
+                            state["cap"] *= 2
+                    else:
+                        raise CapacityOverflow("GroupedJoin", state["cap"])
+                    yield out
+
+        return BatchStream(make)
+
     def _exec_semijoin(self, node: N.SemiJoin, scalars):
         left = self._exec(node.left, scalars)
-        right = self._exec(node.right, scalars).materialize()
+        right_stream = self._exec(node.right, scalars)
+        jt = "anti" if node.negated else "semi"
+        from presto_tpu.runtime.memory import estimate_node_bytes
+
+        est = estimate_node_bytes(node.right, self.catalog)
+        if est > self.join_build_budget:
+            # grouped semi/anti: a probe key's existence is decided
+            # entirely by its own hash bucket, so bucketing is exact
+            # for both semi AND anti (an absent bucket means globally
+            # absent for anti rows routed there)
+            lkey, rkey = self._join_key_exprs(
+                node.left_keys, node.right_keys, left, right_stream, scalars
+            )
+            return self._exec_grouped_semijoin(left, right_stream, lkey, rkey, est, jt)
+        right = right_stream.materialize()
         lkey, rkey = self._join_key_exprs(
             node.left_keys, node.right_keys, left, right, scalars
         )
         dense = self._dense_domain(node.right, node.right_keys, right)
         build = JoinBuildOperator(rkey, dense_domain=dense)
         Pipeline(BatchSource(right), [build]).run()
-        op = LookupJoinOperator(
-            build, lkey, (), "anti" if node.negated else "semi"
-        )
+        op = LookupJoinOperator(build, lkey, (), jt)
         return left.map(lambda b: op.process(b)[0])
+
+    def _exec_grouped_semijoin(self, left, right_stream, lkey, rkey,
+                               est_bytes: int, jt: str):
+        from presto_tpu.exec.grouped import bucket_batches, spill_stream
+
+        nbuckets = max(2, int(-(-est_bytes // max(self.join_build_budget, 1))))
+        rspill = spill_stream(right_stream, rkey, nbuckets)
+        lspill = spill_stream(left, lkey, nbuckets)
+        probe_chunk = 1 << 18
+        build_cap = batch_capacity(
+            max(max((rspill.bucket_rows(b) for b in range(nbuckets)), default=0), 16),
+            minimum=16,
+        )
+        probe_cap = batch_capacity(
+            max(probe_chunk, lspill.max_chunk_rows(), 16), minimum=16
+        )
+        build = JoinBuildOperator(rkey, capacity=build_cap)
+        op = LookupJoinOperator(build, lkey, (), jt)
+
+        def make():
+            for bk in range(nbuckets):
+                build_batch = rspill.bucket_batch(bk, capacity=build_cap)
+                probe_chunks = bucket_batches(lspill, bk, probe_chunk, probe_cap)
+                if build_batch is None:
+                    if jt == "anti":  # nothing to exclude: all pass
+                        yield from probe_chunks
+                    continue
+                build.batches = [build_batch]
+                build.build_side = None
+                build.finish()
+                for pb in probe_chunks:
+                    yield op.process(pb)[0]
+
+        return BatchStream(make)
 
     # ---- window functions -----------------------------------------------
     def _exec_window(self, node: N.Window, scalars):

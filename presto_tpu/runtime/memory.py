@@ -1,0 +1,53 @@
+"""Device-memory budgeting — the L9 capacity planner.
+
+Reference parity: ``MemoryPool`` / ``QueryContext`` / the
+``MemoryRevokingScheduler``-triggered spill decision [SURVEY §2.1 L9
+rows, §7.4 #5]. TPU-first: there is no mid-operator revocation — XLA
+allocations are planned at compile time — so budgeting happens at PLAN
+time: the executor estimates a fragment's device-resident bytes from
+connector stats and chooses grouped (bucketed) execution with host-RAM
+offload BEFORE compiling, instead of reacting to pressure mid-flight.
+"""
+
+from __future__ import annotations
+
+from presto_tpu.plan import nodes as N
+from presto_tpu.types import DataType, TypeKind
+
+#: conservative default when the backend exposes no memory stats
+#: (v5e chip = 16 GB HBM; leave headroom for XLA scratch + outputs)
+DEFAULT_BUDGET_BYTES = 8 << 30
+
+
+def device_budget_bytes(device=None) -> int:
+    """Usable device memory for resident operator state."""
+    import jax
+
+    dev = device or jax.devices()[0]
+    try:
+        stats = dev.memory_stats()
+        if stats and "bytes_limit" in stats:
+            return int(stats["bytes_limit"] * 0.5)
+    except Exception:  # noqa: BLE001 — CPU/interpret backends
+        pass
+    return DEFAULT_BUDGET_BYTES
+
+
+def column_bytes(dtype: DataType) -> int:
+    """Per-row device bytes of a column (data + validity mask)."""
+    if dtype.kind is TypeKind.BYTES:
+        return dtype.width + 1
+    return dtype.np_dtype.itemsize + 1
+
+
+def node_row_bytes(node: N.PlanNode) -> int:
+    """Per-row device bytes of a node's output (+1 for the live mask)."""
+    return sum(column_bytes(f.dtype) for f in node.fields) + 1
+
+
+def estimate_node_bytes(node: N.PlanNode, catalog) -> int:
+    """Estimated device-resident bytes if the node's output were fully
+    materialized (stats-based; the grouped-execution trigger)."""
+    from presto_tpu.plan.bounds import estimate_rows
+
+    return estimate_rows(node, catalog) * node_row_bytes(node)

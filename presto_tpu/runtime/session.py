@@ -70,7 +70,11 @@ class Session:
         nested queries cross-contaminate each other's stats
         (reference parity: per-query SqlQueryExecution objects)."""
         if self.mesh is None:
-            return LocalExecutor(self.catalog)
+            budget = self.properties.get("join_build_budget_bytes")
+            return LocalExecutor(
+                self.catalog,
+                join_build_budget=int(budget) if budget is not None else None,
+            )
         from presto_tpu.exec.distributed import DistributedExecutor
 
         return DistributedExecutor(

@@ -32,9 +32,7 @@ def test_scan_is_lazy_and_streams_splits(monkeypatch):
 
     monkeypatch.setattr(conn, "scan", spy)
     stream = s.executor._exec(
-        s.plan("select l_orderkey from lineitem").child.child
-        if False else s.plan("select l_orderkey from lineitem").child,
-        {},
+        s.plan("select l_orderkey from lineitem").child, {}
     )
     assert calls == [], "scan must not run until the stream is drained"
     it = iter(stream)
@@ -67,19 +65,16 @@ def test_overflow_retry_replays_the_stream(monkeypatch):
     """A sort-strategy group overflow mid-stream retries at doubled
     capacity by REPLAYING the scan; a plain generator would come back
     empty and silently drop rows (the bug class this design avoids)."""
-    import presto_tpu.exec.local_planner as LP
-
     # lie about the expected row count so max_groups starts far too
     # small and the first attempt overflows after consuming batches
     import presto_tpu.plan.bounds as B
 
-    monkeypatch.setattr(LP, "MAX_GROUP_CAP", 1 << 20)
-    real = B.estimate_rows
     monkeypatch.setattr(B, "estimate_rows", lambda node, cat: 16)
 
     s = _session(units=1 << 11)
     got = s.sql("select l_orderkey, count(*) c from lineitem "
                 "group by l_orderkey order by l_orderkey")
+    monkeypatch.undo()
     li = s.catalog.connector("tpch").table_pandas("lineitem", ["l_orderkey"])
     want = (
         li.groupby("l_orderkey").size().rename("c").reset_index()
