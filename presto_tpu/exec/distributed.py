@@ -69,8 +69,9 @@ from presto_tpu.exec.pipeline import BatchSource, Pipeline
 from presto_tpu.expr import BIGINT, evaluate, bind_scalars
 from presto_tpu.ops.groupby import gather_padded, group_ids_sort, segment_agg
 from presto_tpu.ops.hashing import partition_ids
+from presto_tpu.ops.sort import sort_indices
 from presto_tpu.ops.join import build_lookup, probe_exists, probe_expand, probe_unique
-from presto_tpu.parallel.exchange import any_flag, exchange_local
+from presto_tpu.parallel.exchange import any_flag, exchange_multiround
 from presto_tpu.parallel.mesh import WORKERS, replicated, row_sharding
 from presto_tpu.plan import nodes as N
 from presto_tpu.plan.catalog import Catalog
@@ -142,11 +143,17 @@ class DistributedExecutor:
         mesh,
         broadcast_limit: int = 1 << 21,
         gather_limit: int = 1 << 22,
+        direct_group_limit: int | None = None,
     ):
+        from presto_tpu.exec.local_planner import DIRECT_LIMIT
+
         self.catalog = catalog
         self.mesh = mesh
         self.nworkers = int(mesh.devices.size)
         self.broadcast_limit = broadcast_limit
+        self.direct_group_limit = (
+            DIRECT_LIMIT if direct_group_limit is None else direct_group_limit
+        )
         #: row guard on replicate-everything fallbacks (window/sort/
         #: limit v1 paths): gathering N rows to EVERY device multiplies
         #: memory by the mesh size — fail fast with a clear message
@@ -344,7 +351,10 @@ class DistributedExecutor:
                 return len(first[name].dictionary)
             return None
 
-        strategy = pick_group_strategy(keys, pax, dict_len, live_count(first))
+        strategy = pick_group_strategy(
+            keys, pax, dict_len, live_count(first),
+            direct_limit=self.direct_group_limit,
+        )
         if isinstance(strategy, DirectStrategy):
             # small dense group domain: per-shard segment_sum + XLA
             # auto-reduction (the psum path of the Q1 fragment)
@@ -368,20 +378,25 @@ class DistributedExecutor:
         return self._dist_grouped_agg(d.batch, keys, aggs, pax)
 
     def _dist_grouped_agg(self, b: Batch, keys, aggs, pax) -> DistBatch:
-        """PARTIAL -> all_to_all(hash(keys)) -> FINAL, one compiled step."""
+        """PARTIAL -> all_to_all(hash(keys)) -> FINAL, one compiled step.
+
+        The exchange is the skew-aware multi-round shuffle: the wire
+        quota stays fixed (sized for the balanced case = one round);
+        retries double only the *receive* capacity, which overflows only
+        when one device genuinely owns more groups than planned."""
         Pn = self.nworkers
         cap_dev = b.capacity // Pn
         mg_partial = batch_capacity(cap_dev, minimum=64)
         quota = batch_capacity(-(-mg_partial // Pn), minimum=64)
 
+        mg_final = batch_capacity(Pn * quota, minimum=64)
         for _ in range(MAX_RETRIES):
-            mg_final = batch_capacity(Pn * quota, minimum=64)
             step = self._make_agg_step(keys, aggs, pax, mg_partial, quota, mg_final)
             out, overflow = step(b)
             if not bool(overflow):
                 return DistBatch(out, sharded=True)
-            quota *= 2
-        raise CapacityOverflow("DistributedAggregate", quota)
+            mg_final *= 2
+        raise CapacityOverflow("DistributedAggregate", mg_final)
 
     def _make_agg_step(self, keys, aggs, pax, mg: int, quota: int, mgf: int):
         Pn = self.nworkers
@@ -468,7 +483,7 @@ class DistributedExecutor:
             part, ovf1 = partial_phase(b)
             key_sort = [_sortable(part[n]) for n, _ in keys]
             pids = partition_ids(key_sort, Pn)
-            exch, ovf2 = exchange_local(part, pids, Pn, quota)
+            exch, ovf2 = exchange_multiround(part, pids, Pn, quota, mgf)
             out, ovf3 = final_phase(exch)
             return out, any_flag(ovf1 | ovf2 | ovf3)
 
@@ -559,25 +574,31 @@ class DistributedExecutor:
         rcap = right.batch.capacity // Pn
         lquota = batch_capacity(-(-lcap // Pn), minimum=64)
         rquota = batch_capacity(-(-rcap // Pn), minimum=64)
+        lrecv = batch_capacity(Pn * lquota, minimum=64)
+        rrecv = batch_capacity(Pn * rquota, minimum=64)
         expand = not node.unique and node.kind not in ("semi", "anti")
         out_cap = None
         if expand:
             out_cap = batch_capacity(max(Pn * lquota, 1024))
 
+        # skew-aware: wire quotas stay fixed (one round when balanced);
+        # retries double the receive/build/output capacities only
         for _ in range(MAX_RETRIES):
             step = self._make_repartition_join_step(
-                node, lkey, rkey, lquota, rquota, out_cap
+                node, lkey, rkey, lquota, rquota, lrecv, rrecv, out_cap
             )
             out, overflow = step(left.batch, right.batch)
             if not bool(overflow):
                 return DistBatch(out, sharded=True)
-            lquota *= 2
-            rquota *= 2
+            lrecv *= 2
+            rrecv *= 2
             if out_cap is not None:
                 out_cap *= 2
-        raise CapacityOverflow("RepartitionJoin", max(lquota, rquota))
+        raise CapacityOverflow("RepartitionJoin", max(lrecv, rrecv))
 
-    def _make_repartition_join_step(self, node, lkey, rkey, lquota, rquota, out_cap):
+    def _make_repartition_join_step(
+        self, node, lkey, rkey, lquota, rquota, lrecv, rrecv, out_cap
+    ):
         Pn = self.nworkers
         outs = [BuildOutput(n, n) for n in node.output_right]
         kind = node.kind
@@ -593,8 +614,8 @@ class DistributedExecutor:
             rv = evaluate(rkey, rb)
             lpids = partition_ids([lv.data.astype(jnp.int64)], Pn)
             rpids = partition_ids([rv.data.astype(jnp.int64)], Pn)
-            le, ovf1 = exchange_local(lb, lpids, Pn, lquota)
-            re, ovf2 = exchange_local(rb, rpids, Pn, rquota)
+            le, ovf1 = exchange_multiround(lb, lpids, Pn, lquota, lrecv)
+            re, ovf2 = exchange_multiround(rb, rpids, Pn, rquota, rrecv)
             bv = evaluate(rkey, re)
             build_cap = re.capacity
             side = build_lookup(bv.data, re.live & bv.valid, build_cap)
@@ -662,36 +683,290 @@ class DistributedExecutor:
 
     # ---- window functions ------------------------------------------------
     def _exec_window(self, node: N.Window, scalars) -> DistBatch:
-        """v1 distribution: gather then window locally (windows in the
-        TPC-H/DS shapes run post-aggregation on small inputs). The
-        partition-parallel variant (all_to_all by hash(partition keys),
-        windows device-local) is the planned upgrade."""
+        """Partition-parallel windows: all_to_all on hash(partition
+        keys) colocates each window partition on one device, then the
+        whole window computation (sort + segmented scans) runs
+        device-locally inside the same compiled step (reference:
+        WindowOperator below a FIXED_HASH exchange on the partition
+        keys [SURVEY §2.1, §2.4]). Windows with no PARTITION BY are one
+        global partition — inherently serial — and take the replicated
+        path (with its gather guard)."""
         from presto_tpu.exec.operators import window_operator_from_node
 
-        d = self._replicate(self._exec(node.child, scalars), guard="Window")
+        d = self._exec(node.child, scalars)
         op = window_operator_from_node(node, scalars)
+        if d.sharded and self.nworkers > 1 and node.partition_by:
+            part = [bind_scalars(e, scalars) for e in node.partition_by]
+            return self._partitioned_window(d, part, op)
+        d = self._replicate(d, guard="Window")
         out = Pipeline(BatchSource([d.batch]), [op]).run()
         return DistBatch(out[0], sharded=False)
 
-    # ---- ordering / limiting (gather exchanges: outputs are small) -------
+    def _partitioned_window(self, d: DistBatch, part_exprs, op) -> DistBatch:
+        Pn = self.nworkers
+        b = d.batch
+        cap_dev = max(b.capacity // Pn, 1)
+        quota = batch_capacity(-(-cap_dev // Pn), minimum=64)
+        recv_cap = batch_capacity(2 * cap_dev, minimum=64)
+        for _ in range(MAX_RETRIES):
+            step = self._make_window_step(part_exprs, op, quota, recv_cap)
+            out, overflow = step(b)
+            if not bool(overflow):
+                return DistBatch(out, sharded=True)
+            recv_cap *= 2
+        raise CapacityOverflow("PartitionedWindow", recv_cap)
+
+    def _make_window_step(self, part_exprs, op, quota: int, recv_cap: int):
+        from presto_tpu.ops.sort import bytes_sort_chunks
+
+        Pn = self.nworkers
+        window_body = op._make_step()
+
+        def hash_cols(local: Batch):
+            """int64 hash inputs per partition key: the null flag plus
+            null-normalized value chunks, so NULL keys form their own
+            colocated partition."""
+            cols = []
+            for e in part_exprs:
+                v = evaluate(e, local)
+                isnull = (~v.valid).astype(jnp.int64)
+                cols.append(isnull)
+                if v.dtype.kind is TypeKind.BYTES and v.dtype.width > 7:
+                    parts = bytes_sort_chunks(v.data)
+                else:
+                    parts = [_sortable(v).astype(jnp.int64)]
+                cols.extend(jnp.where(v.valid, p, 0) for p in parts)
+            return cols
+
+        @partial(
+            shard_map, mesh=self.mesh,
+            in_specs=(P(WORKERS),), out_specs=(P(WORKERS), P()),
+            check_vma=False,
+        )
+        def step(local: Batch):
+            pids = partition_ids(hash_cols(local), Pn)
+            exch, ovf = exchange_multiround(local, pids, Pn, quota, recv_cap)
+            out = window_body(exch)
+            return out, any_flag(ovf)
+
+        return jax.jit(step)
+
+    # ---- ordering / limiting ---------------------------------------------
     def _exec_sort(self, node: N.Sort, scalars) -> DistBatch:
-        d = self._replicate(self._exec(node.child, scalars), guard="Sort")
+        """Distributed sort: sample-based range partition on the first
+        sort key (all_to_all), then per-device full sort. Device i ends
+        up owning the i-th global key range, so concatenation in device
+        order — which is exactly what resharding to replicated does —
+        is globally sorted (reference: OrderByOperator + MergeOperator's
+        distributed merge of pre-sorted partitions [SURVEY §2.1]).
+
+        Ties on the first key colocate (searchsorted buckets), so
+        secondary keys are settled entirely device-locally. Degenerate
+        first keys (one dominant value) overflow the receive capacity;
+        after retries the replicated fallback (with its gather guard)
+        takes over.
+        """
+        d = self._exec(node.child, scalars)
         keys = [SortKey(bind_scalars(k.expr, scalars), k.descending, k.nulls_first)
                 for k in node.keys]
+        if d.sharded and self.nworkers > 1:
+            try:
+                return self._range_partition_sort(d, keys)
+            except CapacityOverflow:
+                pass  # pathological skew: fall through to replicate
+        d = self._replicate(d, guard="Sort")
         out = Pipeline(BatchSource([d.batch]), [OrderByOperator(keys)]).run()
         return DistBatch(out[0], sharded=False)
 
     def _exec_topn(self, node: N.TopN, scalars) -> DistBatch:
-        d = self._replicate(self._exec(node.child, scalars), guard="TopN")
+        """Local-first TopN: each device keeps its own top n, only the
+        P*n survivors are gathered for the final pass (reference:
+        partial TopN below the exchange [SURVEY §2.1 TopNOperator])."""
+        d = self._exec(node.child, scalars)
         keys = [SortKey(bind_scalars(k.expr, scalars), k.descending, k.nulls_first)
                 for k in node.keys]
+        if d.sharded and self.nworkers > 1:
+            d = self._local_topn(d, keys, node.count)
+        # normally P*n survivors; a huge n degenerates to replicating
+        # the table, which the gather guard must still catch
+        d = self._replicate(d, guard="TopN")
         out = Pipeline(BatchSource([d.batch]), [TopNOperator(keys, node.count)]).run()
         return DistBatch(out[0], sharded=False)
 
     def _exec_limit(self, node: N.Limit, scalars) -> DistBatch:
-        d = self._replicate(self._exec(node.child, scalars), guard="Limit")
+        """Local-first limit: each device keeps its first n live rows
+        (in row order — which preserves global order when the child is
+        range-partition sorted, since the true global prefix is a
+        per-device prefix), then the final limit runs on the small
+        gathered remainder."""
+        d = self._exec(node.child, scalars)
+        if d.sharded and self.nworkers > 1:
+            d = self._local_limit(d, node.count)
+        d = self._replicate(d, guard="Limit")
         out = Pipeline(BatchSource([d.batch]), [LimitOperator(node.count)]).run()
         return DistBatch(out[0], sharded=False)
+
+    # -- local-first prefix/topn bodies ------------------------------------
+    def _local_topn(self, d: DistBatch, keys, n: int) -> DistBatch:
+        b = d.batch
+        cap_dev = max(b.capacity // self.nworkers, 1)
+        cap_out = batch_capacity(min(n, cap_dev), minimum=16)
+
+        @partial(
+            shard_map, mesh=self.mesh,
+            in_specs=(P(WORKERS),), out_specs=P(WORKERS),
+            check_vma=False,
+        )
+        def step(local: Batch):
+            vals = [evaluate(k.expr, local) for k in keys]
+            order = sort_indices(
+                [v.data for v in vals],
+                [k.descending for k in keys],
+                local.live,
+                nulls_first=[k.nulls_first for k in keys],
+                valids=[v.valid for v in vals],
+            )
+            take = order[:cap_out]
+            cols = {
+                nm: Column(
+                    gather_rows(c.data, take, 0),
+                    gather_padded(c.valid, take, False),
+                    c.dtype, c.dictionary,
+                )
+                for nm, c in local.columns.items()
+            }
+            live = gather_padded(local.live, take, False)
+            live = live & (jnp.arange(cap_out) < n)
+            return Batch(cols, live)
+
+        return DistBatch(jax.jit(step)(b), sharded=True)
+
+    def _local_limit(self, d: DistBatch, n: int) -> DistBatch:
+        from presto_tpu.ops.compact import compact_indices
+
+        b = d.batch
+        cap_dev = max(b.capacity // self.nworkers, 1)
+        cap_out = batch_capacity(min(n, cap_dev), minimum=16)
+
+        @partial(
+            shard_map, mesh=self.mesh,
+            in_specs=(P(WORKERS),), out_specs=P(WORKERS),
+            check_vma=False,
+        )
+        def step(local: Batch):
+            live_rank = jnp.cumsum(local.live.astype(jnp.int64))
+            keep = local.live & (live_rank <= n)
+            idx, _, _ = compact_indices(keep, cap_out)
+            cols = {
+                nm: Column(
+                    gather_rows(c.data, idx, 0),
+                    gather_padded(c.valid, idx, False),
+                    c.dtype, c.dictionary,
+                )
+                for nm, c in local.columns.items()
+            }
+            return Batch(cols, gather_padded(local.live, idx, False))
+
+        return DistBatch(jax.jit(step)(b), sharded=True)
+
+    # -- range-partition distributed sort ----------------------------------
+    @staticmethod
+    def _sort_cmp(key: SortKey, batch: Batch):
+        """Null/direction-normalized comparison value for the first
+        sort key: ascending order of the returned array == the desired
+        SQL order. int64 keys stay int64 (wide BYTES use their most
+        significant 7-byte chunk — ties colocate), floats stay float."""
+        from presto_tpu.ops.sort import bytes_sort_chunks
+
+        v = evaluate(key.expr, batch)
+        if v.dtype.kind is TypeKind.BYTES and v.dtype.width > 7:
+            s = bytes_sort_chunks(v.data)[0]
+        else:
+            s = _sortable(v)
+        if key.descending:
+            s = -s if jnp.issubdtype(s.dtype, jnp.floating) else ~s.astype(jnp.int64)
+        if jnp.issubdtype(s.dtype, jnp.floating):
+            null_val = -jnp.inf if key.nulls_first else jnp.inf
+        else:
+            s = s.astype(jnp.int64)
+            info = jnp.iinfo(jnp.int64)
+            null_val = info.min if key.nulls_first else info.max
+        return jnp.where(v.valid, s, null_val)
+
+    def _range_partition_sort(self, d: DistBatch, keys) -> DistBatch:
+        Pn = self.nworkers
+        b = d.batch
+        cap_dev = max(b.capacity // Pn, 1)
+        nsamples = min(64, cap_dev)
+        k0 = keys[0]
+
+        @partial(
+            shard_map, mesh=self.mesh,
+            in_specs=(P(WORKERS),), out_specs=(P(WORKERS), P(WORKERS)),
+            check_vma=False,
+        )
+        def sample_step(local: Batch):
+            cmp = self._sort_cmp(k0, local)
+            order = sort_indices([cmp], [False], local.live)
+            cnt = jnp.sum(local.live.astype(jnp.int64))
+            pos = (jnp.arange(nsamples) * jnp.maximum(cnt, 1)) // nsamples
+            samp = gather_padded(cmp[order], pos, 0)
+            ok = jnp.arange(nsamples) < cnt
+            return samp[None, :], ok[None, :]
+
+        samp, ok = jax.jit(sample_step)(b)
+        samp = np.asarray(samp).reshape(-1)
+        ok = np.asarray(ok).reshape(-1)
+        pool = np.sort(samp[ok])
+        if pool.size == 0:
+            return d  # no live rows anywhere: nothing to sort
+        # P-1 evenly spaced splitters over the pooled sample
+        sel = (np.arange(1, Pn) * pool.size) // Pn
+        splitters = jnp.asarray(pool[sel])
+
+        quota = batch_capacity(-(-cap_dev // Pn), minimum=64)
+        recv_cap = batch_capacity(2 * cap_dev, minimum=64)
+        for _ in range(MAX_RETRIES):
+            step = self._make_range_sort_step(keys, splitters, quota, recv_cap)
+            out, overflow = step(b)
+            if not bool(overflow):
+                return DistBatch(out, sharded=True)
+            recv_cap *= 2
+        raise CapacityOverflow("RangePartitionSort", recv_cap)
+
+    def _make_range_sort_step(self, keys, splitters, quota: int, recv_cap: int):
+        Pn = self.nworkers
+        k0 = keys[0]
+
+        @partial(
+            shard_map, mesh=self.mesh,
+            in_specs=(P(WORKERS),), out_specs=(P(WORKERS), P()),
+            check_vma=False,
+        )
+        def step(local: Batch):
+            cmp = self._sort_cmp(k0, local)
+            pids = jnp.searchsorted(splitters, cmp, side="right").astype(jnp.int32)
+            exch, ovf = exchange_multiround(local, pids, Pn, quota, recv_cap)
+            vals = [evaluate(k.expr, exch) for k in keys]
+            order = sort_indices(
+                [v.data for v in vals],
+                [k.descending for k in keys],
+                exch.live,
+                nulls_first=[k.nulls_first for k in keys],
+                valids=[v.valid for v in vals],
+            )
+            cols = {
+                nm: Column(
+                    gather_rows(c.data, order, 0),
+                    gather_padded(c.valid, order, False),
+                    c.dtype, c.dictionary,
+                )
+                for nm, c in exch.columns.items()
+            }
+            out = Batch(cols, gather_padded(exch.live, order, False))
+            return out, any_flag(ovf)
+
+        return jax.jit(step)
 
     # ---- scalar subqueries ----------------------------------------------
     def _exec_bindscalars(self, node: N.BindScalars, scalars) -> DistBatch:

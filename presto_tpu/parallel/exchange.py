@@ -86,6 +86,116 @@ def exchange_local(batch: Batch, pids, num_partitions: int, quota: int):
     return Batch(cols, live), overflow
 
 
+def exchange_multiround(
+    batch: Batch,
+    pids,
+    num_partitions: int,
+    quota: int,
+    recv_cap: int,
+    max_rounds: int | None = None,
+):
+    """Skew-aware per-device shuffle body: multi-round, fixed wire quota.
+
+    The single-round ``exchange_local`` couples the *wire* quota (rows
+    per destination per ``all_to_all``) to the *receive* capacity
+    (``P * quota``): one hot key forces the host to double the quota and
+    recompile the whole fragment step (SURVEY §7.4 #4). Here the two are
+    decoupled — the moral equivalent of the reference's token-paged
+    ``ExchangeClient`` pulls (a bounded buffer drained over as many
+    round trips as the data needs [SURVEY §2.5]):
+
+    - every round moves at most ``quota`` rows per (sender, dest) pair
+      through one ``all_to_all``; undelivered rows wait for the next
+      round (``lax.while_loop`` — rounds are data-dependent but the
+      program is compiled once);
+    - receivers append compacted rows into a ``recv_cap`` buffer;
+      overflow now means "this device *owns* more rows than recv_cap"
+      (true placement skew), never "one destination was hot this round".
+
+    Returns ``(received, overflow)`` like ``exchange_local``; overflow
+    is this device's receive-side flag OR an undrained-after-
+    ``max_rounds`` flag (psum across the axis before acting).
+    """
+    P = num_partitions
+    cap = batch.live.shape[0]
+    if max_rounds is None:
+        # a sender drains at most `cap` rows to one destination
+        max_rounds = max(1, -(-cap // quota))
+    names = list(batch.columns)
+
+    def empty_buf(c: Column):
+        tail = tuple(c.data.shape[1:])
+        return (
+            jnp.zeros((recv_cap,) + tail, c.data.dtype),
+            jnp.zeros(recv_cap, jnp.bool_),
+        )
+
+    def any_pending(remaining):
+        # psum lives in the body (a collective in the while cond is
+        # not portable); the cond reads the carried flag
+        return jax.lax.psum(jnp.any(remaining).astype(jnp.int32), WORKERS) > 0
+
+    init = (
+        batch.live,  # remaining: rows not yet delivered
+        any_pending(batch.live),  # pending anywhere on the axis
+        jnp.zeros((), jnp.int64),  # receive write offset
+        jnp.zeros((), jnp.bool_),  # receive-side overflow
+        jnp.zeros((), jnp.int32),  # round counter
+        {n: empty_buf(batch.columns[n]) for n in names},
+    )
+
+    def cond(state):
+        _remaining, pending, _off, _ovf, rnd, _bufs = state
+        return pending & (rnd < max_rounds)
+
+    def body(state):
+        remaining, _pending, off, ovf, rnd, bufs = state
+        slot, _counts, _ = partition_layout(pids, remaining, P, quota)
+        sent = remaining & (slot < P * quota)
+
+        def send_recv(values, fill=0):
+            buf = scatter_to_buffer(values, slot, P, quota, fill)
+            return _a2a(buf).reshape((P * quota,) + values.shape[1:])
+
+        got = send_recv(sent, False)
+        pos = off + jnp.cumsum(got.astype(jnp.int64)) - 1
+        pos = jnp.where(got, pos, recv_cap)  # dead slots drop
+        total = jnp.sum(got.astype(jnp.int64))
+
+        new_bufs = {}
+        for n in names:
+            c = batch.columns[n]
+            data, valid = bufs[n]
+            rdata = send_recv(c.data)
+            rvalid = send_recv(c.valid, False)
+            new_bufs[n] = (
+                data.at[pos].set(rdata, mode="drop"),
+                valid.at[pos].set(rvalid, mode="drop"),
+            )
+        new_off = off + total
+        new_remaining = remaining & ~sent
+        return (
+            new_remaining,
+            any_pending(new_remaining),
+            new_off,
+            ovf | (new_off > recv_cap),
+            rnd + 1,
+            new_bufs,
+        )
+
+    remaining, _pending, off, ovf, _rnd, bufs = jax.lax.while_loop(
+        cond, body, init
+    )
+    undrained = jnp.any(remaining)
+    cols = {
+        n: Column(bufs[n][0], bufs[n][1], batch.columns[n].dtype,
+                  batch.columns[n].dictionary)
+        for n in names
+    }
+    live = jnp.arange(recv_cap) < off
+    return Batch(cols, live), ovf | undrained
+
+
 def broadcast_local(batch: Batch) -> Batch:
     """Per-device broadcast body: every device ends up with all rows
     (reference: BroadcastOutputBuffer / REPLICATED join distribution)."""
@@ -124,6 +234,32 @@ def make_shuffle_step(mesh, num_partitions: int, quota: int):
     )
     def step(batch: Batch, pids):
         out, ovf = exchange_local(batch, pids, num_partitions, quota)
+        return out, any_flag(ovf)
+
+    return jax.jit(step)
+
+
+def make_multiround_shuffle_step(
+    mesh, num_partitions: int, quota: int, recv_cap: int
+):
+    """jitted (sharded Batch, sharded pids) -> (sharded Batch, overflow)
+    using the skew-aware multi-round exchange: a zipfian key stream
+    completes at a small fixed wire quota instead of forcing the host
+    to double-and-recompile (SURVEY §7.4 #4)."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(WORKERS), P(WORKERS)),
+        out_specs=(P(WORKERS), P()),
+        check_vma=False,
+    )
+    def step(batch: Batch, pids):
+        out, ovf = exchange_multiround(
+            batch, pids, num_partitions, quota, recv_cap
+        )
         return out, any_flag(ovf)
 
     return jax.jit(step)

@@ -100,6 +100,68 @@ def test_shuffle_overflow_flag(mesh, rng):
     assert bool(overflow)
 
 
+def test_multiround_shuffle_drains_zipfian_skew(mesh, rng):
+    """Skew-aware exchange (SURVEY §7.4 #4): a zipfian key stream —
+    one hot destination — completes at a small FIXED wire quota via
+    extra rounds, where the single-round exchange would overflow and
+    force a host-side quota doubling + recompile."""
+    from presto_tpu.parallel.exchange import make_multiround_shuffle_step
+
+    n = 8
+    cap = 8 * 512
+    # zipf-ish: ~70% of rows share one hot key -> one hot partition
+    hot = rng.random(cap) < 0.7
+    k = np.where(hot, 7, rng.integers(0, 1000, cap)).astype(np.int64)
+    v = rng.normal(size=cap)
+    from presto_tpu.batch import Batch as B
+
+    b = B.from_numpy({"k": k, "v": v}, {"k": BIGINT, "v": DOUBLE}, count=cap - 9)
+    sharded = jax.device_put(b, row_sharding(mesh))
+    pids = jax.device_put(
+        partition_ids([sharded["k"].data], n), row_sharding(mesh)
+    )
+    # wire quota 64 rows/dest/round: the hot device receives ~2850 rows
+    # (>> 8*64 per round) yet the step completes without overflow
+    step = make_multiround_shuffle_step(mesh, n, quota=64, recv_cap=4096)
+    out, overflow = step(sharded, pids)
+    assert not bool(overflow)
+    live_in, live_out = np.asarray(b.live), np.asarray(out.live)
+    got = sorted(
+        zip(
+            np.asarray(out["k"].data)[live_out].tolist(),
+            np.round(np.asarray(out["v"].data)[live_out], 9).tolist(),
+        )
+    )
+    want = sorted(
+        zip(
+            np.asarray(b["k"].data)[live_in].tolist(),
+            np.round(np.asarray(b["v"].data)[live_in], 9).tolist(),
+        )
+    )
+    assert got == want
+    # rows landed on their hash owners
+    kk = np.asarray(out["k"].data)
+    owner = np.asarray(partition_ids([jax.numpy.asarray(kk)], n))
+    dev_of_row = np.arange(out.capacity) // (out.capacity // n)
+    assert (owner[live_out] == dev_of_row[live_out]).all()
+
+
+def test_multiround_shuffle_receive_overflow_flag(mesh, rng):
+    """Overflow now means true placement skew: a device owning more
+    rows than recv_cap trips the flag (host doubles recv capacity)."""
+    from presto_tpu.parallel.exchange import make_multiround_shuffle_step
+
+    n = 8
+    b = _random_batch(rng, 8 * 512)
+    sharded = jax.device_put(b, row_sharding(mesh))
+    zeros = jax.device_put(
+        jax.numpy.zeros(8 * 512, jax.numpy.int32), row_sharding(mesh)
+    )
+    step = make_multiround_shuffle_step(mesh, n, quota=64, recv_cap=256)
+    _, overflow = step(sharded, zeros)
+    assert bool(overflow)
+
+
 def test_broadcast_replicates_all_rows(mesh, rng):
     b = _random_batch(rng, 8 * 64)
     sharded = jax.device_put(b, row_sharding(mesh))
@@ -109,6 +171,120 @@ def test_broadcast_replicates_all_rows(mesh, rng):
     live_out = np.asarray(out.live)
     assert sorted(np.asarray(out["k"].data)[live_out].tolist()) == sorted(
         np.asarray(b["k"].data)[live_in].tolist()
+    )
+
+
+# ---------------------------------------------------------------------------
+# distributed sort / topN / limit (no full replication)
+# ---------------------------------------------------------------------------
+
+
+def _sort_env(mesh, rows=8 * 2048, gather_limit=1024):
+    """A session whose gather guard is far below the table size: any
+    replicate-everything fallback in sort/topN/limit trips the guard,
+    so passing proves the local-first / range-partition paths ran."""
+    conn = TpchConnector(sf=0.01, units_per_split=1 << 14)
+    session = Session(
+        {"tpch": conn},
+        mesh=mesh,
+        properties={"gather_row_limit": gather_limit},
+    )
+    return session, conn
+
+
+def test_distributed_order_by_without_replication(mesh):
+    session, conn = _sort_env(mesh)
+    df = session.sql(
+        "select l_orderkey, l_extendedprice from lineitem order by l_extendedprice desc, l_orderkey"
+    )
+    li = conn.table_pandas("lineitem")
+    want = li.sort_values(
+        ["l_extendedprice", "l_orderkey"], ascending=[False, True], kind="stable"
+    ).reset_index(drop=True)
+    assert len(df) == len(want)
+    np.testing.assert_array_equal(
+        df["l_extendedprice"].to_numpy(), want["l_extendedprice"].to_numpy()
+    )
+    # orderkey must be ascending within equal-price runs; spot-check
+    # global sortedness of the (price desc, key asc) pair
+    p = df["l_extendedprice"].to_numpy()
+    k = df["l_orderkey"].to_numpy()
+    assert ((p[:-1] > p[1:]) | ((p[:-1] == p[1:]) & (k[:-1] <= k[1:]))).all()
+
+
+def test_distributed_topn_without_replication(mesh):
+    session, conn = _sort_env(mesh)
+    df = session.sql(
+        "select l_orderkey, l_extendedprice from lineitem "
+        "order by l_extendedprice desc, l_orderkey limit 25"
+    )
+    li = conn.table_pandas("lineitem")
+    want = (
+        li.sort_values(
+            ["l_extendedprice", "l_orderkey"], ascending=[False, True], kind="stable"
+        )
+        .head(25)
+        .reset_index(drop=True)
+    )
+    np.testing.assert_array_equal(
+        df["l_orderkey"].to_numpy(), want["l_orderkey"].to_numpy()
+    )
+    np.testing.assert_array_equal(
+        df["l_extendedprice"].to_numpy(), want["l_extendedprice"].to_numpy()
+    )
+
+
+def test_distributed_limit_without_replication(mesh):
+    session, conn = _sort_env(mesh)
+    df = session.sql("select l_orderkey from lineitem limit 100")
+    assert len(df) == 100
+    # any 100 rows of the table qualify; check membership
+    keys = set(conn.table_pandas("lineitem")["l_orderkey"].tolist())
+    assert set(df["l_orderkey"].tolist()) <= keys
+
+
+def test_distributed_window_partition_parallel(mesh):
+    """PARTITION BY windows run via all_to_all on the partition keys
+    with a gather guard far below the table size: passing proves no
+    full replication happened."""
+    session, conn = _sort_env(mesh)
+    df = session.sql(
+        "select l_orderkey, l_linenumber, "
+        "       sum(l_quantity) over (partition by l_orderkey) as order_qty, "
+        "       row_number() over (partition by l_orderkey order by l_linenumber) as rn "
+        "from lineitem"
+    )
+    li = conn.table_pandas("lineitem")
+    want_qty = li.groupby("l_orderkey")["l_quantity"].transform("sum")
+    li = li.assign(order_qty=want_qty)
+    li["rn"] = (
+        li.sort_values(["l_orderkey", "l_linenumber"], kind="stable")
+        .groupby("l_orderkey")
+        .cumcount()
+        + 1
+    )
+    got = df.sort_values(["l_orderkey", "l_linenumber"]).reset_index(drop=True)
+    want = li.sort_values(["l_orderkey", "l_linenumber"]).reset_index(drop=True)
+    np.testing.assert_allclose(
+        got["order_qty"].to_numpy(), want["order_qty"].to_numpy(), rtol=1e-9
+    )
+    np.testing.assert_array_equal(got["rn"].to_numpy(), want["rn"].to_numpy())
+
+
+def test_distributed_sort_skewed_first_key(mesh):
+    """Degenerate first key (one dominant value): range partitioning
+    overflows and the executor falls back without wrong results."""
+    session, conn = _sort_env(mesh, gather_limit=1 << 22)
+    df = session.sql(
+        "select l_linenumber, l_orderkey from lineitem "
+        "order by l_linenumber, l_orderkey"
+    )
+    li = conn.table_pandas("lineitem")
+    want = li.sort_values(
+        ["l_linenumber", "l_orderkey"], kind="stable"
+    ).reset_index(drop=True)
+    np.testing.assert_array_equal(
+        df["l_orderkey"].to_numpy(), want["l_orderkey"].to_numpy()
     )
 
 
@@ -157,9 +333,12 @@ def test_repartition_join_path(mesh, name):
 
 
 def test_gather_fallback_guard(mesh):
-    """The replicate-everything window/sort fallbacks must fail fast
-    with a clear error above gather_row_limit instead of silently
-    multiplying memory by the mesh size (round-1 advisor finding)."""
+    """The remaining replicate-everything fallback (a global window —
+    no PARTITION BY means one inherently serial partition) must fail
+    fast with a clear error above gather_row_limit instead of silently
+    multiplying memory by the mesh size (round-1 advisor finding).
+    Sort/topN/limit and partitioned windows no longer replicate, so
+    they run fine under the same tiny guard (tests above)."""
     import pytest
 
     from presto_tpu.connectors.tpch import TpchConnector
@@ -172,7 +351,12 @@ def test_gather_fallback_guard(mesh):
         mesh=mesh,
     )
     with pytest.raises(CapacityOverflow, match="gather_limit"):
-        s.sql("select l_orderkey from lineitem order by l_orderkey")
+        s.sql(
+            "select l_orderkey, "
+            "row_number() over (order by l_orderkey) rn from lineitem"
+        )
     # small inputs still pass through the fallback (region: 5 rows < 16)
-    df = s.sql("select r_name from region order by r_name limit 3")
-    assert len(df) == 3
+    df = s.sql(
+        "select r_name, row_number() over (order by r_name) rn from region"
+    )
+    assert len(df) == 5
