@@ -1,0 +1,205 @@
+"""CLI / REPL: ``python -m presto_tpu``.
+
+Reference parity: the ``presto-cli`` console — interactive statement
+loop with EXPLAIN / EXPLAIN ANALYZE, ``SET SESSION`` / ``SHOW
+SESSION`` / ``SHOW TABLES``, and one-shot ``--execute`` mode
+[SURVEY §2.1 client rows, §7.2 step 7]. Single-controller: the
+"server" is the in-process ``Session``; there is no wire protocol to
+speak, so the CLI is a thin loop over it.
+
+Examples::
+
+    python -m presto_tpu --catalog tpch --sf 0.01
+    python -m presto_tpu --catalog tpcds --sf 0.001 \
+        -e "select count(*) from store_sales"
+    python -m presto_tpu --mesh 8        # distributed over 8 devices
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def make_connector(catalog: str, sf: float):
+    if catalog == "tpch":
+        from presto_tpu.connectors.tpch import TpchConnector
+
+        return TpchConnector(sf=sf)
+    if catalog == "tpcds":
+        from presto_tpu.connectors.tpcds import TpcdsConnector
+
+        return TpcdsConnector(sf=sf)
+    if catalog == "ssb":
+        from presto_tpu.connectors.ssb import SsbConnector
+
+        return SsbConnector(sf=sf)
+    raise SystemExit(f"unknown catalog {catalog!r} (tpch, tpcds, ssb)")
+
+
+HELP = """\
+Statements end with ';'. Besides SQL:
+  EXPLAIN <query>;            show the optimized plan
+  EXPLAIN ANALYZE <query>;    execute and annotate the plan with actuals
+  SET SESSION <name> = <value>;
+  SHOW SESSION;               list session properties
+  SHOW TABLES;                list tables in the catalog
+  HELP;  QUIT; / EXIT;
+"""
+
+
+def split_statements(text: str) -> list[str]:
+    """Split on ';' outside single/double-quoted strings (a quoted
+    ``';'`` must not end a statement)."""
+    out, buf, quote = [], [], None
+    for ch in text:
+        if quote:
+            buf.append(ch)
+            if ch == quote:
+                quote = None
+        elif ch in ("'", '"'):
+            quote = ch
+            buf.append(ch)
+        elif ch == ";":
+            out.append("".join(buf))
+            buf = []
+        else:
+            buf.append(ch)
+    if "".join(buf).strip():
+        out.append("".join(buf))
+    return [s for s in out if s.strip()]
+
+
+def _print_df(df, max_rows: int):
+    import pandas as pd
+
+    with pd.option_context(
+        "display.max_rows", max_rows, "display.width", 200,
+        "display.max_columns", 50,
+    ):
+        print(df.to_string(index=False))
+    print(f"({len(df)} row{'s' if len(df) != 1 else ''})")
+
+
+def run_statement(session, stmt: str, max_rows: int = 100) -> bool:
+    """Execute one statement; returns False to quit the loop."""
+    s = stmt.strip().rstrip(";").strip()
+    if not s:
+        return True
+    low = s.lower()
+    if low in ("quit", "exit"):
+        return False
+    if low == "help":
+        print(HELP, end="")
+        return True
+    if low == "show session":
+        for name, value, desc in session.show_session():
+            print(f"{name} = {value}")
+            print(f"    {desc}")
+        return True
+    if low == "show tables":
+        for cat, conn in session.catalog.connectors.items():
+            for t in conn.tables():
+                print(f"{cat}.{t}")
+        return True
+    if low.startswith("set session"):
+        rest = s[len("set session"):].strip()
+        if "=" not in rest:
+            print("usage: SET SESSION <name> = <value>", file=sys.stderr)
+            return True
+        name, _, value = rest.partition("=")
+        value = value.strip().strip("'\"")
+        try:
+            session.set_property(name.strip(), value)
+            print(f"SET {name.strip()} = {session.prop(name.strip())}")
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+        return True
+    try:
+        if low.startswith("explain analyze"):
+            print(session.explain_analyze(s[len("explain analyze"):]))
+        elif low.startswith("explain"):
+            print(session.explain(s[len("explain"):]))
+        else:
+            t0 = time.perf_counter()
+            df = session.sql(s)
+            wall = time.perf_counter() - t0
+            _print_df(df, max_rows)
+            print(f"[{wall:.3f}s]")
+    except Exception as e:  # REPL survives bad statements
+        print(f"error: {type(e).__name__}: {e}", file=sys.stderr)
+    return True
+
+
+def repl(session, max_rows: int):
+    print("presto-tpu REPL — HELP; for commands, QUIT; to leave")
+    buf: list[str] = []
+    while True:
+        try:
+            prompt = "presto> " if not buf else "     -> "
+            line = input(prompt)
+        except EOFError:
+            print()
+            return
+        except KeyboardInterrupt:
+            buf.clear()
+            print()
+            continue
+        buf.append(line)
+        joined = "\n".join(buf)
+        if ";" not in line:
+            continue
+        buf.clear()
+        if not run_statement(session, joined, max_rows):
+            return
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m presto_tpu", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("--catalog", default="tpch",
+                    help="tpch | tpcds | ssb (default tpch)")
+    ap.add_argument("--sf", type=float, default=0.01,
+                    help="scale factor (default 0.01)")
+    ap.add_argument("--mesh", type=int, default=None, metavar="N",
+                    help="run distributed over an N-device mesh")
+    ap.add_argument("-e", "--execute", default=None, metavar="SQL",
+                    help="execute one statement and exit")
+    ap.add_argument("-f", "--file", default=None,
+                    help="execute ';'-separated statements from a file")
+    ap.add_argument("--max-rows", type=int, default=100)
+    ap.add_argument("--session", action="append", default=[],
+                    metavar="NAME=VALUE", help="initial session property")
+    args = ap.parse_args(argv)
+
+    from presto_tpu.runtime.session import Session
+
+    props = {}
+    for kv in args.session:
+        name, _, value = kv.partition("=")
+        props[name.strip()] = value.strip()
+    mesh = None
+    if args.mesh is not None:
+        from presto_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(args.mesh)
+    conn = make_connector(args.catalog, args.sf)
+    session = Session({args.catalog: conn}, properties=props, mesh=mesh)
+
+    if args.execute is not None:
+        run_statement(session, args.execute, args.max_rows)
+        return
+    if args.file is not None:
+        with open(args.file) as f:
+            text = f.read()
+        for stmt in split_statements(text):
+            run_statement(session, stmt, args.max_rows)
+        return
+    repl(session, args.max_rows)
+
+
+if __name__ == "__main__":
+    main()

@@ -63,7 +63,8 @@ def _null_column(dtype, cap: int, tail: tuple = ()):
     )
 
 
-def pick_group_strategy(keys, pax, dict_len, est_rows: int):
+def pick_group_strategy(keys, pax, dict_len, est_rows: int,
+                        direct_limit: int = DIRECT_LIMIT):
     """Grouping-strategy choice shared by the local and distributed
     executors: direct addressing for small dictionary-key domains,
     bounded merge-by-sort otherwise (see module docstring).
@@ -87,7 +88,7 @@ def pick_group_strategy(keys, pax, dict_len, est_rows: int):
                 ok = False
                 break
             domains.append(d)
-        if ok and domains and int(np.prod(domains)) <= DIRECT_LIMIT:
+        if ok and domains and int(np.prod(domains)) <= direct_limit:
             strides = []
             acc = 1
             for d in reversed(domains):
@@ -101,7 +102,8 @@ def pick_group_strategy(keys, pax, dict_len, est_rows: int):
 
 
 class LocalExecutor:
-    def __init__(self, catalog: Catalog, join_build_budget: int | None = None):
+    def __init__(self, catalog: Catalog, join_build_budget: int | None = None,
+                 direct_group_limit: int = DIRECT_LIMIT):
         self.catalog = catalog
         #: optional StatsRecorder for the current query (set by the
         #: Session; powers QueryInfo node stats and EXPLAIN ANALYZE)
@@ -114,6 +116,7 @@ class LocalExecutor:
 
             join_build_budget = device_budget_bytes() // 4
         self.join_build_budget = join_build_budget
+        self.direct_group_limit = direct_group_limit
 
     # ------------------------------------------------------------------
     def run(self, plan: N.PlanNode):
@@ -261,7 +264,8 @@ class LocalExecutor:
             return len(d) if d is not None else None
 
         return pick_group_strategy(
-            keys, pax, dict_len, estimate_rows(node.child, self.catalog)
+            keys, pax, dict_len, estimate_rows(node.child, self.catalog),
+            direct_limit=self.direct_group_limit,
         )
 
     # ---- joins -----------------------------------------------------------

@@ -1,0 +1,152 @@
+"""Session property registry: every engine knob, typed and validated.
+
+Reference parity: ``SystemSessionProperties`` — the rule that every
+perf-relevant config default is also a per-query/session overridable
+property, with typed validation and unknown-property rejection at the
+door (Airlift config binding fails startup on unknown keys)
+[SURVEY §2.1 session/config row, §5.6].
+
+The registry is the single source of truth: ``Session`` validates its
+``properties`` mapping against it, the REPL's ``SET SESSION`` /
+``SHOW SESSION`` statements read it, and executors pull their knobs
+through ``Session.prop()``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+from presto_tpu.exec.local_planner import DIRECT_LIMIT
+
+
+@dataclass(frozen=True)
+class PropertyDef:
+    name: str
+    py_type: type
+    default: Any
+    description: str
+    #: extra constraint beyond the type (returns problem string or None)
+    check: Optional[Callable[[Any], Optional[str]]] = None
+
+    def coerce(self, value):
+        """Coerce a user-supplied value (possibly a SQL literal string)
+        to the property's type; raises ValueError with the property
+        name on any mismatch."""
+        if value is None:
+            return None
+        try:
+            if self.py_type is bool:
+                if isinstance(value, bool):
+                    v = value
+                elif isinstance(value, str):
+                    s = value.strip().lower()
+                    if s in ("true", "1", "on", "yes"):
+                        v = True
+                    elif s in ("false", "0", "off", "no"):
+                        v = False
+                    else:
+                        raise ValueError(s)
+                else:
+                    v = bool(value)
+            elif self.py_type is int:
+                v = int(value)
+            elif self.py_type is float:
+                v = float(value)
+            else:
+                v = self.py_type(value)
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"session property {self.name}: cannot interpret "
+                f"{value!r} as {self.py_type.__name__}"
+            ) from None
+        if self.check is not None:
+            problem = self.check(v)
+            if problem:
+                raise ValueError(f"session property {self.name}: {problem}")
+        return v
+
+
+def _positive(v):
+    return None if v > 0 else f"must be positive, got {v}"
+
+
+def _non_negative(v):
+    return None if v >= 0 else f"must be >= 0, got {v}"
+
+
+SESSION_PROPERTIES: dict[str, PropertyDef] = {
+    p.name: p
+    for p in [
+        PropertyDef(
+            "broadcast_join_row_limit", int, 1 << 21,
+            "Build sides with at most this many rows use the broadcast "
+            "(all_gather REPLICATED) join distribution; larger builds "
+            "repartition both sides (FIXED_HASH all_to_all). 0 disables "
+            "broadcast joins entirely.",
+            _non_negative,
+        ),
+        PropertyDef(
+            "gather_row_limit", int, 1 << 22,
+            "Guard on replicate-everything fallbacks (global-partition "
+            "windows, degenerate-key sorts, unsharded build sides): "
+            "replicating more rows than this to every device fails fast "
+            "instead of multiplying HBM use by the mesh size.",
+            _positive,
+        ),
+        PropertyDef(
+            "join_build_budget_bytes", int, None,
+            "L9 capacity planner: estimated join build sides above this "
+            "byte budget run as grouped (bucketed) execution with "
+            "host-RAM offload. Default: device HBM / 4.",
+            _positive,
+        ),
+        PropertyDef(
+            "direct_group_limit", int, DIRECT_LIMIT,
+            "Grouped aggregation uses dense direct addressing when the "
+            "product of the key dictionary domains is at most this; "
+            "larger domains use the bounded sort-based strategy.",
+            _positive,
+        ),
+        PropertyDef(
+            "collect_node_stats", bool, False,
+            "Record per-plan-node wall time and output rows on every "
+            "query (the EXPLAIN ANALYZE recorder, always on).",
+        ),
+        PropertyDef(
+            "query_retries", int, 0,
+            "Transparent query-level retries on execution failure — the "
+            "engine's whole failure-recovery posture (like the "
+            "reference, there is no mid-query recovery; see README "
+            "'Failure posture').",
+            _non_negative,
+        ),
+        PropertyDef(
+            "pallas_strings", bool, None,
+            "Force the Pallas string-predicate kernels on or off "
+            "(process-wide; default: on when running on TPU). Mirrors "
+            "the PRESTO_TPU_PALLAS environment variable.",
+        ),
+    ]
+}
+
+
+def validate_properties(props: dict) -> dict:
+    """Coerce + validate a property mapping; unknown names are errors
+    (the reference fails startup on unknown config keys)."""
+    out = {}
+    for name, value in props.items():
+        d = SESSION_PROPERTIES.get(name)
+        if d is None:
+            known = ", ".join(sorted(SESSION_PROPERTIES))
+            raise ValueError(
+                f"unknown session property {name!r} (known: {known})"
+            )
+        out[name] = d.coerce(value)
+    return out
+
+
+def effective(props: dict, name: str):
+    """Value of a property under the session overrides."""
+    d = SESSION_PROPERTIES[name]
+    return props.get(name, d.default)

@@ -47,16 +47,40 @@ class Session:
         Session properties override engine defaults per query, the
         reference's SystemSessionProperties rule [SURVEY §5.6]."""
         from presto_tpu.connectors.system import SystemConnector
+        from presto_tpu.runtime.properties import validate_properties
 
         conns = dict(connectors)
         conns.setdefault("system", SystemConnector(self))
         self.catalog = Catalog(conns)
         self.analyzer = Analyzer(self.catalog)
-        self.properties = dict(properties or {})
+        self.properties = validate_properties(dict(properties or {}))
         self.mesh = mesh
         self.trace_token = trace_token
         self.events = EventDispatcher()
         self.query_history: list[QueryInfo] = []
+
+    # ------------------------------------------------------------------
+    def prop(self, name: str):
+        """Effective value of a session property (override or default)."""
+        from presto_tpu.runtime.properties import effective
+
+        return effective(self.properties, name)
+
+    def set_property(self, name: str, value):
+        """SET SESSION name = value (typed + validated; unknown names
+        rejected, the reference's config-binding rule [SURVEY §5.6])."""
+        from presto_tpu.runtime.properties import validate_properties
+
+        self.properties.update(validate_properties({name: value}))
+
+    def show_session(self) -> "list[tuple[str, object, str]]":
+        """(name, effective value, description) rows, SHOW SESSION."""
+        from presto_tpu.runtime.properties import SESSION_PROPERTIES
+
+        return [
+            (d.name, self.prop(d.name), d.description)
+            for d in SESSION_PROPERTIES.values()
+        ]
     @property
     def executor(self):
         """A freshly-configured executor reflecting current session
@@ -69,23 +93,28 @@ class Session:
         recorder) must never live on a shared object, or concurrent /
         nested queries cross-contaminate each other's stats
         (reference parity: per-query SqlQueryExecution objects)."""
+        import os
+
+        pallas = self.prop("pallas_strings")
+        if pallas is not None:
+            # the string-kernel probe reads the env at trace time;
+            # mirror the property there (documented as process-wide)
+            os.environ["PRESTO_TPU_PALLAS"] = "1" if pallas else "0"
         if self.mesh is None:
-            budget = self.properties.get("join_build_budget_bytes")
+            budget = self.prop("join_build_budget_bytes")
             return LocalExecutor(
                 self.catalog,
-                join_build_budget=int(budget) if budget is not None else None,
+                join_build_budget=budget,
+                direct_group_limit=self.prop("direct_group_limit"),
             )
         from presto_tpu.exec.distributed import DistributedExecutor
 
         return DistributedExecutor(
             self.catalog,
             self.mesh,
-            broadcast_limit=int(
-                self.properties.get("broadcast_join_row_limit", 1 << 21)
-            ),
-            gather_limit=int(
-                self.properties.get("gather_row_limit", 1 << 22)
-            ),
+            broadcast_limit=self.prop("broadcast_join_row_limit"),
+            gather_limit=self.prop("gather_row_limit"),
+            direct_group_limit=self.prop("direct_group_limit"),
         )
 
     # ------------------------------------------------------------------
@@ -111,18 +140,31 @@ class Session:
 
     def sql(self, sql: str):
         """Execute and return a pandas DataFrame."""
-        recorder = (
-            StatsRecorder()
-            if self.properties.get("collect_node_stats")
-            else None
+        want = bool(self.prop("collect_node_stats"))
+        df, _info = self._run_with_retries(
+            sql, (lambda: StatsRecorder()) if want else (lambda: None)
         )
-        df, _info = self._run_tracked(sql, self.plan(sql), recorder)
         return df
 
     def execute(self, sql: str):
         """Execute returning (DataFrame, QueryInfo)."""
-        recorder = StatsRecorder()
-        return self._run_tracked(sql, self.plan(sql), recorder)
+        return self._run_with_retries(sql, StatsRecorder)
+
+    def _run_with_retries(self, sql: str, make_recorder):
+        """The engine's whole failure-recovery posture, like the
+        reference's: no mid-query recovery — a failed attempt fails the
+        query, and recovery is re-running it from the top
+        (``query_retries`` session property). Each attempt is tracked
+        as its own query with its own fresh recorder — stats from a
+        failed attempt must not leak into the retry's QueryInfo."""
+        retries = self.prop("query_retries")
+        for attempt in range(retries + 1):
+            try:
+                return self._run_tracked(sql, self.plan(sql), make_recorder())
+            except Exception:
+                if attempt == retries:
+                    raise
+                REGISTRY.counter("query.retried").add()
 
     # ------------------------------------------------------------------
     def _run_tracked(self, sql: str, plan: PlanNode, recorder):

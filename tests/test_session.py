@@ -1,8 +1,13 @@
-"""Session runtime behaviors (query lifecycle, stats isolation).
+"""Session runtime behaviors (query lifecycle, stats isolation,
+session properties, the CLI statement loop).
 
 Reference parity: per-query execution objects (SqlQueryExecution) —
 per-query state like the stats recorder must not live on shared
-machinery [SURVEY §3.1; round-1 advisor finding]."""
+machinery [SURVEY §3.1; round-1 advisor finding]; SystemSessionProperties
+typed/validated per-session knobs [SURVEY §5.6]; presto-cli console
+[SURVEY §2.1]."""
+
+import pytest
 
 from presto_tpu.connectors.tpch import TpchConnector
 from presto_tpu.runtime.session import Session
@@ -50,3 +55,116 @@ def test_nested_query_from_event_listener_keeps_outer_stats():
     assert int(df["c"][0]) == 25
     assert info.node_stats, "outer query lost its recorded stats"
     assert len(nested_df) == 1
+
+
+# ---------------------------------------------------------------------------
+# session properties (SURVEY §5.6)
+# ---------------------------------------------------------------------------
+
+
+def test_unknown_session_property_rejected():
+    with pytest.raises(ValueError, match="unknown session property"):
+        Session({"tpch": TpchConnector(sf=0.01)}, properties={"nope": 1})
+
+
+def test_property_type_coercion_and_validation():
+    s = Session(
+        {"tpch": TpchConnector(sf=0.01)},
+        properties={"gather_row_limit": "4096", "collect_node_stats": "true"},
+    )
+    assert s.prop("gather_row_limit") == 4096
+    assert s.prop("collect_node_stats") is True
+    with pytest.raises(ValueError, match="must be positive"):
+        s.set_property("gather_row_limit", 0)
+    with pytest.raises(ValueError, match="cannot interpret"):
+        s.set_property("gather_row_limit", "abc")
+    # 0 is legal where it means "disabled" (never broadcast)
+    s.set_property("broadcast_join_row_limit", 0)
+    assert s.prop("broadcast_join_row_limit") == 0
+
+
+def test_show_session_lists_every_registered_property():
+    from presto_tpu.runtime.properties import SESSION_PROPERTIES
+
+    s = Session({"tpch": TpchConnector(sf=0.01)})
+    rows = s.show_session()
+    assert {r[0] for r in rows} == set(SESSION_PROPERTIES)
+    assert all(r[2] for r in rows)  # every property is documented
+
+
+def test_direct_group_limit_reaches_executor():
+    s = Session(
+        {"tpch": TpchConnector(sf=0.01)},
+        properties={"direct_group_limit": 7},
+    )
+    assert s.executor.direct_group_limit == 7
+    df = s.sql(
+        "select l_returnflag, l_linestatus, count(*) c "
+        "from lineitem group by l_returnflag, l_linestatus order by 1, 2"
+    )
+    assert df["c"].sum() > 0
+
+
+def test_query_retries_rerun_failed_queries():
+    s = Session(
+        {"tpch": TpchConnector(sf=0.01)},
+        properties={"query_retries": 2},
+    )
+    calls = []
+    orig = Session._run_tracked
+
+    def flaky(self, sql, plan, recorder):
+        calls.append(1)
+        if len(calls) < 3:
+            raise RuntimeError("transient device loss")
+        return orig(self, sql, plan, recorder)
+
+    Session._run_tracked = flaky
+    try:
+        df = s.sql("select count(*) c from nation")
+    finally:
+        Session._run_tracked = orig
+    assert len(calls) == 3
+    assert int(df["c"][0]) == 25
+
+
+# ---------------------------------------------------------------------------
+# CLI statement loop (presto-cli analog)
+# ---------------------------------------------------------------------------
+
+
+def test_cli_statements(capsys):
+    from presto_tpu.__main__ import run_statement
+
+    s = Session({"tpch": TpchConnector(sf=0.01)})
+    assert run_statement(s, "select count(*) as c from nation;")
+    out = capsys.readouterr().out
+    assert "25" in out and "1 row" in out
+
+    assert run_statement(s, "show tables;")
+    assert "tpch.lineitem" in capsys.readouterr().out
+
+    assert run_statement(s, "set session gather_row_limit = 1234;")
+    assert s.prop("gather_row_limit") == 1234
+    assert run_statement(s, "show session;")
+    assert "gather_row_limit = 1234" in capsys.readouterr().out
+
+    assert run_statement(s, "explain select * from nation;")
+    assert "TableScan" in capsys.readouterr().out
+
+    assert run_statement(s, "select no_such_column from nation;")
+    assert "error:" in capsys.readouterr().err  # REPL survives bad SQL
+
+    assert not run_statement(s, "quit;")
+
+
+def test_cli_file_split_respects_quoted_semicolons():
+    from presto_tpu.__main__ import split_statements
+
+    stmts = split_statements(
+        "select r_name from region where r_name like '%;%';\n"
+        "select 1 ; select ';' from region"
+    )
+    assert stmts[0].strip() == "select r_name from region where r_name like '%;%'"
+    assert stmts[1].strip() == "select 1"
+    assert stmts[2].strip() == "select ';' from region"
