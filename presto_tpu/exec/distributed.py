@@ -111,6 +111,24 @@ def _compact_step(mesh, out_cap: int):
     return jax.jit(step)
 
 
+def _pad_rows(b: Batch, cap: int) -> Batch:
+    """Grow a batch's row capacity with dead rows (resharding requires
+    the row axis divisible by the mesh size)."""
+    if cap == b.capacity:
+        return b
+    extra = cap - b.capacity
+
+    def pad(a, fill=0):
+        tail = (extra,) + tuple(a.shape[1:])
+        return jnp.concatenate([a, jnp.full(tail, fill, a.dtype)])
+
+    cols = {
+        n: Column(pad(c.data), pad(c.valid, False), c.dtype, c.dictionary)
+        for n, c in b.columns.items()
+    }
+    return Batch(cols, pad(b.live, False))
+
+
 def _compact_local(b: Batch, out_cap: int) -> Batch:
     """Gather live rows into a smaller-capacity batch (one nonzero +
     per-column gather). Caller guarantees live_count <= out_cap."""
@@ -680,6 +698,50 @@ class DistributedExecutor:
             return DistBatch(op.process(left.batch)[0], left.sharded)
         shim = _SemiShim(node)
         return self._repartition_join(shim, left, right, lkey, rkey)
+
+    # ---- set operations --------------------------------------------------
+    def _exec_union(self, node: N.Union, scalars) -> DistBatch:
+        """UNION ALL: per-device concatenation of the children's local
+        shards (one shard_map, no collective — a bag union needs no
+        data movement). Unsharded children are resharded first."""
+        from presto_tpu.exec.operators import (
+            align_batch_dicts,
+            concat_batches,
+            union_target_dicts,
+        )
+
+        names = node.field_names()
+        parts = []
+        for c in node.inputs:
+            d = self._exec(c, scalars)
+            b = d.batch.select(names)
+            if not d.sharded:
+                b = self._shard(_pad_rows(b, -(-b.capacity // self.nworkers)
+                                          * self.nworkers))
+            parts.append(b)
+        targets = union_target_dicts(names, parts)
+        parts = [align_batch_dicts(p, targets) for p in parts]
+
+        @partial(
+            shard_map, mesh=self.mesh,
+            in_specs=tuple(P(WORKERS) for _ in parts), out_specs=P(WORKERS),
+            check_vma=False,
+        )
+        def step(*bs):
+            return concat_batches(list(bs))
+
+        out = jax.jit(step)(*parts)
+        # a NULL-literal branch carries no dictionary; keep the first
+        # real one for each column so the output decodes
+        cols = {}
+        for n in names:
+            d = next(
+                (p[n].dictionary for p in parts if p[n].dictionary is not None),
+                None,
+            )
+            c = out[n]
+            cols[n] = Column(c.data, c.valid, c.dtype, d)
+        return DistBatch(Batch(cols, out.live), sharded=True)
 
     # ---- window functions ------------------------------------------------
     def _exec_window(self, node: N.Window, scalars) -> DistBatch:

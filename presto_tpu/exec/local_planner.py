@@ -38,6 +38,8 @@ from presto_tpu.exec.operators import (
     OrderByOperator,
     SortStrategy,
     TopNOperator,
+    align_batch_dicts,
+    union_target_dicts,
 )
 from presto_tpu.exec.pipeline import BatchSource, BatchStream, Pipeline, ScanSource
 from presto_tpu.expr import BIGINT, Call, Expr, InputRef, Literal, bind_scalars
@@ -541,6 +543,29 @@ class LocalExecutor:
 
         op = window_operator_from_node(node, scalars)
         return BatchStream.of(Pipeline(child, [op]).run())
+
+    # ---- set operations --------------------------------------------------
+    def _exec_union(self, node: N.Union, scalars):
+        """UNION ALL: lazy concatenation of the child streams. Columns
+        are name-aligned by the analyzer's coercing Projects; batches
+        keep their own capacities (a consumer compiles per capacity
+        bucket). VARCHAR columns whose children carry different
+        dictionaries are re-encoded into a merged target dictionary
+        (codes are only comparable within one dictionary)."""
+        children = [self._exec(c, scalars) for c in node.inputs]
+        names = node.field_names()
+        targets = union_target_dicts(
+            names, [cs.peek() for cs in children]
+        )
+        mapping_cache: dict = {}
+
+        def make():
+            for cs in children:
+                for b in cs:
+                    yield align_batch_dicts(b.select(names), targets,
+                                            mapping_cache)
+
+        return BatchStream(make)
 
     # ---- ordering / limiting --------------------------------------------
     def _exec_sort(self, node: N.Sort, scalars):

@@ -619,6 +619,57 @@ def concat_batches(batches: list[Batch]) -> Batch:
     return Batch(cols, jnp.concatenate([b.live for b in batches]))
 
 
+def union_target_dicts(names, sample_batches):
+    """Per-column target dictionaries for a UNION: where children carry
+    different dictionaries for the same column, the target is their
+    merge; identical/absent dictionaries need no alignment (the common
+    case — one dictionary object per source column). ``sample_batches``
+    are one representative batch per child (dictionaries are uniform
+    within a child's stream); Nones (empty children) are skipped."""
+    from presto_tpu.batch import Dictionary
+
+    targets: dict[str, object] = {}
+    for n in names:
+        dicts = []
+        for b in sample_batches:
+            if b is None or n not in b:
+                continue
+            d = b[n].dictionary
+            if d is not None and all(d is not x for x in dicts):
+                dicts.append(d)
+        if len(dicts) > 1:
+            merged: list[str] = []
+            for d in dicts:
+                merged.extend(d.values.tolist())
+            targets[n] = Dictionary(merged)
+    return targets
+
+
+def align_batch_dicts(b: Batch, targets: dict, _cache: dict | None = None) -> Batch:
+    """Re-encode dictionary columns of ``b`` into the union's target
+    dictionaries via a small device-side code mapping table. ``_cache``
+    (keyed by (column, source-dictionary identity)) lets a streaming
+    caller build each mapping once instead of per batch."""
+    if not targets:
+        return b
+    cols = dict(b.columns)
+    for n, target in targets.items():
+        c = cols.get(n)
+        if c is None or c.dictionary is None or c.dictionary is target:
+            continue
+        key = (n, id(c.dictionary))
+        mapping = None if _cache is None else _cache.get(key)
+        if mapping is None:
+            mapping = jnp.asarray(
+                np.array([target.code_of(v) for v in c.dictionary.values],
+                         dtype=np.int32)
+            )
+            if _cache is not None:
+                _cache[key] = mapping
+        cols[n] = Column(mapping[c.data], c.valid, c.dtype, target)
+    return Batch(cols, b.live)
+
+
 class OrderByOperator(CollectingOperator):
     """Full sort (reference: OrderByOperator + PagesIndex.sort)."""
 

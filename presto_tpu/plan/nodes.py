@@ -172,6 +172,24 @@ class SemiJoin(PlanNode):
 
 
 @dataclass(frozen=True)
+class Union(PlanNode):
+    """UNION ALL: bag concatenation of children producing identical
+    field names/types (the analyzer inserts coercing Projects;
+    reference: UnionNode + the exchange that merges its sources).
+    UNION distinct is planned as a dedup Aggregate above this node."""
+
+    inputs: tuple[PlanNode, ...]
+
+    @property
+    def children(self):
+        return self.inputs
+
+    @property
+    def fields(self):
+        return self.inputs[0].fields
+
+
+@dataclass(frozen=True)
 class Sort(PlanNode):
     child: PlanNode
     keys: tuple[SortKey, ...]

@@ -193,6 +193,31 @@ def _resolved_refs(n, out: set[str]):
             _resolved_refs(v, out)
 
 
+def _substitute_outside_aggs(n, mapping):
+    """Like substitute_nodes, but leaves aggregate-call subtrees (and
+    window calls) untouched — grouping-sets NULL substitution must not
+    rewrite aggregate arguments."""
+    if isinstance(n, A.FunctionCall) and (n.name in AGG_FUNCS or n.over is not None):
+        return n
+    if isinstance(n, A.Node) and not isinstance(n, A.Query):
+        try:
+            if n in mapping:
+                return mapping[n]
+        except TypeError:
+            pass
+    if isinstance(n, A.Query) or not isinstance(n, (A.Node, tuple)):
+        return n
+    if isinstance(n, tuple):
+        return tuple(_substitute_outside_aggs(v, mapping) for v in n)
+    changes = {}
+    for f in n.__dataclass_fields__:
+        v = getattr(n, f)
+        nv = _substitute_outside_aggs(v, mapping)
+        if nv is not v:
+            changes[f] = nv
+    return replace(n, **changes) if changes else n
+
+
 def substitute_nodes(n, mapping):
     """Structurally replace AST nodes found in ``mapping`` (by value
     equality) with their replacements; subqueries are left untouched."""
@@ -244,14 +269,190 @@ class Analyzer:
         self._uniq += 1
         return f"{base}${self._uniq}"
 
-    def analyze(self, query: A.Query) -> N.PlanNode:
-        plan, _scope = self._analyze_query(query, outer=None, ctes={})
+    def analyze(self, query: A.Node) -> N.PlanNode:
+        plan, _scope = self._analyze_any(query, outer=None, ctes={})
         return plan
+
+    def _analyze_any(
+        self, q: A.Node, outer: Scope | None, ctes: dict
+    ) -> tuple[N.PlanNode, Scope]:
+        """Dispatch: plain SELECT core vs UNION chain."""
+        if isinstance(q, A.SetQuery):
+            return self._analyze_setquery(q, outer, ctes)
+        return self._analyze_query(q, outer, ctes)
+
+    # ------------------------------------------------------------------
+    def _analyze_setquery(
+        self, q: A.SetQuery, outer: Scope | None, ctes: dict
+    ) -> tuple[N.PlanNode, Scope]:
+        """UNION [ALL] chain -> N.Union (+ dedup Aggregate for UNION
+        distinct), left-associative like the reference's SetOperation
+        planning [SURVEY §2.1 planner row]. Terms are coerced to common
+        column types; output names come from the first term."""
+        from presto_tpu.types import common_super_type
+
+        ctes = dict(ctes)
+        for name, cq in q.ctes:
+            ctes[name] = cq
+        planned = [self._analyze_any(t, outer, ctes) for t in q.terms]
+        first_out = planned[0][0]
+        names = list(first_out.names)
+        for out, _scope in planned[1:]:
+            if len(out.names) != len(names):
+                raise AnalysisError(
+                    f"UNION terms have {len(names)} vs {len(out.names)} columns"
+                )
+        # unified column types across terms
+        types = []
+        for i in range(len(names)):
+            t = planned[0][1].fields[i].dtype
+            for _, scope in planned[1:]:
+                t = common_super_type(t, scope.fields[i].dtype)
+            types.append(t)
+
+        # internal field names are uniquified: client names may repeat
+        # (SELECT a, a FROM ...) and Batch columns are name-keyed
+        internal = [self.fresh(n) for n in names]
+
+        def as_union_input(out: N.Output, scope: Scope) -> N.PlanNode:
+            exprs = []
+            for i, n in enumerate(internal):
+                f = scope.fields[i]
+                e: Expr = InputRef(f.dtype, out.sources[i])
+                exprs.append((n, self._coerce_to(e, types[i])))
+            return N.Project(out.child, tuple(exprs))
+
+        acc = as_union_input(*planned[0])
+        for op, (out, scope) in zip(q.ops, planned[1:]):
+            acc = N.Union((acc, as_union_input(out, scope)))
+            if op == "union":  # distinct: dedup everything so far
+                acc = N.Aggregate(
+                    acc,
+                    tuple((n, InputRef(t, n)) for n, t in zip(internal, types)),
+                    (),
+                )
+        plan = acc
+        out_scope = Scope(
+            [FieldRef(i_, t, "", n)
+             for i_, n, t in zip(internal, names, types)]
+        )
+        if q.order_by:
+            keys = []
+            scalar_binds: list[N.ScalarValue] = []
+            for item in q.order_by:
+                e = self._order_expr(item.expr, out_scope, out_scope, None,
+                                     ctes, scalar_binds, {}, {})
+                keys.append(SortKey(e, item.descending, bool(item.nulls_first)))
+            if q.limit is not None:
+                plan = N.TopN(plan, tuple(keys), q.limit)
+            else:
+                plan = N.Sort(plan, tuple(keys))
+            if scalar_binds:
+                plan = N.BindScalars(plan, tuple(scalar_binds))
+        elif q.limit is not None:
+            plan = N.Limit(plan, q.limit)
+        out = N.Output(plan, tuple(names), tuple(internal))
+        return out, out_scope
+
+    def _coerce_to(self, e: Expr, t) -> Expr:
+        """Lift ``e`` to the union-unified type ``t`` (already a common
+        super type of e.dtype per the coercion lattice)."""
+        from presto_tpu.expr import rescale_decimal
+        from presto_tpu.types import TypeKind as TK
+
+        if e.dtype == t:
+            return e
+        if t.kind is TK.DOUBLE:
+            return Call(t, "cast_double", (e,))
+        if t.kind is TK.BIGINT:
+            return Call(t, "cast_bigint", (e,))
+        if t.kind is TK.DECIMAL:
+            return Call(t, rescale_decimal(t.scale), (e,))
+        if t.kind is e.dtype.kind:
+            return e  # width/param variations of the same kind
+        raise AnalysisError(f"cannot unify UNION column types {e.dtype} and {t}")
+
+    # ------------------------------------------------------------------
+    def _expand_grouping_sets(
+        self, q: A.Query, outer, ctes: dict
+    ) -> A.SetQuery | None:
+        """GROUP BY ROLLUP/CUBE/GROUPING SETS -> UNION ALL of one
+        grouped branch per set (the reference plans GroupingSets as a
+        GroupIdNode; re-aggregation per set is the equivalent here).
+        In each branch, grouping columns absent from its set become
+        typed NULL literals in SELECT/HAVING, and grouping(col) folds
+        to its 0/1 constant for that branch."""
+        gs_items = [g for g in q.group_by if isinstance(g, A.GroupingSets)]
+        if not gs_items:
+            return None
+        if len(gs_items) > 1:
+            raise AnalysisError("multiple GROUPING SETS elements not supported")
+        prefix = tuple(g for g in q.group_by if not isinstance(g, A.GroupingSets))
+        gs = gs_items[0]
+        all_keys: list[A.Node] = []
+        for s in gs.sets:
+            for k in s:
+                if k not in all_keys:
+                    all_keys.append(k)
+        # type each grouping key against the FROM scope once, so absent
+        # keys can be replaced by *typed* NULLs (the union type checker
+        # needs them); this pre-analysis of FROM is throwaway
+        ctes2 = dict(ctes)
+        for name, cq in q.ctes:
+            ctes2[name] = cq
+        rels: list[Rel] = []
+        edges: list[dict] = []
+        if q.from_ is not None:
+            self._flatten_from(q.from_, rels, edges, ctes2, outer)
+        probe_scope = Scope([f for r in rels for f in r.scope.fields])
+        key_null: dict[A.Node, A.Node] = {}
+        for k in all_keys:
+            e = self._expr(k, probe_scope, outer, ctes2, [])
+            key_null[k] = A.Resolved(Literal(e.dtype, None))
+        branches = []
+        for s in gs.sets:
+            grouped = set(prefix) | set(s)
+            g_map: dict[A.Node, A.Node] = {}
+            null_map: dict[A.Node, A.Node] = {}
+            for k in all_keys:
+                g_map[A.FunctionCall("grouping", (k,))] = A.NumberLit(
+                    "0" if k in grouped else "1"
+                )
+                if k not in grouped:
+                    null_map[k] = key_null[k]
+
+            def sub(n):
+                # grouping() folds anywhere; key->NULL only OUTSIDE
+                # aggregate arguments (SUM(a) in a subtotal row still
+                # sums the real column, standard grouping-sets
+                # semantics)
+                n = substitute_nodes(n, g_map)
+                return _substitute_outside_aggs(n, null_map)
+
+            branches.append(replace(
+                q,
+                group_by=prefix + tuple(s),
+                select=tuple(sub(it) for it in q.select),
+                having=sub(q.having) if q.having is not None else None,
+                order_by=(),
+                limit=None,
+                ctes=(),
+            ))
+        return A.SetQuery(
+            terms=tuple(branches),
+            ops=("union_all",) * (len(branches) - 1),
+            order_by=q.order_by,
+            limit=q.limit,
+            ctes=q.ctes,
+        )
 
     # ------------------------------------------------------------------
     def _analyze_query(
         self, q: A.Query, outer: Scope | None, ctes: dict[str, A.Query]
     ) -> tuple[N.PlanNode, Scope]:
+        expanded = self._expand_grouping_sets(q, outer, ctes)
+        if expanded is not None:
+            return self._analyze_setquery(expanded, outer, ctes)
         ctes = dict(ctes)
         for name, cq in q.ctes:
             ctes[name] = cq
@@ -409,7 +610,7 @@ class Analyzer:
         if isinstance(rel, A.Table):
             binding = rel.alias or rel.name
             if rel.name in ctes:
-                plan, sub_scope = self._analyze_query(ctes[rel.name], None, ctes)
+                plan, sub_scope = self._analyze_any(ctes[rel.name], None, ctes)
                 self._add_derived(rels, binding, plan, sub_scope)
                 return
             meta = self.catalog.resolve(rel.name)
@@ -428,7 +629,7 @@ class Analyzer:
             return
         if isinstance(rel, A.SubqueryRelation):
             binding = rel.alias or self.fresh("subq")
-            plan, sub_scope = self._analyze_query(rel.query, None, ctes)
+            plan, sub_scope = self._analyze_any(rel.query, None, ctes)
             self._add_derived(rels, binding, plan, sub_scope)
             return
         if isinstance(rel, A.Join):
@@ -721,6 +922,19 @@ class Analyzer:
     # ------------------------------------------------------------------
     # subquery predicates
     # ------------------------------------------------------------------
+    def _as_plain_query(self, q: A.Node) -> A.Query:
+        """Wrap a SetQuery as SELECT * FROM (<union>) so the subquery
+        rewrite machinery (which pattern-matches Query fields) can
+        consume UNIONs in IN/EXISTS/scalar positions. Correlated
+        references inside the union fail resolution cleanly (outer
+        scope is not threaded through the wrapper)."""
+        if isinstance(q, A.SetQuery):
+            return A.Query(
+                select=(A.SelectItem(A.Star(), None),),
+                from_=A.SubqueryRelation(q, self.fresh("u")),
+            )
+        return q
+
     def _apply_subquery_pred(self, c, plan, scope, outer, ctes, scalar_binds):
         # EXISTS / NOT EXISTS
         node = c
@@ -729,11 +943,15 @@ class Analyzer:
             negated = not negated
             node = node.operand
         if isinstance(node, A.Exists):
-            return self._plan_exists(node.query, negated != node.negated, plan,
-                                     scope, ctes)
+            return self._plan_exists(
+                self._as_plain_query(node.query), negated != node.negated,
+                plan, scope, ctes,
+            )
         if isinstance(node, A.InSubquery):
             value = self._expr(node.value, scope, outer, ctes, scalar_binds)
-            sub_plan, sub_scope = self._analyze_query(node.query, None, ctes)
+            sub_plan, sub_scope = self._analyze_query(
+                self._as_plain_query(node.query), None, ctes
+            )
             inner = sub_plan.child if isinstance(sub_plan, N.Output) else sub_plan
             key_name = (
                 sub_plan.sources[0] if isinstance(sub_plan, N.Output)
@@ -888,6 +1106,7 @@ class Analyzer:
 
     def _plan_scalar_compare(self, op, other_ast, sub_q: A.Query, negated, flip,
                              plan, scope, outer, ctes, scalar_binds):
+        sub_q = self._as_plain_query(sub_q)
         probe = self._inner_scope_probe(sub_q, ctes)
         new_where, corr, neq = self._split_correlation(sub_q, probe, scope, ctes)
         if neq:
@@ -1418,7 +1637,7 @@ class Analyzer:
             raise AnalysisError(f"unknown function {n.name}")
         if isinstance(n, A.ScalarSubquery):
             # scalar subquery in a value position (uncorrelated only)
-            sub_plan, sub_scope = self._analyze_query(n.query, None, ctes)
+            sub_plan, sub_scope = self._analyze_any(n.query, None, ctes)
             if len(sub_scope.fields) != 1:
                 raise AnalysisError("scalar subquery must produce one column")
             sname = self.fresh("scalar")

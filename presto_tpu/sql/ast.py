@@ -214,13 +214,35 @@ class OrderItem(Node):
 
 
 @dataclass(frozen=True)
+class GroupingSets(Node):
+    """A ROLLUP / CUBE / GROUPING SETS element inside GROUP BY; the
+    parser normalizes all three spellings to the explicit set list."""
+
+    sets: tuple[tuple[Node, ...], ...]
+
+
+@dataclass(frozen=True)
 class Query(Node):
     select: tuple[SelectItem, ...]
     from_: Optional[Node]  # relation tree (None for SELECT <expr>)
     where: Optional[Node] = None
-    group_by: tuple[Node, ...] = ()
+    group_by: tuple[Node, ...] = ()  # exprs and/or GroupingSets elements
     having: Optional[Node] = None
     order_by: tuple[OrderItem, ...] = ()
     limit: Optional[int] = None
     distinct: bool = False
     ctes: tuple[tuple[str, "Query"], ...] = ()  # WITH name AS (query)
+
+
+@dataclass(frozen=True)
+class SetQuery(Node):
+    """UNION [ALL] chain. ``ops[i]`` combines ``terms[i]`` into the
+    running result ('union' dedups, 'union_all' keeps duplicates);
+    ORDER BY / LIMIT apply to the combined result and may reference the
+    first term's output names or ordinals."""
+
+    terms: tuple[Node, ...]  # Query | SetQuery
+    ops: tuple[str, ...]  # len(terms) - 1
+    order_by: tuple[OrderItem, ...] = ()
+    limit: Optional[int] = None
+    ctes: tuple[tuple[str, "Query"], ...] = ()

@@ -15,6 +15,10 @@ from presto_tpu.sql import ast as A
 from presto_tpu.sql.lexer import Token, tokenize
 
 
+#: contextual (non-reserved) set-operation words: never implicit aliases
+_SET_OP_WORDS = ("intersect", "except")
+
+
 class ParseError(ValueError):
     def __init__(self, msg: str, tok: Token):
         super().__init__(f"{msg} at line {tok.line}:{tok.col} (near {tok.text!r})")
@@ -37,6 +41,18 @@ class Parser:
     def op(self, *ops: str) -> bool:
         t = self.cur
         return t.kind == "OP" and t.text in ops
+
+    def word(self, *words: str) -> bool:
+        """Match a non-reserved word (lexed as IDENT) or keyword:
+        ROLLUP/CUBE/GROUPING/SETS/INTERSECT/EXCEPT are contextual."""
+        t = self.cur
+        return t.kind in ("KW", "IDENT") and t.text.lower() in words
+
+    def _accept_word(self, w: str) -> bool:
+        if self.word(w):
+            self.eat()
+            return True
+        return False
 
     def eat(self):
         t = self.cur
@@ -74,7 +90,9 @@ class Parser:
         return q
 
     # -- query ------------------------------------------------------------
-    def parse_query(self) -> A.Query:
+    def parse_query(self) -> A.Node:
+        """[WITH ...] term (UNION [ALL] term)* [ORDER BY ...] [LIMIT n]
+        -> Query (no set ops) or SetQuery."""
         ctes: list[tuple[str, A.Query]] = []
         if self.accept_kw("with"):
             while True:
@@ -85,6 +103,71 @@ class Parser:
                 self.expect_op(")")
                 if not self.accept_op(","):
                     break
+        first, first_parenthesized = self._parse_set_term()
+        terms = [first]
+        ops: list[str] = []
+        while True:
+            if self.kw("union"):
+                self.eat()
+                if self.accept_kw("all"):
+                    ops.append("union_all")
+                else:
+                    self.accept_kw("distinct")
+                    ops.append("union")
+                terms.append(self._parse_set_term()[0])
+                continue
+            if self.word("intersect", "except"):
+                raise ParseError("INTERSECT/EXCEPT not supported", self.cur)
+            break
+        order_by: list[A.OrderItem] = []
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            order_by.append(self.parse_order_item())
+            while self.accept_op(","):
+                order_by.append(self.parse_order_item())
+        limit = None
+        if self.accept_kw("limit"):
+            t = self.eat()
+            if t.kind != "NUMBER":
+                raise ParseError("expected LIMIT count", t)
+            limit = int(t.text)
+        if len(terms) == 1:
+            q = terms[0]
+            if not first_parenthesized:
+                # a bare core: its order/limit/ctes slots are empty
+                return dataclasses.replace(
+                    q, order_by=tuple(order_by), limit=limit, ctes=tuple(ctes)
+                )
+            # a parenthesized query keeps its own ORDER BY/LIMIT/CTEs;
+            # outer clauses (if any) wrap it as a single-term SetQuery
+            if not order_by and limit is None and not ctes:
+                return q
+            return A.SetQuery(
+                terms=(q,), ops=(), order_by=tuple(order_by),
+                limit=limit, ctes=tuple(ctes),
+            )
+        return A.SetQuery(
+            terms=tuple(terms),
+            ops=tuple(ops),
+            order_by=tuple(order_by),
+            limit=limit,
+            ctes=tuple(ctes),
+        )
+
+    def _parse_set_term(self) -> tuple[A.Node, bool]:
+        """One UNION operand: a parenthesized query or a bare select
+        core (whose ORDER BY/LIMIT, if unparenthesized, belong to the
+        enclosing query — standard SQL). Returns (term, parenthesized)."""
+        if self.op("(") and self.toks[self.i + 1].kind == "KW" and self.toks[
+            self.i + 1
+        ].text.lower() in ("select", "with"):
+            self.eat()
+            q = self.parse_query()
+            self.expect_op(")")
+            return q, True
+        return self._parse_select_core(), False
+
+    def _parse_select_core(self) -> A.Query:
         self.expect_kw("select")
         distinct = self.accept_kw("distinct")
         self.accept_kw("all")
@@ -98,33 +181,66 @@ class Parser:
         group_by: list[A.Node] = []
         if self.accept_kw("group"):
             self.expect_kw("by")
-            group_by.append(self.parse_expr())
+            group_by.append(self._parse_grouping_element())
             while self.accept_op(","):
-                group_by.append(self.parse_expr())
+                group_by.append(self._parse_grouping_element())
         having = self.parse_expr() if self.accept_kw("having") else None
-        order_by: list[A.OrderItem] = []
-        if self.accept_kw("order"):
-            self.expect_kw("by")
-            order_by.append(self.parse_order_item())
-            while self.accept_op(","):
-                order_by.append(self.parse_order_item())
-        limit = None
-        if self.accept_kw("limit"):
-            t = self.eat()
-            if t.kind != "NUMBER":
-                raise ParseError("expected LIMIT count", t)
-            limit = int(t.text)
         return A.Query(
             select=tuple(items),
             from_=from_,
             where=where,
             group_by=tuple(group_by),
             having=having,
-            order_by=tuple(order_by),
-            limit=limit,
             distinct=distinct,
-            ctes=tuple(ctes),
         )
+
+    def _parse_grouping_element(self) -> A.Node:
+        """GROUP BY element: expr | ROLLUP(...) | CUBE(...) |
+        GROUPING SETS ((...), ...) — the latter three normalize to an
+        explicit GroupingSets set list."""
+        if self.word("rollup") and self.toks[self.i + 1].text == "(":
+            self.eat()
+            self.expect_op("(")
+            exprs = [self.parse_expr()]
+            while self.accept_op(","):
+                exprs.append(self.parse_expr())
+            self.expect_op(")")
+            sets = tuple(tuple(exprs[:k]) for k in range(len(exprs), -1, -1))
+            return A.GroupingSets(sets)
+        if self.word("cube") and self.toks[self.i + 1].text == "(":
+            self.eat()
+            self.expect_op("(")
+            exprs = [self.parse_expr()]
+            while self.accept_op(","):
+                exprs.append(self.parse_expr())
+            self.expect_op(")")
+            sets = []
+            for mask in range((1 << len(exprs)) - 1, -1, -1):
+                sets.append(tuple(
+                    e for i, e in enumerate(exprs) if mask & (1 << (len(exprs) - 1 - i))
+                ))
+            return A.GroupingSets(tuple(sets))
+        if self.word("grouping"):
+            save = self.i
+            self.eat()
+            if self._accept_word("sets"):
+                self.expect_op("(")
+                sets = []
+                while True:
+                    self.expect_op("(")
+                    exprs = []
+                    if not self.op(")"):
+                        exprs.append(self.parse_expr())
+                        while self.accept_op(","):
+                            exprs.append(self.parse_expr())
+                    self.expect_op(")")
+                    sets.append(tuple(exprs))
+                    if not self.accept_op(","):
+                        break
+                self.expect_op(")")
+                return A.GroupingSets(tuple(sets))
+            self.i = save  # grouping(...) the function, in an expression
+        return self.parse_expr()
 
     def parse_name(self) -> str:
         t = self.cur
@@ -152,7 +268,7 @@ class Parser:
         alias = None
         if self.accept_kw("as"):
             alias = self.parse_name()
-        elif self.cur.kind == "IDENT":
+        elif self.cur.kind == "IDENT" and not self.word(*_SET_OP_WORDS):
             alias = self.eat().text.lower()
         elif self.cur.kind == "QIDENT":
             alias = self.eat().text
@@ -224,7 +340,7 @@ class Parser:
     def _maybe_alias(self) -> str | None:
         if self.accept_kw("as"):
             return self.parse_name()
-        if self.cur.kind == "IDENT":
+        if self.cur.kind == "IDENT" and not self.word(*_SET_OP_WORDS):
             return self.eat().text.lower()
         if self.cur.kind == "QIDENT":
             return self.eat().text
