@@ -24,7 +24,18 @@ import numpy as np
 
 from presto_tpu.connectors.tpcds import schema as S
 
-_TABLE_IDS = {t: i for i, t in enumerate(S.TABLES)}
+# append-only: a table's id is part of its Philox key, so existing
+# tables keep their ids (and data) as new tables are added
+_TABLE_ORDER = [
+    "date_dim", "item", "customer", "customer_address",
+    "customer_demographics", "household_demographics", "store", "promotion",
+    "store_sales", "catalog_sales", "web_sales",
+    "warehouse", "reason", "ship_mode", "income_band", "call_center",
+    "web_site", "web_page", "time_dim", "inventory",
+    "store_returns", "catalog_returns", "web_returns",
+]
+_TABLE_IDS = {t: i for i, t in enumerate(_TABLE_ORDER)}
+assert set(_TABLE_IDS) == set(S.TABLES), "schema/table-id list out of sync"
 
 _ST = {
     name: i
@@ -37,6 +48,13 @@ _ST = {
             "employees", "floor", "hours", "market", "birth", "email",
             "channel1", "channel2", "channel3", "channel4", "cost", "null1",
             "null2", "null3", "ticket", "lines",
+            # appended post-round-2 (append-only: stream ids are part of
+            # the deterministic data contract)
+            "salutation", "preferred", "soldtime", "shipdate", "shipmode",
+            "warehouse", "callcenter", "shipaddr", "shipcust", "website",
+            "webpage", "retflag", "retdate", "retqty", "retreason",
+            "retcust", "fee", "sqft", "charcnt", "linkcnt", "wtype",
+            "invqty", "null4", "null5",
         ]
     )
 }
@@ -186,6 +204,106 @@ def household_demographics_chunk(lo: int, hi: int, columns=None):
     return arrays
 
 
+def time_dim_chunk(lo: int, hi: int, columns=None):
+    """Pure clock math over second-of-day [lo, hi)."""
+    sk = np.arange(lo, hi, dtype=np.int64)
+    h = (sk // 3600).astype(np.int32)
+    m = ((sk // 60) % 60).astype(np.int32)
+    s = (sk % 60).astype(np.int32)
+    d_ampm = S.DICTS["t_am_pm"]
+    d_shift = S.DICTS["t_shift"]
+    d_sub = S.DICTS["t_sub_shift"]
+    d_meal = S.DICTS["t_meal_time"]
+    shift = np.select(
+        [(h >= 6) & (h < 14), (h >= 14) & (h < 22)],
+        [d_shift.code_of("first"), d_shift.code_of("second")],
+        d_shift.code_of("third"),
+    ).astype(np.int32)
+    sub = np.select(
+        [(h >= 6) & (h < 12), (h >= 12) & (h < 18), (h >= 18)],
+        [d_sub.code_of("morning"), d_sub.code_of("afternoon"),
+         d_sub.code_of("evening")],
+        d_sub.code_of("night"),
+    ).astype(np.int32)
+    meal = np.select(
+        [(h >= 6) & (h < 9), (h >= 11) & (h < 14), (h >= 17) & (h < 21)],
+        [d_meal.code_of("breakfast"), d_meal.code_of("lunch"),
+         d_meal.code_of("dinner")],
+        d_meal.code_of(""),
+    ).astype(np.int32)
+    arrays = {
+        "t_time_sk": sk,
+        "t_time_id": _keyed_id("T", sk, 16),
+        "t_time": sk.astype(np.int32),
+        "t_hour": h,
+        "t_minute": m,
+        "t_second": s,
+        "t_am_pm": np.where(
+            h < 12, d_ampm.code_of("AM"), d_ampm.code_of("PM")
+        ).astype(np.int32),
+        "t_shift": shift,
+        "t_sub_shift": sub,
+        "t_meal_time": meal,
+    }
+    if columns is not None:
+        arrays = {c: arrays[c] for c in columns}
+    return arrays
+
+
+def reason_chunk(lo: int, hi: int, columns=None):
+    sk = np.arange(lo + 1, hi + 1, dtype=np.int64)
+    d = S.DICTS["r_reason_desc"]
+    arrays = {
+        "r_reason_sk": sk,
+        "r_reason_id": _keyed_id("AAAAAAAA", sk, 16),
+        "r_reason_desc": d.encode(S.REASONS)[(sk - 1) % len(S.REASONS)].astype(
+            np.int32
+        ),
+    }
+    if columns is not None:
+        arrays = {c: arrays[c] for c in columns}
+    return arrays
+
+
+def ship_mode_chunk(lo: int, hi: int, columns=None):
+    sk = np.arange(lo + 1, hi + 1, dtype=np.int64)
+    i = sk - 1
+    dt = S.DICTS["sm_type"]
+    dc = S.DICTS["sm_code"]
+    dca = S.DICTS["sm_carrier"]
+    arrays = {
+        "sm_ship_mode_sk": sk,
+        "sm_ship_mode_id": _keyed_id("AAAAAAAA", sk, 16),
+        "sm_type": dt.encode(S.SHIP_MODE_TYPES)[
+            i % len(S.SHIP_MODE_TYPES)
+        ].astype(np.int32),
+        "sm_code": dc.encode(S.SHIP_MODE_CODES)[
+            (i // len(S.SHIP_MODE_TYPES)) % len(S.SHIP_MODE_CODES)
+        ].astype(np.int32),
+        "sm_carrier": dca.encode(S.SHIP_CARRIERS)[
+            i % len(S.SHIP_CARRIERS)
+        ].astype(np.int32),
+    }
+    if columns is not None:
+        arrays = {c: arrays[c] for c in columns}
+    return arrays
+
+
+def income_band_chunk(lo: int, hi: int, columns=None):
+    sk = np.arange(lo + 1, hi + 1, dtype=np.int64)
+    arrays = {
+        "ib_income_band_sk": sk,
+        "ib_lower_bound": ((sk - 1) * 10000 + 1).astype(np.int32),
+        "ib_upper_bound": (sk * 10000).astype(np.int32),
+    }
+    arrays["ib_lower_bound"] = np.where(sk == 1, 0, arrays["ib_lower_bound"]).astype(
+        np.int32
+    )
+    if columns is not None:
+        arrays = {c: arrays[c] for c in columns}
+    return arrays
+
+
 # ---------------------------------------------------------------------------
 # generator
 # ---------------------------------------------------------------------------
@@ -235,12 +353,143 @@ class TpcdsGenerator:
             arrays = {c: arrays[c] for c in columns}
         return arrays
 
+    # -- small dimensions --------------------------------------------------
+    def warehouse_chunk(self, chunk: int, lo: int, hi: int, columns=None):
+        n = hi - lo
+        sk = np.arange(lo + 1, hi + 1, dtype=np.int64)
+        r = lambda s: _rng(self.seed, "warehouse", chunk, _ST[s])
+        dn = S.DICTS["w_warehouse_name"]
+        dci = S.DICTS["w_city"]
+        dco = S.DICTS["w_county"]
+        dst = S.DICTS["w_state"]
+        dctr = S.DICTS["w_country"]
+        state = r("state").integers(0, len(S.STATES), size=n, dtype=np.int64)
+        arrays = {
+            "w_warehouse_sk": sk,
+            "w_warehouse_id": _keyed_id("AAAAAAAA", sk, 16),
+            "w_warehouse_name": dn.encode(
+                [f"Warehouse #{1 + (k - 1) % 30}" for k in sk]
+            ).astype(np.int32),
+            "w_warehouse_sq_ft": r("sqft").integers(
+                50_000, 1_000_001, size=n
+            ).astype(np.int32),
+            "w_city": dci.encode(S.DICTS["w_city"].values[
+                r("city").integers(0, len(dci), size=n)
+            ]).astype(np.int32),
+            "w_county": dco.encode(S.COUNTIES)[
+                r("county").integers(0, len(S.COUNTIES), size=n)
+            ].astype(np.int32),
+            "w_state": dst.encode(S.STATES)[state].astype(np.int32),
+            "w_country": np.full(n, dctr.code_of("United States"), np.int32),
+            "w_gmt_offset": (-(5 + (state % 6)) * 100).astype(np.int64),
+        }
+        if columns is not None:
+            arrays = {c: arrays[c] for c in columns}
+        return arrays
+
+    def call_center_chunk(self, chunk: int, lo: int, hi: int, columns=None):
+        n = hi - lo
+        sk = np.arange(lo + 1, hi + 1, dtype=np.int64)
+        r = lambda s: _rng(self.seed, "call_center", chunk, _ST[s])
+        dn = S.DICTS["cc_name"]
+        dco = S.DICTS["cc_county"]
+        dst = S.DICTS["cc_state"]
+        arrays = {
+            "cc_call_center_sk": sk,
+            "cc_call_center_id": _keyed_id("AAAAAAAA", sk, 16),
+            "cc_name": dn.encode(S.CC_NAMES)[(sk - 1) % len(S.CC_NAMES)].astype(
+                np.int32
+            ),
+            "cc_manager": _word_soup(r("manager"), n, 40),
+            "cc_mkt_id": r("market").integers(1, 7, size=n).astype(np.int32),
+            "cc_county": dco.encode(S.COUNTIES)[
+                r("county").integers(0, len(S.COUNTIES), size=n)
+            ].astype(np.int32),
+            "cc_state": dst.encode(S.STATES)[
+                r("state").integers(0, len(S.STATES), size=n)
+            ].astype(np.int32),
+        }
+        if columns is not None:
+            arrays = {c: arrays[c] for c in columns}
+        return arrays
+
+    def web_site_chunk(self, chunk: int, lo: int, hi: int, columns=None):
+        n = hi - lo
+        sk = np.arange(lo + 1, hi + 1, dtype=np.int64)
+        r = lambda s: _rng(self.seed, "web_site", chunk, _ST[s])
+        dn = S.DICTS["web_name"]
+        dc = S.DICTS["web_company_name"]
+        arrays = {
+            "web_site_sk": sk,
+            "web_site_id": _keyed_id("AAAAAAAA", sk, 16),
+            "web_name": dn.encode([f"site_{(k - 1) % 30}" for k in sk]).astype(
+                np.int32
+            ),
+            "web_company_name": dc.encode(S.WEB_COMPANY_NAMES)[
+                (sk - 1) % len(S.WEB_COMPANY_NAMES)
+            ].astype(np.int32),
+            "web_manager": _word_soup(r("manager"), n, 40),
+        }
+        if columns is not None:
+            arrays = {c: arrays[c] for c in columns}
+        return arrays
+
+    def web_page_chunk(self, chunk: int, lo: int, hi: int, columns=None):
+        n = hi - lo
+        sk = np.arange(lo + 1, hi + 1, dtype=np.int64)
+        r = lambda s: _rng(self.seed, "web_page", chunk, _ST[s])
+        dt = S.DICTS["wp_type"]
+        arrays = {
+            "wp_web_page_sk": sk,
+            "wp_web_page_id": _keyed_id("AAAAAAAA", sk, 16),
+            "wp_char_count": r("charcnt").integers(
+                100, 8001, size=n
+            ).astype(np.int32),
+            "wp_link_count": r("linkcnt").integers(2, 26, size=n).astype(np.int32),
+            "wp_type": dt.encode(S.WEB_PAGE_TYPES)[
+                r("wtype").integers(0, len(S.WEB_PAGE_TYPES), size=n)
+            ].astype(np.int32),
+        }
+        if columns is not None:
+            arrays = {c: arrays[c] for c in columns}
+        return arrays
+
+    def inventory_chunk(self, chunk: int, lo: int, hi: int, columns=None):
+        """Index decode over (week, item, warehouse); the cadence is a
+        weekly snapshot across the sales span (dsdgen semantics)."""
+        idx = np.arange(lo, hi, dtype=np.int64)
+        n_wh = self.counts["warehouse"]
+        n_it = self.counts["item"]
+        wh = idx % n_wh
+        it = (idx // n_wh) % n_it
+        week = idx // (n_wh * n_it)
+        r = lambda s: _rng(self.seed, "inventory", chunk, _ST[s])
+        qty = r("invqty").integers(0, 1001, size=len(idx)).astype(np.int32)
+        arrays = {
+            "inv_date_sk": S.date_to_sk(S.SALES_DATE_LO + week * 7).astype(
+                np.int64
+            ),
+            "inv_item_sk": it + 1,
+            "inv_warehouse_sk": wh + 1,
+            "inv_quantity_on_hand": qty,
+        }
+        arrays["inv_quantity_on_hand$valid"] = r("null4").random(len(idx)) >= 0.02
+        return _project(arrays, S.TABLES["inventory"], columns)
+
     # -- customer & address ----------------------------------------------
     def customer_chunk(self, chunk: int, lo: int, hi: int, columns=None):
         n = hi - lo
         sk = np.arange(lo + 1, hi + 1, dtype=np.int64)
         r = lambda s: _rng(self.seed, "customer", chunk, _ST[s])
+        dsal = S.DICTS["c_salutation"]
+        dpref = S.DICTS["c_preferred_cust_flag"]
         arrays = {
+            "c_salutation": dsal.encode(S.SALUTATIONS)[
+                r("salutation").integers(0, len(S.SALUTATIONS), size=n)
+            ].astype(np.int32),
+            "c_preferred_cust_flag": dpref.encode(S.YN)[
+                (r("preferred").random(n) < 0.5).astype(np.int64)
+            ].astype(np.int32),
             "c_customer_sk": sk,
             "c_customer_id": _keyed_id("AAAAAAAA", sk, 16),
             "c_current_cdemo_sk": r("cdemo").integers(
@@ -406,6 +655,10 @@ class TpcdsGenerator:
 
     def store_sales_chunk(self, chunk: int, lo: int, hi: int, columns=None):
         arrays, r, n = self._sales_core("store_sales", "ss", chunk, lo, hi)
+        arrays["ss_sold_time_sk"] = r("soldtime").integers(
+            8 * 3600, 22 * 3600, size=n, dtype=np.int64
+        )
+        arrays["ss_sold_time_sk$valid"] = r("null4").random(n) >= 0.04
         arrays["ss_customer_sk"] = r("customer").integers(
             1, self.counts["customer"] + 1, size=n, dtype=np.int64
         )
@@ -431,8 +684,33 @@ class TpcdsGenerator:
 
     def catalog_sales_chunk(self, chunk: int, lo: int, hi: int, columns=None):
         arrays, r, n = self._sales_core("catalog_sales", "cs", chunk, lo, hi)
-        arrays["cs_bill_customer_sk"] = r("customer").integers(
+        bill = r("customer").integers(
             1, self.counts["customer"] + 1, size=n, dtype=np.int64
+        )
+        arrays["cs_bill_customer_sk"] = bill
+        # ~10% of orders ship to a different customer (gift shape)
+        other = r("shipcust").integers(
+            1, self.counts["customer"] + 1, size=n, dtype=np.int64
+        )
+        gift = r("retcust").random(n) < 0.1
+        arrays["cs_ship_customer_sk"] = np.where(gift, other, bill)
+        arrays["cs_ship_date_sk"] = arrays["cs_sold_date_sk"] + r(
+            "shipdate"
+        ).integers(2, 121, size=n)
+        arrays["cs_ship_date_sk$valid"] = arrays["cs_sold_date_sk$valid"] & (
+            r("null4").random(n) >= 0.02
+        )
+        arrays["cs_ship_addr_sk"] = r("shipaddr").integers(
+            1, self.counts["customer_address"] + 1, size=n, dtype=np.int64
+        )
+        arrays["cs_call_center_sk"] = r("callcenter").integers(
+            1, self.counts["call_center"] + 1, size=n, dtype=np.int64
+        )
+        arrays["cs_ship_mode_sk"] = r("shipmode").integers(
+            1, S.FIXED_ROWS["ship_mode"] + 1, size=n, dtype=np.int64
+        )
+        arrays["cs_warehouse_sk"] = r("warehouse").integers(
+            1, self.counts["warehouse"] + 1, size=n, dtype=np.int64
         )
         arrays["cs_bill_cdemo_sk"] = r("cdemo").integers(
             1, S.FIXED_ROWS["customer_demographics"] + 1, size=n, dtype=np.int64
@@ -443,14 +721,184 @@ class TpcdsGenerator:
 
     def web_sales_chunk(self, chunk: int, lo: int, hi: int, columns=None):
         arrays, r, n = self._sales_core("web_sales", "ws", chunk, lo, hi)
-        arrays["ws_bill_customer_sk"] = r("customer").integers(
+        bill = r("customer").integers(
             1, self.counts["customer"] + 1, size=n, dtype=np.int64
+        )
+        arrays["ws_bill_customer_sk"] = bill
+        other = r("shipcust").integers(
+            1, self.counts["customer"] + 1, size=n, dtype=np.int64
+        )
+        gift = r("retcust").random(n) < 0.1
+        arrays["ws_ship_customer_sk"] = np.where(gift, other, bill)
+        arrays["ws_sold_time_sk"] = r("soldtime").integers(
+            0, 86_400, size=n, dtype=np.int64
+        )
+        arrays["ws_sold_time_sk$valid"] = r("null4").random(n) >= 0.04
+        arrays["ws_ship_date_sk"] = arrays["ws_sold_date_sk"] + r(
+            "shipdate"
+        ).integers(2, 121, size=n)
+        arrays["ws_ship_date_sk$valid"] = arrays["ws_sold_date_sk$valid"] & (
+            r("null5").random(n) >= 0.02
+        )
+        arrays["ws_ship_addr_sk"] = r("shipaddr").integers(
+            1, self.counts["customer_address"] + 1, size=n, dtype=np.int64
+        )
+        arrays["ws_web_page_sk"] = r("webpage").integers(
+            1, self.counts["web_page"] + 1, size=n, dtype=np.int64
+        )
+        arrays["ws_web_site_sk"] = r("website").integers(
+            1, self.counts["web_site"] + 1, size=n, dtype=np.int64
+        )
+        arrays["ws_ship_mode_sk"] = r("shipmode").integers(
+            1, S.FIXED_ROWS["ship_mode"] + 1, size=n, dtype=np.int64
+        )
+        arrays["ws_warehouse_sk"] = r("warehouse").integers(
+            1, self.counts["warehouse"] + 1, size=n, dtype=np.int64
         )
         arrays["ws_order_number"] = np.arange(lo + 1, hi + 1, dtype=np.int64)
         return _project(arrays, S.TABLES["web_sales"], columns)
 
+    # -- returns channels --------------------------------------------------
+    # A returns table rides its parent sales table's chunk decomposition
+    # (the TPC-H orders<->lineitem stream-consistency pattern): chunk c
+    # over parent rows [lo, hi) regenerates the parent's linking columns
+    # with the SAME (table, chunk) Philox keys, so sr_ticket_number /
+    # sr_item_sk etc. join back to real sales rows whatever order the
+    # two tables are scanned in.
+
+    def _returns_common(self, table: str, parent_chunk: dict, prefix: str,
+                        chunk: int, lo: int, hi: int):
+        n = hi - lo
+        r = lambda s: _rng(self.seed, table, chunk, _ST[s])
+        mask = r("retflag").random(n) < S.RETURN_FRACTION
+        idx = np.nonzero(mask)[0]
+        qty = parent_chunk[f"{prefix}_quantity"][idx].astype(np.int64)
+        price = parent_chunk[f"{prefix}_sales_price"][idx]
+        ret_qty = 1 + (r("retqty").integers(0, 1 << 30, size=n)[idx]
+                       % np.maximum(qty, 1))
+        amt = price * ret_qty
+        tax = (amt * 9) // 200
+        fee = r("fee").integers(50, 10_001, size=n)[idx]  # 0.50-100.00
+        ship_cost = (amt * 3) // 20  # 15% of the returned amount
+        refunded = amt // 2
+        credit = amt - refunded
+        sold = parent_chunk[f"{prefix}_sold_date_sk"][idx]
+        sold_valid = parent_chunk[f"{prefix}_sold_date_sk$valid"][idx]
+        ret_date = sold + r("retdate").integers(1, 91, size=n)[idx]
+        reason = r("retreason").integers(
+            1, S.FIXED_ROWS["reason"] + 1, size=n, dtype=np.int64
+        )[idx]
+        return {
+            "idx": idx, "ret_qty": ret_qty.astype(np.int32),
+            "amt": amt, "tax": tax, "fee": fee, "ship_cost": ship_cost,
+            "refunded": refunded, "credit": credit, "ret_date": ret_date,
+            "ret_date_valid": sold_valid, "reason": reason, "r": r,
+        }
+
+    def store_returns_chunk(self, chunk: int, lo: int, hi: int, columns=None):
+        parent = self.store_sales_chunk(chunk, lo, hi, [
+            "ss_sold_date_sk", "ss_item_sk", "ss_customer_sk", "ss_cdemo_sk",
+            "ss_hdemo_sk", "ss_addr_sk", "ss_store_sk", "ss_ticket_number",
+            "ss_quantity", "ss_sales_price",
+        ])
+        c = self._returns_common("store_returns", parent, "ss", chunk, lo, hi)
+        idx = c["idx"]
+        arrays = {
+            "sr_returned_date_sk": c["ret_date"],
+            "sr_returned_date_sk$valid": c["ret_date_valid"],
+            "sr_item_sk": parent["ss_item_sk"][idx],
+            "sr_customer_sk": parent["ss_customer_sk"][idx],
+            "sr_cdemo_sk": parent["ss_cdemo_sk"][idx],
+            "sr_cdemo_sk$valid": parent["ss_cdemo_sk$valid"][idx],
+            "sr_hdemo_sk": parent["ss_hdemo_sk"][idx],
+            "sr_addr_sk": parent["ss_addr_sk"][idx],
+            "sr_store_sk": parent["ss_store_sk"][idx],
+            "sr_reason_sk": c["reason"],
+            "sr_ticket_number": parent["ss_ticket_number"][idx],
+            "sr_return_quantity": c["ret_qty"],
+            "sr_return_amt": c["amt"],
+            "sr_return_tax": c["tax"],
+            "sr_fee": c["fee"],
+            "sr_return_ship_cost": c["ship_cost"],
+            "sr_refunded_cash": c["refunded"],
+            "sr_store_credit": c["credit"],
+            "sr_net_loss": c["tax"] + c["fee"] + c["ship_cost"],
+        }
+        return _project(arrays, S.TABLES["store_returns"], columns)
+
+    def catalog_returns_chunk(self, chunk: int, lo: int, hi: int, columns=None):
+        parent = self.catalog_sales_chunk(chunk, lo, hi, [
+            "cs_sold_date_sk", "cs_item_sk", "cs_bill_customer_sk",
+            "cs_ship_customer_sk", "cs_ship_addr_sk", "cs_call_center_sk",
+            "cs_order_number", "cs_quantity", "cs_sales_price",
+        ])
+        c = self._returns_common("catalog_returns", parent, "cs", chunk, lo, hi)
+        idx = c["idx"]
+        arrays = {
+            "cr_returned_date_sk": c["ret_date"],
+            "cr_returned_date_sk$valid": c["ret_date_valid"],
+            "cr_item_sk": parent["cs_item_sk"][idx],
+            "cr_refunded_customer_sk": parent["cs_bill_customer_sk"][idx],
+            "cr_returning_customer_sk": parent["cs_ship_customer_sk"][idx],
+            "cr_returning_addr_sk": parent["cs_ship_addr_sk"][idx],
+            "cr_call_center_sk": parent["cs_call_center_sk"][idx],
+            "cr_reason_sk": c["reason"],
+            "cr_order_number": parent["cs_order_number"][idx],
+            "cr_return_quantity": c["ret_qty"],
+            "cr_return_amount": c["amt"],
+            "cr_return_tax": c["tax"],
+            "cr_fee": c["fee"],
+            "cr_return_ship_cost": c["ship_cost"],
+            "cr_refunded_cash": c["refunded"],
+            "cr_store_credit": c["credit"],
+            "cr_net_loss": c["tax"] + c["fee"] + c["ship_cost"],
+        }
+        return _project(arrays, S.TABLES["catalog_returns"], columns)
+
+    def web_returns_chunk(self, chunk: int, lo: int, hi: int, columns=None):
+        parent = self.web_sales_chunk(chunk, lo, hi, [
+            "ws_sold_date_sk", "ws_item_sk", "ws_bill_customer_sk",
+            "ws_ship_customer_sk", "ws_ship_addr_sk", "ws_order_number",
+            "ws_quantity", "ws_sales_price",
+        ])
+        c = self._returns_common("web_returns", parent, "ws", chunk, lo, hi)
+        idx = c["idx"]
+        r = c["r"]
+        cdemo = r("cdemo").integers(
+            1, S.FIXED_ROWS["customer_demographics"] + 1, size=hi - lo,
+            dtype=np.int64,
+        )
+        cdemo2 = r("retcust").integers(
+            1, S.FIXED_ROWS["customer_demographics"] + 1, size=hi - lo,
+            dtype=np.int64,
+        )
+        arrays = {
+            "wr_returned_date_sk": c["ret_date"],
+            "wr_returned_date_sk$valid": c["ret_date_valid"],
+            "wr_item_sk": parent["ws_item_sk"][idx],
+            "wr_refunded_customer_sk": parent["ws_bill_customer_sk"][idx],
+            "wr_refunded_cdemo_sk": cdemo[idx],
+            "wr_refunded_addr_sk": parent["ws_ship_addr_sk"][idx],
+            "wr_returning_customer_sk": parent["ws_ship_customer_sk"][idx],
+            "wr_returning_cdemo_sk": cdemo2[idx],
+            "wr_reason_sk": c["reason"],
+            "wr_order_number": parent["ws_order_number"][idx],
+            "wr_return_quantity": c["ret_qty"],
+            "wr_return_amt": c["amt"],
+            "wr_return_tax": c["tax"],
+            "wr_fee": c["fee"],
+            "wr_return_ship_cost": c["ship_cost"],
+            "wr_refunded_cash": c["refunded"],
+            "wr_net_loss": c["tax"] + c["fee"] + c["ship_cost"],
+        }
+        return _project(arrays, S.TABLES["web_returns"], columns)
+
     # -- dispatch ----------------------------------------------------------
     def base_rows(self, table: str) -> int:
+        """Generation units per table: parent sales rows for returns
+        (variable output rows per chunk, like TPC-H lineitem)."""
+        if table in S.RETURN_PARENT:
+            return self.counts[S.RETURN_PARENT[table]]
         return self.counts[table]
 
     def generate(self, table: str, chunk: int, lo: int, hi: int, columns=None):
@@ -460,6 +908,14 @@ class TpcdsGenerator:
             return customer_demographics_chunk(lo, hi, columns)
         if table == "household_demographics":
             return household_demographics_chunk(lo, hi, columns)
+        if table == "time_dim":
+            return time_dim_chunk(lo, hi, columns)
+        if table == "reason":
+            return reason_chunk(lo, hi, columns)
+        if table == "ship_mode":
+            return ship_mode_chunk(lo, hi, columns)
+        if table == "income_band":
+            return income_band_chunk(lo, hi, columns)
         return getattr(self, f"{table}_chunk")(chunk, lo, hi, columns)
 
 

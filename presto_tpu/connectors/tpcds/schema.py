@@ -123,6 +123,42 @@ COMMENT_WORDS = (
     "detect cajole"
 ).split()
 
+SALUTATIONS = ["Dr.", "Miss", "Mr.", "Mrs.", "Ms.", "Sir"]
+
+# dsdgen reason word list (abbreviated to the spec's reason shapes)
+REASONS = [
+    "Package was damaged", "Stopped working", "Did not get it on time",
+    "Not the product that was ordred", "Parts missing",
+    "Does not work with a product that I have", "Gift exchange",
+    "Did not like the color", "Did not like the model", "Did not fit",
+    "Wrong size", "Lost my job", "unauthoized purchase", "Found a better price",
+    "Not working any more", "No service location in my area",
+    "Did not like the warranty", "Did not believe the warranty",
+    "duplicate purchase", "its is a boy", "its is a girl", "reason 22",
+    "reason 23", "reason 24", "reason 25", "reason 26", "reason 27",
+    "reason 28", "reason 29", "reason 30", "reason 31", "reason 32",
+    "reason 33", "reason 34", "reason 35",
+]
+
+SHIP_MODE_TYPES = ["EXPRESS", "LIBRARY", "NEXT DAY", "OVERNIGHT",
+                   "REGULAR", "TWO DAY"]
+SHIP_MODE_CODES = ["AIR", "SURFACE", "SEA"]
+SHIP_CARRIERS = [
+    "UPS", "FEDEX", "AIRBORNE", "USPS", "DHL", "TBS", "ZHOU", "ZOUROS",
+    "MSC", "LATVIAN", "ALLIANCE", "ORIENTAL", "BARIAN", "BOXBUNDLES",
+    "HARMSTORF", "PRIVATECARRIER", "DIAMOND", "RUPEKSA", "GERMA", "GREAT EASTERN",
+]
+
+CC_NAMES = ["NY Metro", "Mid Atlantic", "Pacific Northwest", "North Midwest",
+            "California", "New England"]
+WEB_COMPANY_NAMES = ["pri", "able", "ese", "anti", "cally", "ation"]
+WEB_PAGE_TYPES = ["ad", "dynamic", "feedback", "general", "order", "protected",
+                  "welcome"]
+AM_PM = ["AM", "PM"]
+SHIFTS = ["first", "second", "third"]
+SUB_SHIFTS = ["morning", "afternoon", "evening", "night"]
+MEAL_TIMES = ["", "breakfast", "lunch", "dinner"]
+
 # ---------------------------------------------------------------------------
 # date_dim span: 1900-01-01 .. 2100-01-01 (dsdgen), julian-numbered sks
 # ---------------------------------------------------------------------------
@@ -175,6 +211,30 @@ DICTS = {
     "p_channel_tv": Dictionary(YN),
     "p_channel_event": Dictionary(YN),
     "p_discount_active": Dictionary(YN),
+    "c_salutation": Dictionary(SALUTATIONS),
+    "c_preferred_cust_flag": Dictionary(YN),
+    "w_warehouse_name": Dictionary(
+        [f"Warehouse #{i}" for i in range(1, 31)]
+    ),
+    "w_city": Dictionary(["Fairview", "Midway", "Oak Grove", "Five Points",
+                          "Centerville"]),
+    "w_county": Dictionary(COUNTIES),
+    "w_state": Dictionary(STATES),
+    "w_country": Dictionary(COUNTRIES),
+    "r_reason_desc": Dictionary(REASONS),
+    "sm_type": Dictionary(SHIP_MODE_TYPES),
+    "sm_code": Dictionary(SHIP_MODE_CODES),
+    "sm_carrier": Dictionary(SHIP_CARRIERS),
+    "cc_name": Dictionary(CC_NAMES),
+    "cc_county": Dictionary(COUNTIES),
+    "cc_state": Dictionary(STATES),
+    "web_name": Dictionary([f"site_{i}" for i in range(30)]),
+    "web_company_name": Dictionary(WEB_COMPANY_NAMES),
+    "wp_type": Dictionary(WEB_PAGE_TYPES),
+    "t_am_pm": Dictionary(AM_PM),
+    "t_shift": Dictionary(SHIFTS),
+    "t_sub_shift": Dictionary(SUB_SHIFTS),
+    "t_meal_time": Dictionary(MEAL_TIMES),
 }
 
 # ---------------------------------------------------------------------------
@@ -222,11 +282,82 @@ TABLES: dict[str, dict[str, DataType]] = {
         "c_current_cdemo_sk": BIGINT,
         "c_current_hdemo_sk": BIGINT,
         "c_current_addr_sk": BIGINT,
+        "c_salutation": varchar(),
+        "c_preferred_cust_flag": varchar(),
         "c_first_name": fixed_bytes(20),
         "c_last_name": fixed_bytes(30),
         "c_birth_year": INTEGER,
         "c_birth_month": INTEGER,
         "c_email_address": fixed_bytes(50),
+    },
+    "warehouse": {
+        "w_warehouse_sk": BIGINT,
+        "w_warehouse_id": fixed_bytes(16),
+        "w_warehouse_name": varchar(),
+        "w_warehouse_sq_ft": INTEGER,
+        "w_city": varchar(),
+        "w_county": varchar(),
+        "w_state": varchar(),
+        "w_country": varchar(),
+        "w_gmt_offset": decimal(5, 2),
+    },
+    "reason": {
+        "r_reason_sk": BIGINT,
+        "r_reason_id": fixed_bytes(16),
+        "r_reason_desc": varchar(),
+    },
+    "ship_mode": {
+        "sm_ship_mode_sk": BIGINT,
+        "sm_ship_mode_id": fixed_bytes(16),
+        "sm_type": varchar(),
+        "sm_code": varchar(),
+        "sm_carrier": varchar(),
+    },
+    "income_band": {
+        "ib_income_band_sk": BIGINT,
+        "ib_lower_bound": INTEGER,
+        "ib_upper_bound": INTEGER,
+    },
+    "call_center": {
+        "cc_call_center_sk": BIGINT,
+        "cc_call_center_id": fixed_bytes(16),
+        "cc_name": varchar(),
+        "cc_manager": fixed_bytes(40),
+        "cc_mkt_id": INTEGER,
+        "cc_county": varchar(),
+        "cc_state": varchar(),
+    },
+    "web_site": {
+        "web_site_sk": BIGINT,
+        "web_site_id": fixed_bytes(16),
+        "web_name": varchar(),
+        "web_company_name": varchar(),
+        "web_manager": fixed_bytes(40),
+    },
+    "web_page": {
+        "wp_web_page_sk": BIGINT,
+        "wp_web_page_id": fixed_bytes(16),
+        "wp_char_count": INTEGER,
+        "wp_link_count": INTEGER,
+        "wp_type": varchar(),
+    },
+    "time_dim": {
+        "t_time_sk": BIGINT,
+        "t_time_id": fixed_bytes(16),
+        "t_time": INTEGER,
+        "t_hour": INTEGER,
+        "t_minute": INTEGER,
+        "t_second": INTEGER,
+        "t_am_pm": varchar(),
+        "t_shift": varchar(),
+        "t_sub_shift": varchar(),
+        "t_meal_time": varchar(),
+    },
+    "inventory": {
+        "inv_date_sk": BIGINT,
+        "inv_item_sk": BIGINT,
+        "inv_warehouse_sk": BIGINT,
+        "inv_quantity_on_hand": INTEGER,
     },
     "customer_address": {
         "ca_address_sk": BIGINT,
@@ -291,6 +422,7 @@ TABLES: dict[str, dict[str, DataType]] = {
     },
     "store_sales": {
         "ss_sold_date_sk": BIGINT,
+        "ss_sold_time_sk": BIGINT,
         "ss_item_sk": BIGINT,
         "ss_customer_sk": BIGINT,
         "ss_cdemo_sk": BIGINT,
@@ -315,9 +447,15 @@ TABLES: dict[str, dict[str, DataType]] = {
     },
     "catalog_sales": {
         "cs_sold_date_sk": BIGINT,
+        "cs_ship_date_sk": BIGINT,
         "cs_item_sk": BIGINT,
         "cs_bill_customer_sk": BIGINT,
+        "cs_ship_customer_sk": BIGINT,
         "cs_bill_cdemo_sk": BIGINT,
+        "cs_ship_addr_sk": BIGINT,
+        "cs_call_center_sk": BIGINT,
+        "cs_ship_mode_sk": BIGINT,
+        "cs_warehouse_sk": BIGINT,
         "cs_promo_sk": BIGINT,
         "cs_order_number": BIGINT,
         "cs_quantity": INTEGER,
@@ -334,8 +472,16 @@ TABLES: dict[str, dict[str, DataType]] = {
     },
     "web_sales": {
         "ws_sold_date_sk": BIGINT,
+        "ws_sold_time_sk": BIGINT,
+        "ws_ship_date_sk": BIGINT,
         "ws_item_sk": BIGINT,
         "ws_bill_customer_sk": BIGINT,
+        "ws_ship_customer_sk": BIGINT,
+        "ws_ship_addr_sk": BIGINT,
+        "ws_web_page_sk": BIGINT,
+        "ws_web_site_sk": BIGINT,
+        "ws_ship_mode_sk": BIGINT,
+        "ws_warehouse_sk": BIGINT,
         "ws_promo_sk": BIGINT,
         "ws_order_number": BIGINT,
         "ws_quantity": INTEGER,
@@ -349,6 +495,61 @@ TABLES: dict[str, dict[str, DataType]] = {
         "ws_coupon_amt": decimal(12, 2),
         "ws_net_paid": decimal(12, 2),
         "ws_net_profit": decimal(12, 2),
+    },
+    "store_returns": {
+        "sr_returned_date_sk": BIGINT,
+        "sr_item_sk": BIGINT,
+        "sr_customer_sk": BIGINT,
+        "sr_cdemo_sk": BIGINT,
+        "sr_hdemo_sk": BIGINT,
+        "sr_addr_sk": BIGINT,
+        "sr_store_sk": BIGINT,
+        "sr_reason_sk": BIGINT,
+        "sr_ticket_number": BIGINT,
+        "sr_return_quantity": INTEGER,
+        "sr_return_amt": decimal(12, 2),
+        "sr_return_tax": decimal(12, 2),
+        "sr_fee": decimal(7, 2),
+        "sr_return_ship_cost": decimal(12, 2),
+        "sr_refunded_cash": decimal(12, 2),
+        "sr_store_credit": decimal(12, 2),
+        "sr_net_loss": decimal(12, 2),
+    },
+    "catalog_returns": {
+        "cr_returned_date_sk": BIGINT,
+        "cr_item_sk": BIGINT,
+        "cr_refunded_customer_sk": BIGINT,
+        "cr_returning_customer_sk": BIGINT,
+        "cr_returning_addr_sk": BIGINT,
+        "cr_call_center_sk": BIGINT,
+        "cr_reason_sk": BIGINT,
+        "cr_order_number": BIGINT,
+        "cr_return_quantity": INTEGER,
+        "cr_return_amount": decimal(12, 2),
+        "cr_return_tax": decimal(12, 2),
+        "cr_fee": decimal(7, 2),
+        "cr_return_ship_cost": decimal(12, 2),
+        "cr_refunded_cash": decimal(12, 2),
+        "cr_store_credit": decimal(12, 2),
+        "cr_net_loss": decimal(12, 2),
+    },
+    "web_returns": {
+        "wr_returned_date_sk": BIGINT,
+        "wr_item_sk": BIGINT,
+        "wr_refunded_customer_sk": BIGINT,
+        "wr_refunded_cdemo_sk": BIGINT,
+        "wr_refunded_addr_sk": BIGINT,
+        "wr_returning_customer_sk": BIGINT,
+        "wr_returning_cdemo_sk": BIGINT,
+        "wr_reason_sk": BIGINT,
+        "wr_order_number": BIGINT,
+        "wr_return_quantity": INTEGER,
+        "wr_return_amt": decimal(12, 2),
+        "wr_return_tax": decimal(12, 2),
+        "wr_fee": decimal(7, 2),
+        "wr_return_ship_cost": decimal(12, 2),
+        "wr_refunded_cash": decimal(12, 2),
+        "wr_net_loss": decimal(12, 2),
     },
 }
 
@@ -364,6 +565,18 @@ UNIQUE_KEYS: dict[str, tuple[tuple[str, ...], ...]] = {
     "store_sales": (),
     "catalog_sales": (),
     "web_sales": (),
+    "store_returns": (),
+    "catalog_returns": (),
+    "web_returns": (),
+    "warehouse": (("w_warehouse_sk",), ("w_warehouse_id",)),
+    "reason": (("r_reason_sk",), ("r_reason_id",)),
+    "ship_mode": (("sm_ship_mode_sk",), ("sm_ship_mode_id",)),
+    "income_band": (("ib_income_band_sk",),),
+    "call_center": (("cc_call_center_sk",), ("cc_call_center_id",)),
+    "web_site": (("web_site_sk",), ("web_site_id",)),
+    "web_page": (("wp_web_page_sk",), ("wp_web_page_id",)),
+    "time_dim": (("t_time_sk",), ("t_time_id",), ("t_time",)),
+    "inventory": (),
 }
 
 
@@ -387,6 +600,11 @@ def table_dicts(table: str) -> dict[str, Dictionary]:
     return {c: DICTS[c] for c in TABLES[table] if c in DICTS}
 
 
+#: probability a sales row has a return (dsdgen ratio ~10%)
+RETURN_FRACTION = 0.1
+#: inventory snapshot cadence: weekly over the sales span (261 weeks)
+INVENTORY_WEEKS = (SALES_DATE_HI - SALES_DATE_LO) // 7 + 1
+
 #: base rows per unit scale factor (facts scale linearly; dims follow
 #: dsdgen's SF1 counts; demographics/date_dim are fixed)
 ROWS_PER_SF = {
@@ -398,6 +616,10 @@ ROWS_PER_SF = {
     "item": 18_000,
     "store": 12,
     "promotion": 300,
+    "warehouse": 5,
+    "call_center": 6,
+    "web_site": 30,
+    "web_page": 60,
 }
 
 FIXED_ROWS = {
@@ -406,13 +628,30 @@ FIXED_ROWS = {
     * CD_DEP_COUNTS * CD_DEP_COUNTS,  # 1_920_800
     "household_demographics": HD_INCOME_BANDS * len(BUY_POTENTIALS)
     * HD_DEP_COUNTS * HD_VEHICLES,  # 7200
+    "reason": len(REASONS),
+    "ship_mode": 20,
+    "income_band": HD_INCOME_BANDS,
+    "time_dim": 86_400,
+}
+
+#: returns ride their parent sales table's chunk decomposition
+#: (lineitem-style stream consistency): generation units ARE parent rows
+RETURN_PARENT = {
+    "store_returns": "store_sales",
+    "catalog_returns": "catalog_sales",
+    "web_returns": "web_sales",
 }
 
 
 def row_count(table: str, sf: float) -> int:
     if table in FIXED_ROWS:
         return FIXED_ROWS[table]
+    if table in RETURN_PARENT:
+        return max(1, int(row_count(RETURN_PARENT[table], sf) * RETURN_FRACTION))
+    if table == "inventory":
+        return INVENTORY_WEEKS * row_count("item", sf) * row_count("warehouse", sf)
     base = ROWS_PER_SF[table]
     mins = {"item": 102, "store": 4, "promotion": 3, "customer": 100,
-            "customer_address": 50}
+            "customer_address": 50, "warehouse": 3, "call_center": 2,
+            "web_site": 2, "web_page": 4}
     return max(int(base * sf), mins.get(table, 1))

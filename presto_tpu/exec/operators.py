@@ -603,18 +603,26 @@ class CollectingOperator(Operator):
 
 
 def concat_batches(batches: list[Batch]) -> Batch:
-    """Concatenate along rows (device op)."""
+    """Concatenate along rows (device op). The output dictionary per
+    column is the first non-None one — a NULL-literal union branch
+    (grouping-sets subtotal rows) carries none, and taking its None
+    would decode every later batch's codes as raw integers."""
     first = batches[0]
     if len(batches) == 1:
         return first
     cols = {}
     for name in first.names:
         t = first[name].dtype
+        d = next(
+            (b[name].dictionary for b in batches
+             if b[name].dictionary is not None),
+            None,
+        )
         cols[name] = Column(
             jnp.concatenate([b[name].data for b in batches]),
             jnp.concatenate([b[name].valid for b in batches]),
             t,
-            first[name].dictionary,
+            d,
         )
     return Batch(cols, jnp.concatenate([b.live for b in batches]))
 

@@ -293,6 +293,8 @@ def estimate_rows(node: N.PlanNode, catalog) -> int:
         return node.count
     if isinstance(node, N.Limit):
         return node.count
+    if isinstance(node, N.Union):
+        return sum(estimate_rows(c, catalog) for c in node.inputs)
     children = node.children
     if children:
         return max(estimate_rows(c, catalog) for c in children)

@@ -54,6 +54,16 @@ class Parser:
             return True
         return False
 
+    def _query_follows(self, idx: int) -> bool:
+        """True when the tokens at ``idx`` open a query expression,
+        possibly through nested parens: ``((select ...`` — the standard
+        TPC-DS spelling of parenthesized union terms."""
+        j = idx
+        while self.toks[j].kind == "OP" and self.toks[j].text == "(":
+            j += 1
+        t = self.toks[j]
+        return t.kind == "KW" and t.text.lower() in ("select", "with")
+
     def eat(self):
         t = self.cur
         self.i += 1
@@ -158,9 +168,7 @@ class Parser:
         """One UNION operand: a parenthesized query or a bare select
         core (whose ORDER BY/LIMIT, if unparenthesized, belong to the
         enclosing query — standard SQL). Returns (term, parenthesized)."""
-        if self.op("(") and self.toks[self.i + 1].kind == "KW" and self.toks[
-            self.i + 1
-        ].text.lower() in ("select", "with"):
+        if self.op("(") and self._query_follows(self.i + 1):
             self.eat()
             q = self.parse_query()
             self.expect_op(")")
@@ -323,13 +331,15 @@ class Parser:
         return rel
 
     def parse_primary_relation(self) -> A.Node:
+        if self.op("(") and self._query_follows(self.i + 1):
+            # derived table, possibly a parenthesized UNION chain:
+            # FROM ((select ...) union all (select ...)) t
+            self.eat()
+            q = self.parse_query()
+            self.expect_op(")")
+            alias = self._maybe_alias()
+            return A.SubqueryRelation(q, alias)
         if self.accept_op("("):
-            # subquery or parenthesized join
-            if self.kw("select", "with"):
-                q = self.parse_query()
-                self.expect_op(")")
-                alias = self._maybe_alias()
-                return A.SubqueryRelation(q, alias)
             rel = self.parse_relation_list()
             self.expect_op(")")
             return rel
