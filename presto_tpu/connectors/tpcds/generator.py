@@ -688,6 +688,10 @@ class TpcdsGenerator:
             1, self.counts["customer"] + 1, size=n, dtype=np.int64
         )
         arrays["cs_bill_customer_sk"] = bill
+        arrays["cs_sold_time_sk"] = r("soldtime").integers(
+            0, 86_400, size=n, dtype=np.int64
+        )
+        arrays["cs_sold_time_sk$valid"] = r("null5").random(n) >= 0.04
         # ~10% of orders ship to a different customer (gift shape)
         other = r("shipcust").integers(
             1, self.counts["customer"] + 1, size=n, dtype=np.int64

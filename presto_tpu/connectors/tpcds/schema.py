@@ -447,6 +447,7 @@ TABLES: dict[str, dict[str, DataType]] = {
     },
     "catalog_sales": {
         "cs_sold_date_sk": BIGINT,
+        "cs_sold_time_sk": BIGINT,
         "cs_ship_date_sk": BIGINT,
         "cs_item_sk": BIGINT,
         "cs_bill_customer_sk": BIGINT,

@@ -94,6 +94,11 @@ def _sortable(v):
     return HashAggregationOperator._sortable(v)
 
 
+def _sortables(v) -> list:
+    """Surrogate column list; wide BYTES expand to 7-byte chunks."""
+    return HashAggregationOperator._sortables(v)
+
+
 import functools
 
 
@@ -423,7 +428,7 @@ class DistributedExecutor:
         def partial_phase(b: Batch):
             kvals = [evaluate(e, b) for _, e in keys]
             pvals = [evaluate(e, b) for _, e in pax]
-            sortables = [_sortable(v) for v in kvals]
+            sortables = [c for v in kvals for c in _sortables(v)]
             gids, rep, ng, ovf = group_ids_sort(sortables, b.live, mg)
             cols: dict[str, Column] = {}
             for (n, e), v in zip(keys, kvals):
@@ -460,7 +465,7 @@ class DistributedExecutor:
 
         def final_phase(b: Batch):
             kvals = [b[n] for n, _ in keys]
-            sortables = [_sortable(v) for v in kvals]
+            sortables = [c for v in kvals for c in _sortables(v)]
             gids, rep, ng, ovf = group_ids_sort(sortables, b.live, mgf)
             cols: dict[str, Column] = {}
             for (n, e), v in zip(keys, kvals):
@@ -499,7 +504,7 @@ class DistributedExecutor:
         )
         def step(b: Batch):
             part, ovf1 = partial_phase(b)
-            key_sort = [_sortable(part[n]) for n, _ in keys]
+            key_sort = [c for n, _ in keys for c in _sortables(part[n])]
             pids = partition_ids(key_sort, Pn)
             exch, ovf2 = exchange_multiround(part, pids, Pn, quota, mgf)
             out, ovf3 = final_phase(exch)

@@ -196,6 +196,24 @@ class HashAggregationOperator(Operator):
             return out
         return data
 
+    @staticmethod
+    def _sortables(v) -> list:
+        """Group-sort surrogate column list: wide BYTES expand into
+        big-endian 7-byte int64 chunks (the sort/window convention), so
+        any-width keys participate in multi-key grouping; everything
+        else is a single surrogate."""
+        from presto_tpu.ops.sort import bytes_sort_chunks
+
+        if v.dtype.kind is TypeKind.BYTES:
+            return bytes_sort_chunks(v.data)
+        return [v.data]
+
+    @staticmethod
+    def _key_chunks(e: Expr) -> int:
+        if e.dtype.kind is TypeKind.BYTES:
+            return -(-e.dtype.width // 7)
+        return 1
+
     # -- shared helpers ---------------------------------------------------
 
     def _agg_kind(self, a: AggSpec) -> str:
@@ -345,13 +363,22 @@ class HashAggregationOperator(Operator):
         pvals = self._eval_passengers(batch)
         inputs = self._eval_inputs(batch)
 
-        # concat: state group rows [g] + batch rows [cap]
+        # concat: state group rows [g] + batch rows [cap]; wide BYTES
+        # keys contribute one sort column per 7-byte chunk
         cat_sort = []
-        for (n, _), v in zip(self.group_keys, kvals):
-            s = self._sortable(v)
-            cat_sort.append(
-                jnp.concatenate([state["key$" + n], s.astype(state["key$" + n].dtype)])
-            )
+        sort_names = []
+        for (n, e), v in zip(self.group_keys, kvals):
+            if e.dtype.kind is TypeKind.BYTES:
+                for j, c in enumerate(self._sortables(v)):
+                    key = f"key${n}${j}"
+                    cat_sort.append(jnp.concatenate([state[key], c]))
+                    sort_names.append(key)
+            else:
+                key = "key$" + n
+                cat_sort.append(jnp.concatenate(
+                    [state[key], v.data.astype(state[key].dtype)]
+                ))
+                sort_names.append(key)
         cat_live = jnp.concatenate([state["present"], batch.live])
         gids, rep, ng, ovf = group_ids_sort(cat_sort, cat_live, g)
 
@@ -363,8 +390,9 @@ class HashAggregationOperator(Operator):
 
         new = dict(state)
         new["overflow"] = state["overflow"] | ovf
-        for i, ((n, e), v) in enumerate(zip(self.group_keys, kvals)):
-            new["key$" + n] = gat(cat_sort[i])
+        for key, cat in zip(sort_names, cat_sort):
+            new[key] = gat(cat)
+        for (n, e), v in zip(self.group_keys, kvals):
             if e.dtype.kind is TypeKind.BYTES:
                 cat_raw = jnp.concatenate([state["keyraw$" + n], v.data])
                 new["keyraw$" + n] = gat(cat_raw)
@@ -399,7 +427,8 @@ class HashAggregationOperator(Operator):
         }
         for name, e in self.group_keys:
             if e.dtype.kind is TypeKind.BYTES:
-                state["key$" + name] = jnp.zeros(g, jnp.int64)  # packed
+                for j in range(self._key_chunks(e)):
+                    state[f"key${name}${j}"] = jnp.zeros(g, jnp.int64)
                 state["keyraw$" + name] = jnp.zeros((g, e.dtype.width), jnp.uint8)
             else:
                 state["key$" + name] = jnp.zeros(g, e.dtype.jnp_dtype)

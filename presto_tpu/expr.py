@@ -448,6 +448,30 @@ def _abs(args, out):
     return jnp.abs(_to_physical(args[0], out)), None
 
 
+@register("sqrt", _t_double)
+def _sqrt(args, out):
+    x = _to_physical(args[0], out)
+    bad = x < 0
+    return jnp.sqrt(jnp.where(bad, 0.0, x)), ~bad & args[0].valid
+
+
+@register("floor", _t_double)
+def _floor(args, out):
+    return jnp.floor(_to_physical(args[0], out)), None
+
+
+@register("ceil", _t_double)
+def _ceil(args, out):
+    return jnp.ceil(_to_physical(args[0], out)), None
+
+
+@register("round", _t_double)
+def _round(args, out):
+    """SQL ROUND: half away from zero (jnp.round is half-even)."""
+    x = _to_physical(args[0], out)
+    return jnp.sign(x) * jnp.floor(jnp.abs(x) + 0.5), None
+
+
 @register("coalesce", _t_same)
 def _coalesce(args, out):
     data = _to_physical(args[-1], out)

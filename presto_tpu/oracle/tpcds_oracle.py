@@ -244,8 +244,859 @@ def q98(t):
     return g  # no LIMIT in q98
 
 
+# -- round-3 breadth (batch 1): returns/inventory/time/ship periphery
+
+
+def _srt(df, cols, ascending=None):
+    return df.sort_values(
+        cols, ascending=ascending if ascending is not None else True,
+        kind="stable",
+    ).reset_index(drop=True)
+
+
+def q13(t):
+    j = t["store_sales"].merge(
+        t["store"], left_on="ss_store_sk", right_on="s_store_sk"
+    ).merge(t["date_dim"], left_on="ss_sold_date_sk", right_on="d_date_sk")
+    j = j[j.d_year == 2001]
+    j = j.merge(t["customer_demographics"], left_on="ss_cdemo_sk",
+                right_on="cd_demo_sk")
+    j = j.merge(t["household_demographics"], left_on="ss_hdemo_sk",
+                right_on="hd_demo_sk")
+    j = j.merge(t["customer_address"], left_on="ss_addr_sk",
+                right_on="ca_address_sk")
+    demo = (
+        ((j.cd_marital_status == "M") & (j.cd_education_status == "Advanced Degree")
+         & j.ss_sales_price.between(50.0, 150.0))
+        | ((j.cd_marital_status == "S") & (j.cd_education_status == "College")
+           & j.ss_sales_price.between(20.0, 100.0))
+        | ((j.cd_marital_status == "W") & (j.cd_education_status == "2 yr Degree")
+           & j.ss_sales_price.between(50.0, 200.0))
+    )
+    geo = (
+        (j.ca_state.isin(["TX", "OH", "KY"]) & j.ss_net_profit.between(-5000, 20000))
+        | (j.ca_state.isin(["WA", "NE", "GA"]) & j.ss_net_profit.between(-5000, 30000))
+        | (j.ca_state.isin(["MT", "MS", "IN"]) & j.ss_net_profit.between(-5000, 25000))
+    )
+    j = j[demo & geo]
+    return pd.DataFrame({
+        "a1": [j.ss_quantity.mean()],
+        "a2": [j.ss_ext_sales_price.mean()],
+        "a3": [j.ss_ext_wholesale_cost.mean()],
+        "a4": [j.ss_ext_wholesale_cost.sum()],
+    })
+
+
+def q21(t):
+    lo = D("2000-03-11") - np.timedelta64(30, "D")
+    hi = D("2000-03-11") + np.timedelta64(30, "D")
+    j = t["inventory"].merge(
+        t["warehouse"], left_on="inv_warehouse_sk", right_on="w_warehouse_sk"
+    ).merge(t["item"], left_on="inv_item_sk", right_on="i_item_sk").merge(
+        t["date_dim"], left_on="inv_date_sk", right_on="d_date_sk"
+    )
+    j = j[(j.d_date >= lo) & (j.d_date <= hi)]
+    pivot = D("2000-03-11")
+    j = j.assign(
+        inv_before=np.where(j.d_date < pivot, j.inv_quantity_on_hand, 0),
+        inv_after=np.where(j.d_date >= pivot, j.inv_quantity_on_hand, 0),
+    )
+    # NULL quantities contribute 0 to both buckets (CASE yields the
+    # quantity only when non-null; engine sums skip NULL)
+    j["inv_before"] = j["inv_before"].fillna(0)
+    j["inv_after"] = j["inv_after"].fillna(0)
+    g = j.groupby(["w_warehouse_name", "i_item_id"], as_index=False).agg(
+        inv_before=("inv_before", "sum"), inv_after=("inv_after", "sum")
+    )
+    g = g[g.inv_before > 0]
+    g["inv_before"] = g["inv_before"].astype(np.int64)
+    g["inv_after"] = g["inv_after"].astype(np.int64)
+    return _srt(g, ["w_warehouse_name", "i_item_id"]).head(100)
+
+
+def _sales_return_catalog(t, d1_years, d2_years, d3_years):
+    ss = t["store_sales"].merge(
+        t["date_dim"][["d_date_sk", "d_year", "d_qoy"]],
+        left_on="ss_sold_date_sk", right_on="d_date_sk",
+    )
+    ss = ss[ss.d_year.isin(d1_years)]
+    j = ss.merge(
+        t["store_returns"],
+        left_on=["ss_customer_sk", "ss_item_sk", "ss_ticket_number"],
+        right_on=["sr_customer_sk", "sr_item_sk", "sr_ticket_number"],
+    )
+    d2 = t["date_dim"][["d_date_sk", "d_year"]].rename(
+        columns={"d_date_sk": "d2_sk", "d_year": "d2_year"}
+    )
+    j = j.merge(d2, left_on="sr_returned_date_sk", right_on="d2_sk")
+    j = j[j.d2_year.isin(d2_years)]
+    j = j.merge(
+        t["catalog_sales"],
+        left_on=["sr_customer_sk", "sr_item_sk"],
+        right_on=["cs_bill_customer_sk", "cs_item_sk"],
+    )
+    d3 = t["date_dim"][["d_date_sk", "d_year"]].rename(
+        columns={"d_date_sk": "d3_sk", "d_year": "d3_year"}
+    )
+    j = j.merge(d3, left_on="cs_sold_date_sk", right_on="d3_sk")
+    j = j[j.d3_year.isin(d3_years)]
+    j = j.merge(t["store"], left_on="ss_store_sk", right_on="s_store_sk")
+    j = j.merge(t["item"], left_on="ss_item_sk", right_on="i_item_sk")
+    return j
+
+
+def q25(t):
+    j = _sales_return_catalog(t, [2000], [2000], [2000])
+    g = j.groupby(
+        ["i_item_id", "i_item_desc", "s_store_id", "s_store_name"],
+        as_index=False,
+    ).agg(
+        store_sales_profit=("ss_net_profit", "sum"),
+        store_returns_loss=("sr_net_loss", "sum"),
+        catalog_sales_profit=("cs_net_profit", "sum"),
+    )
+    return _srt(
+        g, ["i_item_id", "i_item_desc", "s_store_id", "s_store_name"]
+    ).head(100)
+
+
+def q29(t):
+    j = _sales_return_catalog(t, [1999], [1999, 2000], [1999, 2000, 2001])
+    g = j.groupby(
+        ["i_item_id", "i_item_desc", "s_store_id", "s_store_name"],
+        as_index=False,
+    ).agg(
+        store_sales_quantity=("ss_quantity", "sum"),
+        store_returns_quantity=("sr_return_quantity", "sum"),
+        catalog_sales_quantity=("cs_quantity", "sum"),
+    )
+    return _srt(
+        g, ["i_item_id", "i_item_desc", "s_store_id", "s_store_name"]
+    ).head(100)
+
+
+def q37(t):
+    it = t["item"]
+    it = it[it.i_current_price.between(10.0, 60.0) & (it.i_manufact_id <= 300)]
+    j = it.merge(t["inventory"], left_on="i_item_sk", right_on="inv_item_sk")
+    j = j.merge(t["date_dim"], left_on="inv_date_sk", right_on="d_date_sk")
+    j = j[(j.d_date >= D("2000-01-01")) & (j.d_date <= D("2000-03-01"))]
+    j = j[j.inv_quantity_on_hand.between(100, 700)]
+    j = j.merge(
+        t["catalog_sales"][["cs_item_sk"]], left_on="i_item_sk",
+        right_on="cs_item_sk",
+    )
+    g = j.groupby(
+        ["i_item_id", "i_item_desc", "i_current_price"], as_index=False
+    ).size()[["i_item_id", "i_item_desc", "i_current_price"]]
+    return _srt(g, ["i_item_id"]).head(100)
+
+
+def q43(t):
+    st = t["store"]
+    st = st[st.s_gmt_offset <= -5]
+    j = t["store_sales"].merge(
+        t["date_dim"], left_on="ss_sold_date_sk", right_on="d_date_sk"
+    )
+    j = j[j.d_year == 2000]
+    j = j.merge(st, left_on="ss_store_sk", right_on="s_store_sk")
+    days = ["Sunday", "Monday", "Tuesday", "Wednesday", "Thursday", "Friday",
+            "Saturday"]
+    names = ["sun_sales", "mon_sales", "tue_sales", "wed_sales", "thu_sales",
+             "fri_sales", "sat_sales"]
+    for d, nm in zip(days, names):
+        j[nm] = j.ss_sales_price.where(j.d_day_name == d)
+    g = j.groupby(["s_store_name", "s_store_id"], as_index=False)[names].sum(
+        min_count=1
+    )
+    return _srt(g, ["s_store_name", "s_store_id"]).head(100)
+
+
+def _ship_lag(t, fact, prefix, dims):
+    f = t[fact]
+    lag = f[f"{prefix}_ship_date_sk"] - f[f"{prefix}_sold_date_sk"]
+    f = f.assign(
+        d30=(lag <= 30).astype(int),
+        d60=((lag > 30) & (lag <= 60)).astype(int),
+        d90=((lag > 60) & (lag <= 90)).astype(int),
+        d120=(lag > 90).astype(int),
+    )
+    dd = t["date_dim"]
+    dd = dd[dd.d_month_seq.between(1200, 1211)]
+    j = f.merge(dd, left_on=f"{prefix}_ship_date_sk", right_on="d_date_sk")
+    for table, lk, rk in dims:
+        j = j.merge(t[table], left_on=lk, right_on=rk)
+    return j
+
+
+def q62(t):
+    j = _ship_lag(t, "web_sales", "ws", [
+        ("warehouse", "ws_warehouse_sk", "w_warehouse_sk"),
+        ("ship_mode", "ws_ship_mode_sk", "sm_ship_mode_sk"),
+        ("web_site", "ws_web_site_sk", "web_site_sk"),
+    ])
+    g = j.groupby(["w_warehouse_name", "sm_type", "web_name"],
+                  as_index=False)[["d30", "d60", "d90", "d120"]].sum()
+    return _srt(g, ["w_warehouse_name", "sm_type", "web_name"]).head(100)
+
+
+def q99(t):
+    j = _ship_lag(t, "catalog_sales", "cs", [
+        ("warehouse", "cs_warehouse_sk", "w_warehouse_sk"),
+        ("ship_mode", "cs_ship_mode_sk", "sm_ship_mode_sk"),
+        ("call_center", "cs_call_center_sk", "cc_call_center_sk"),
+    ])
+    g = j.groupby(["w_warehouse_name", "sm_type", "cc_name"],
+                  as_index=False)[["d30", "d60", "d90", "d120"]].sum()
+    return _srt(g, ["w_warehouse_name", "sm_type", "cc_name"]).head(100)
+
+
+def q79(t):
+    j = t["store_sales"].merge(
+        t["date_dim"], left_on="ss_sold_date_sk", right_on="d_date_sk"
+    )
+    j = j[(j.d_dow == 1) & (j.d_year == 2000)]
+    j = j.merge(t["store"], left_on="ss_store_sk", right_on="s_store_sk")
+    j = j.merge(t["household_demographics"], left_on="ss_hdemo_sk",
+                right_on="hd_demo_sk")
+    j = j[(j.hd_dep_count == 6) | (j.hd_vehicle_count > 2)]
+    g = j.groupby(
+        ["ss_ticket_number", "ss_customer_sk", "ss_addr_sk", "s_city"],
+        as_index=False, dropna=False,
+    ).agg(amt=("ss_coupon_amt", "sum"), profit=("ss_net_profit", "sum"))
+    g = g.merge(t["customer"], left_on="ss_customer_sk",
+                right_on="c_customer_sk")
+    out = g[["c_last_name", "c_first_name", "s_city", "ss_ticket_number",
+             "amt", "profit"]]
+    return _srt(
+        out, ["c_last_name", "c_first_name", "s_city", "profit",
+              "ss_ticket_number"],
+    ).head(100)
+
+
+def q91(t):
+    j = t["catalog_returns"].merge(
+        t["call_center"], left_on="cr_call_center_sk",
+        right_on="cc_call_center_sk",
+    ).merge(t["date_dim"], left_on="cr_returned_date_sk", right_on="d_date_sk")
+    j = j[j.d_year == 2000]
+    j = j.merge(t["customer"], left_on="cr_returning_customer_sk",
+                right_on="c_customer_sk")
+    j = j.merge(t["customer_demographics"], left_on="c_current_cdemo_sk",
+                right_on="cd_demo_sk")
+    j = j.merge(t["household_demographics"], left_on="c_current_hdemo_sk",
+                right_on="hd_demo_sk")
+    j = j[
+        ((j.cd_marital_status == "M") & (j.cd_education_status == "Unknown"))
+        | ((j.cd_marital_status == "W")
+           & (j.cd_education_status == "Advanced Degree"))
+    ]
+    j = j[j.hd_buy_potential.str.startswith("0-500")]
+    g = j.groupby(["cc_call_center_id", "cc_name", "cc_manager"],
+                  as_index=False).agg(returns_loss=("cr_net_loss", "sum"))
+    return _srt(g, ["returns_loss", "cc_call_center_id"],
+                ascending=[False, True]).head(100)
+
+
+def q93(t):
+    re = t["reason"]
+    re = re[re.r_reason_desc == "Stopped working"]
+    j = t["store_sales"].merge(
+        t["store_returns"],
+        left_on=["ss_item_sk", "ss_ticket_number"],
+        right_on=["sr_item_sk", "sr_ticket_number"],
+    )
+    j = j.merge(re, left_on="sr_reason_sk", right_on="r_reason_sk")
+    act = np.where(
+        j.sr_return_quantity.notna(),
+        (j.ss_quantity - j.sr_return_quantity) * j.ss_sales_price,
+        j.ss_quantity * j.ss_sales_price,
+    )
+    j = j.assign(act_sales=act)
+    g = j.groupby("ss_customer_sk", as_index=False).agg(
+        sumsales=("act_sales", "sum")
+    )
+    return _srt(g, ["sumsales", "ss_customer_sk"]).head(100)
+
+
+def q96(t):
+    j = t["store_sales"].merge(
+        t["time_dim"], left_on="ss_sold_time_sk", right_on="t_time_sk"
+    ).merge(t["household_demographics"], left_on="ss_hdemo_sk",
+            right_on="hd_demo_sk").merge(
+        t["store"], left_on="ss_store_sk", right_on="s_store_sk"
+    )
+    j = j[(j.t_hour == 20) & (j.t_minute >= 30) & (j.hd_dep_count == 7)
+          & (j.s_store_name == "ese")]
+    return pd.DataFrame({"cnt": [len(j)]})
+
+
+
+
+
+# -- round-3 breadth (batch 2)
+
+
+def q15(t):
+    j = t["catalog_sales"].merge(
+        t["customer"], left_on="cs_bill_customer_sk", right_on="c_customer_sk"
+    ).merge(t["customer_address"], left_on="c_current_addr_sk",
+            right_on="ca_address_sk").merge(
+        t["date_dim"], left_on="cs_sold_date_sk", right_on="d_date_sk"
+    )
+    j = j[(j.d_qoy == 2) & (j.d_year == 2000)]
+    j = j[j.ca_state.isin(["CA", "WA", "GA"]) | (j.cs_sales_price > 70)]
+    j = j.assign(zip=j.ca_zip.str[:5])
+    g = j.groupby("zip", as_index=False).agg(tot=("cs_sales_price", "sum"))
+    return _srt(g, ["zip"]).head(100)
+
+
+def q45(t):
+    j = t["web_sales"].merge(
+        t["customer"], left_on="ws_bill_customer_sk", right_on="c_customer_sk"
+    ).merge(t["customer_address"], left_on="c_current_addr_sk",
+            right_on="ca_address_sk").merge(
+        t["date_dim"], left_on="ws_sold_date_sk", right_on="d_date_sk"
+    )
+    j = j[(j.d_qoy == 2) & (j.d_year == 2000)]
+    j = j[j.ca_state.isin(["CA", "WA", "GA"]) | (j.ws_sales_price > 50)]
+    j = j.assign(zip=j.ca_zip.str[:5])
+    g = j.groupby("zip", as_index=False).agg(tot=("ws_sales_price", "sum"))
+    return _srt(g, ["zip"]).head(100)
+
+
+def q17(t):
+    j = _sales_return_catalog(t, [2000], [2000], [2000])
+    j = j[j.d_qoy == 1]  # d1 quarter restriction rides the ss-side dates
+
+    def stats(g, col, names):
+        cnt = g[col].count()
+        ave = g[col].mean()
+        sd = g[col].std()
+        return {names[0]: cnt, names[1]: ave, names[2]: sd,
+                names[3]: sd / ave}
+
+    rows = []
+    for key, g in j.groupby(["i_item_id", "i_item_desc", "s_state"]):
+        row = dict(zip(["i_item_id", "i_item_desc", "s_state"], key))
+        row.update(stats(g, "ss_quantity", [
+            "store_sales_quantitycount", "store_sales_quantityave",
+            "store_sales_quantitystdev", "store_sales_quantitycov"]))
+        row.update(stats(g, "sr_return_quantity", [
+            "store_returns_quantitycount", "store_returns_quantityave",
+            "store_returns_quantitystdev", "store_returns_quantitycov"]))
+        row.update(stats(g, "cs_quantity", [
+            "catalog_sales_quantitycount", "catalog_sales_quantityave",
+            "catalog_sales_quantitystdev", "catalog_sales_quantitycov"]))
+        rows.append(row)
+    out = pd.DataFrame(rows)
+    return _srt(out, ["i_item_id", "i_item_desc", "s_state"]).head(100)
+
+
+def _excess_discount(t, fact, prefix, manu_cap):
+    f = t[fact].merge(t["date_dim"], left_on=f"{prefix}_sold_date_sk",
+                      right_on="d_date_sk")
+    f = f[(f.d_date >= D("2000-01-01")) & (f.d_date <= D("2000-12-31"))]
+    avg_disc = f.groupby(f"{prefix}_item_sk")[
+        f"{prefix}_ext_discount_amt"
+    ].mean().rename("avg_disc").reset_index()
+    it = t["item"]
+    it = it[it.i_manufact_id <= manu_cap]
+    j = f.merge(it, left_on=f"{prefix}_item_sk", right_on="i_item_sk")
+    j = j.merge(avg_disc, on=f"{prefix}_item_sk")
+    j = j[j[f"{prefix}_ext_discount_amt"] > 1.3 * j.avg_disc]
+    return pd.DataFrame(
+        {"excess_discount_amount": [j[f"{prefix}_ext_discount_amt"].sum()]}
+    )
+
+
+def q32(t):
+    return _excess_discount(t, "catalog_sales", "cs", 100)
+
+
+def q92(t):
+    return _excess_discount(t, "web_sales", "ws", 150)
+
+
+def _bulk_tickets(t, dom_pred, potentials, ratio):
+    j = t["store_sales"].merge(
+        t["date_dim"], left_on="ss_sold_date_sk", right_on="d_date_sk"
+    )
+    j = j[j.d_year.isin([1999, 2000, 2001]) & dom_pred(j)]
+    j = j.merge(t["store"], left_on="ss_store_sk", right_on="s_store_sk")
+    j = j.merge(t["household_demographics"], left_on="ss_hdemo_sk",
+                right_on="hd_demo_sk")
+    j = j[j.hd_buy_potential.isin(potentials) & (j.hd_vehicle_count > 0)]
+    j = j[(j.hd_dep_count / j.hd_vehicle_count) > ratio]
+    g = j.groupby(["ss_ticket_number", "ss_customer_sk"],
+                  as_index=False).size().rename(columns={"size": "cnt"})
+    g = g[g.cnt.between(1, 5)]
+    return g.merge(t["customer"], left_on="ss_customer_sk",
+                   right_on="c_customer_sk")
+
+
+def q34(t):
+    g = _bulk_tickets(
+        t, lambda j: j.d_dom.between(1, 3) | j.d_dom.between(25, 28),
+        [">10000", "0-500"], 1.2,
+    )
+    out = g[["c_last_name", "c_first_name", "c_salutation",
+             "c_preferred_cust_flag", "ss_ticket_number", "cnt"]]
+    return _srt(
+        out,
+        ["c_last_name", "c_first_name", "c_salutation",
+         "c_preferred_cust_flag", "ss_ticket_number"],
+        ascending=[True, True, True, False, True],
+    ).head(100)
+
+
+def q73(t):
+    g = _bulk_tickets(
+        t, lambda j: j.d_dom.between(1, 2), [">10000", "Unknown"], 1,
+    )
+    out = g[["c_last_name", "c_first_name", "c_salutation",
+             "c_preferred_cust_flag", "ss_ticket_number", "cnt"]]
+    return _srt(
+        out, ["cnt", "c_last_name", "c_first_name", "ss_ticket_number"],
+        ascending=[False, True, True, True],
+    ).head(100)
+
+
+def _city_mismatch(t, dow_filter, hd_filter, aggs):
+    j = t["store_sales"].merge(
+        t["date_dim"], left_on="ss_sold_date_sk", right_on="d_date_sk"
+    )
+    j = j[dow_filter(j) & j.d_year.isin([1999, 2000, 2001])]
+    j = j.merge(t["store"], left_on="ss_store_sk", right_on="s_store_sk")
+    j = j.merge(t["household_demographics"], left_on="ss_hdemo_sk",
+                right_on="hd_demo_sk")
+    j = j[hd_filter(j)]
+    j = j.merge(t["customer_address"], left_on="ss_addr_sk",
+                right_on="ca_address_sk")
+    g = j.groupby(
+        ["ss_ticket_number", "ss_customer_sk", "ss_addr_sk", "ca_city"],
+        as_index=False,
+    ).agg(**aggs)
+    g = g.rename(columns={"ca_city": "bought_city"})
+    g = g.merge(t["customer"], left_on="ss_customer_sk",
+                right_on="c_customer_sk")
+    g = g.merge(
+        t["customer_address"].add_prefix("cur_"),
+        left_on="c_current_addr_sk", right_on="cur_ca_address_sk",
+    )
+    return g[g.cur_ca_city != g.bought_city]
+
+
+def q46(t):
+    g = _city_mismatch(
+        t, lambda j: j.d_dow.isin([0, 6]),
+        lambda j: (j.hd_dep_count == 5) | (j.hd_vehicle_count == 3),
+        dict(amt=("ss_coupon_amt", "sum"), profit=("ss_net_profit", "sum")),
+    )
+    out = g[["c_last_name", "c_first_name", "cur_ca_city", "bought_city",
+             "ss_ticket_number", "amt", "profit"]]
+    return _srt(out, ["c_last_name", "c_first_name", "cur_ca_city",
+                      "bought_city", "ss_ticket_number"]).head(100)
+
+
+def q68(t):
+    g = _city_mismatch(
+        t, lambda j: j.d_dom.between(1, 2),
+        lambda j: (j.hd_dep_count == 5) | (j.hd_vehicle_count == 3),
+        dict(extended_price=("ss_ext_sales_price", "sum"),
+             list_price=("ss_ext_list_price", "sum"),
+             extended_tax=("ss_ext_tax", "sum")),
+    )
+    out = g[["c_last_name", "c_first_name", "cur_ca_city", "bought_city",
+             "ss_ticket_number", "extended_price", "extended_tax",
+             "list_price"]]
+    return _srt(out, ["c_last_name", "cur_ca_city", "bought_city",
+                      "ss_ticket_number"]).head(100)
+
+
+def q48(t):
+    j = t["store_sales"].merge(
+        t["store"], left_on="ss_store_sk", right_on="s_store_sk"
+    ).merge(t["date_dim"], left_on="ss_sold_date_sk", right_on="d_date_sk")
+    j = j[j.d_year == 2001]
+    j = j.merge(t["customer_demographics"], left_on="ss_cdemo_sk",
+                right_on="cd_demo_sk")
+    j = j.merge(t["customer_address"], left_on="ss_addr_sk",
+                right_on="ca_address_sk")
+    demo = (
+        ((j.cd_marital_status == "M") & (j.cd_education_status == "4 yr Degree")
+         & j.ss_sales_price.between(50.0, 150.0))
+        | ((j.cd_marital_status == "D") & (j.cd_education_status == "2 yr Degree")
+           & j.ss_sales_price.between(10.0, 100.0))
+        | ((j.cd_marital_status == "S") & (j.cd_education_status == "College")
+           & j.ss_sales_price.between(50.0, 200.0))
+    )
+    geo = (
+        (j.ca_state.isin(["CO", "OH", "TX"]) & j.ss_net_profit.between(0, 22000))
+        | (j.ca_state.isin(["OR", "MN", "KY"]) & j.ss_net_profit.between(0, 30000))
+        | (j.ca_state.isin(["VA", "CA", "MS"]) & j.ss_net_profit.between(0, 25000))
+    )
+    j = j[demo & geo & (j.ca_country == "United States")]
+    return pd.DataFrame({"total_quantity": [j.ss_quantity.sum()]})
+
+
+def q65(t):
+    f = t["store_sales"].merge(
+        t["date_dim"], left_on="ss_sold_date_sk", right_on="d_date_sk"
+    )
+    f = f[f.d_month_seq.between(1200, 1211)]
+    sc = f.groupby(["ss_store_sk", "ss_item_sk"], as_index=False).agg(
+        revenue=("ss_sales_price", "sum")
+    )
+    sb = sc.groupby("ss_store_sk", as_index=False).agg(ave=("revenue", "mean"))
+    j = sc.merge(sb, on="ss_store_sk")
+    j = j[j.revenue <= 1.0 * j.ave]
+    j = j.merge(t["store"], left_on="ss_store_sk", right_on="s_store_sk")
+    j = j.merge(t["item"], left_on="ss_item_sk", right_on="i_item_sk")
+    out = j[["s_store_name", "i_item_desc", "revenue", "i_current_price",
+             "i_wholesale_cost", "i_brand"]]
+    return _srt(out, ["s_store_name", "i_item_desc", "revenue"]).head(100)
+
+
+def q85(t):
+    j = t["web_sales"].merge(
+        t["web_returns"],
+        left_on=["ws_item_sk", "ws_order_number"],
+        right_on=["wr_item_sk", "wr_order_number"],
+    ).merge(t["web_page"], left_on="ws_web_page_sk", right_on="wp_web_page_sk")
+    j = j.merge(t["date_dim"], left_on="ws_sold_date_sk", right_on="d_date_sk")
+    j = j[j.d_year == 2000]
+    cd1 = t["customer_demographics"].add_prefix("cd1_")
+    cd2 = t["customer_demographics"].add_prefix("cd2_")
+    j = j.merge(cd1, left_on="wr_refunded_cdemo_sk", right_on="cd1_cd_demo_sk")
+    j = j.merge(cd2, left_on="wr_returning_cdemo_sk", right_on="cd2_cd_demo_sk")
+    j = j.merge(t["customer_address"], left_on="wr_refunded_addr_sk",
+                right_on="ca_address_sk")
+    j = j.merge(t["reason"], left_on="wr_reason_sk", right_on="r_reason_sk")
+    demo = (
+        ((j.cd1_cd_marital_status == "M") & j.ws_sales_price.between(50.0, 150.0))
+        | ((j.cd1_cd_marital_status == "S") & j.ws_sales_price.between(10.0, 100.0))
+        | ((j.cd1_cd_marital_status == "W") & j.ws_sales_price.between(50.0, 200.0))
+    )
+    geo = (
+        (j.ca_state.isin(["IN", "OH", "NJ"])
+         & j.ws_net_profit.between(-10000, 10000))
+        | (j.ca_state.isin(["WI", "CT", "KY"])
+           & j.ws_net_profit.between(-10000, 20000))
+        | (j.ca_state.isin(["LA", "IA", "AR"])
+           & j.ws_net_profit.between(-10000, 30000))
+    )
+    j = j[demo & geo]
+    g = j.groupby("r_reason_desc", as_index=False).agg(
+        q=("ws_quantity", "mean"), rc=("wr_refunded_cash", "mean"),
+        f=("wr_fee", "mean"),
+    )
+    return _srt(g, ["r_reason_desc"]).head(100)
+
+
+def _traffic_count(t, hour, half):
+    j = t["store_sales"].merge(
+        t["time_dim"], left_on="ss_sold_time_sk", right_on="t_time_sk"
+    ).merge(t["household_demographics"], left_on="ss_hdemo_sk",
+            right_on="hd_demo_sk").merge(
+        t["store"], left_on="ss_store_sk", right_on="s_store_sk"
+    )
+    j = j[(j.t_hour == hour)
+          & ((j.t_minute >= 30) if half else (j.t_minute < 30))]
+    j = j[
+        ((j.hd_dep_count == 4) & (j.hd_vehicle_count <= 6))
+        | ((j.hd_dep_count == 2) & (j.hd_vehicle_count <= 4))
+        | ((j.hd_dep_count == 0) & (j.hd_vehicle_count <= 2))
+    ]
+    return len(j[j.s_store_name == "ese"])
+
+
+def q88(t):
+    return pd.DataFrame({
+        "h8_30_to_9": [_traffic_count(t, 8, True)],
+        "h9_to_9_30": [_traffic_count(t, 9, False)],
+        "h9_30_to_10": [_traffic_count(t, 9, True)],
+        "h10_to_10_30": [_traffic_count(t, 10, False)],
+    })
+
+
+def q90(t):
+    def cnt(lo, hi):
+        j = t["web_sales"].merge(
+            t["time_dim"], left_on="ws_sold_time_sk", right_on="t_time_sk"
+        ).merge(t["web_page"], left_on="ws_web_page_sk",
+                right_on="wp_web_page_sk")
+        j = j[j.t_hour.between(lo, hi) & j.wp_char_count.between(2000, 6000)]
+        return len(j)
+
+    return pd.DataFrame({"am_pm_ratio": [cnt(8, 9) / cnt(19, 20)]})
+
+
+# -- round-3 breadth (batch 3)
+
+
+def q1(t):
+    ctr = t["store_returns"].merge(
+        t["date_dim"], left_on="sr_returned_date_sk", right_on="d_date_sk"
+    )
+    ctr = ctr[ctr.d_year == 2000]
+    ctr = ctr.groupby(["sr_customer_sk", "sr_store_sk"], as_index=False).agg(
+        ctr_total_return=("sr_return_amt", "sum")
+    )
+    ave = ctr.groupby("sr_store_sk")["ctr_total_return"].mean().rename(
+        "store_avg"
+    ).reset_index()
+    j = ctr.merge(ave, on="sr_store_sk")
+    j = j[j.ctr_total_return > 1.2 * j.store_avg]
+    j = j.merge(t["store"], left_on="sr_store_sk", right_on="s_store_sk")
+    j = j.merge(t["customer"], left_on="sr_customer_sk",
+                right_on="c_customer_sk")
+    out = j[["c_customer_id"]]
+    return _srt(out, ["c_customer_id"]).head(100)
+
+
+def _multi_order_unreturned(t, fact, prefix, returns, rprefix, extra):
+    f = t[fact]
+    dd = t["date_dim"]
+    dd = dd[(dd.d_date >= D("2000-03-01")) & (dd.d_date <= D("2000-06-30"))]
+    j = f.merge(dd, left_on=f"{prefix}_ship_date_sk", right_on="d_date_sk")
+    j = j.merge(t["customer_address"], left_on=f"{prefix}_ship_addr_sk",
+                right_on="ca_address_sk")
+    j = extra(j)
+    # EXISTS: another order from the same warehouse
+    wh_orders = f.groupby(f"{prefix}_warehouse_sk")[
+        f"{prefix}_order_number"
+    ].nunique().rename("n_orders").reset_index()
+    j = j.merge(wh_orders, on=f"{prefix}_warehouse_sk")
+    j = j[j.n_orders > 1]
+    # NOT EXISTS: order never returned
+    returned = set(t[returns][f"{rprefix}_order_number"].dropna())
+    j = j[~j[f"{prefix}_order_number"].isin(returned)]
+    return pd.DataFrame(
+        {"order_count": [j[f"{prefix}_order_number"].nunique()]}
+    )
+
+
+def q16(t):
+    def extra(j):
+        return j.merge(t["call_center"], left_on="cs_call_center_sk",
+                       right_on="cc_call_center_sk")
+
+    return _multi_order_unreturned(
+        t, "catalog_sales", "cs", "catalog_returns", "cr", extra
+    )
+
+
+def q94(t):
+    def extra(j):
+        w = t["web_site"]
+        w = w[w.web_company_name == "pri"]
+        return j.merge(w, left_on="ws_web_site_sk", right_on="web_site_sk")
+
+    return _multi_order_unreturned(
+        t, "web_sales", "ws", "web_returns", "wr", extra
+    )
+
+
+def _channel_union(t, item_filter, year, group_col):
+    it = t["item"]
+    wanted = set(it[item_filter(it)][group_col])
+    parts = []
+    for fact, prefix in (("store_sales", "ss"), ("catalog_sales", "cs"),
+                         ("web_sales", "ws")):
+        f = t[fact].merge(t["date_dim"], left_on=f"{prefix}_sold_date_sk",
+                          right_on="d_date_sk")
+        f = f[f.d_year == year]
+        f = f.merge(it, left_on=f"{prefix}_item_sk", right_on="i_item_sk")
+        f = f[f[group_col].isin(wanted)]
+        g = f.groupby(group_col, as_index=False).agg(
+            total_sales=(f"{prefix}_ext_sales_price", "sum")
+        )
+        parts.append(g)
+    u = pd.concat(parts, ignore_index=True)
+    g = u.groupby(group_col, as_index=False).agg(
+        total_sales=("total_sales", "sum")
+    )
+    return _srt(g, ["total_sales", group_col]).head(100)[
+        [group_col, "total_sales"]
+    ]
+
+
+def q33(t):
+    return _channel_union(
+        t, lambda it: it.i_category.isin(["Books"]), 2000, "i_manufact_id"
+    )
+
+
+def q56(t):
+    return _channel_union(
+        t, lambda it: it.i_color.isin(["blue", "orchid", "pink"]), 2000,
+        "i_item_id",
+    )
+
+
+def q60(t):
+    return _channel_union(
+        t, lambda it: it.i_category.isin(["Music"]), 1999, "i_item_id"
+    )
+
+
+def q71(t):
+    parts = []
+    for fact, prefix in (("web_sales", "ws"), ("catalog_sales", "cs"),
+                         ("store_sales", "ss")):
+        f = t[fact].merge(t["date_dim"], left_on=f"{prefix}_sold_date_sk",
+                          right_on="d_date_sk")
+        f = f[(f.d_moy == 11) & (f.d_year == 2000)]
+        parts.append(pd.DataFrame({
+            "ext_price": f[f"{prefix}_ext_sales_price"],
+            "sold_item_sk": f[f"{prefix}_item_sk"],
+            "time_sk": f[f"{prefix}_sold_time_sk"],
+        }))
+    u = pd.concat(parts, ignore_index=True)
+    it = t["item"]
+    it = it[it.i_manager_id <= 20]
+    j = u.merge(it, left_on="sold_item_sk", right_on="i_item_sk")
+    td = t["time_dim"]
+    td = td[td.t_meal_time.isin(["breakfast", "dinner"])]
+    j = j.merge(td, left_on="time_sk", right_on="t_time_sk")
+    g = j.groupby(["i_brand_id", "i_brand", "t_hour", "t_minute"],
+                  as_index=False).agg(ext_price=("ext_price", "sum"))
+    g = g.rename(columns={"i_brand_id": "brand_id", "i_brand": "brand"})
+    out = _srt(g, ["ext_price", "brand_id", "t_hour", "t_minute"],
+               ascending=[False, True, True, True]).head(100)
+    return out[["brand_id", "brand", "t_hour", "t_minute", "ext_price"]]
+
+
+def q76(t):
+    parts = []
+    for ch, fact, prefix in ((1, "store_sales", "ss"), (2, "web_sales", "ws"),
+                             (3, "catalog_sales", "cs")):
+        f = t[fact]
+        f = f[f[f"{prefix}_promo_sk"].isna()]
+        f = f.merge(t["date_dim"], left_on=f"{prefix}_sold_date_sk",
+                    right_on="d_date_sk")
+        f = f.merge(t["item"], left_on=f"{prefix}_item_sk",
+                    right_on="i_item_sk")
+        parts.append(pd.DataFrame({
+            "channel": ch, "d_year": f.d_year, "d_qoy": f.d_qoy,
+            "i_category": f.i_category,
+            "ext_sales_price": f[f"{prefix}_ext_sales_price"],
+        }))
+    u = pd.concat(parts, ignore_index=True)
+    g = u.groupby(["channel", "d_year", "d_qoy", "i_category"],
+                  as_index=False).agg(
+        sales_cnt=("ext_sales_price", "size"),
+        sales_amt=("ext_sales_price", "sum"),
+    )
+    return _srt(g, ["channel", "d_year", "d_qoy", "i_category"]).head(100)
+
+
+def q22(t):
+    j = t["inventory"].merge(
+        t["date_dim"], left_on="inv_date_sk", right_on="d_date_sk"
+    ).merge(t["item"], left_on="inv_item_sk", right_on="i_item_sk")
+    j = j[j.d_month_seq.between(1200, 1211)]
+    # NULL-able int decodes as an object column; numeric mean needs float
+    j = j.assign(inv_quantity_on_hand=pd.to_numeric(j.inv_quantity_on_hand))
+    levels = [["i_brand", "i_class", "i_category"], ["i_brand", "i_class"],
+              ["i_brand"], []]
+    parts = []
+    for lv in levels:
+        if lv:
+            g = j.groupby(lv, as_index=False).agg(
+                qoh=("inv_quantity_on_hand", "mean")
+            )
+        else:
+            g = pd.DataFrame({"qoh": [j.inv_quantity_on_hand.mean()]})
+        for c in ["i_brand", "i_class", "i_category"]:
+            if c not in g:
+                g[c] = None
+        parts.append(g[["i_brand", "i_class", "i_category", "qoh"]])
+    u = pd.concat(parts, ignore_index=True)
+    u = u.sort_values(
+        ["qoh", "i_brand", "i_class", "i_category"],
+        na_position="last", kind="stable",
+    ).reset_index(drop=True)
+    return u.head(100)
+
+
+def _margin_hierarchy(t, fact, prefix, num_col, den_col, asc, date_filter,
+                      extra_dims):
+    """Rollup(i_category, i_class) metric + rank within parent. The
+    metric is sum(num)/sum(den) (den_col None -> just sum(num))."""
+    f = t[fact].merge(t["date_dim"], left_on=f"{prefix}_sold_date_sk",
+                      right_on="d_date_sk")
+    f = date_filter(f)
+    for table, lk, rk in extra_dims:
+        f = f.merge(t[table], left_on=lk, right_on=rk)
+    f = f.merge(t["item"], left_on=f"{prefix}_item_sk", right_on="i_item_sk")
+
+    def metric_frame(g):
+        if den_col is None:
+            g["m"] = g["num"]
+            return g.drop(columns=["num"])
+        g["m"] = g["num"] / g["den"]
+        return g.drop(columns=["num", "den"])
+
+    levels = [(["i_category", "i_class"], 0), (["i_category"], 1), ([], 2)]
+    parts = []
+    for lv, loc in levels:
+        agg = {"num": (num_col, "sum")}
+        if den_col is not None:
+            agg["den"] = (den_col, "sum")
+        if lv:
+            g = f.groupby(lv, as_index=False).agg(**agg)
+        else:
+            g = pd.DataFrame({k: [f[v[0]].sum()] for k, v in agg.items()})
+        g = metric_frame(g)
+        for c in ["i_category", "i_class"]:
+            if c not in g:
+                g[c] = None
+        g["lochierarchy"] = loc
+        parts.append(g[["m", "i_category", "i_class", "lochierarchy"]])
+    u = pd.concat(parts, ignore_index=True)
+    u["parent"] = np.where(u.lochierarchy == 0, u.i_category, None)
+    u["rank_within_parent"] = (
+        u.groupby(["lochierarchy", "parent"], dropna=False)["m"]
+        .rank(method="min", ascending=asc).astype(np.int64)
+    )
+    # ORDER BY lochierarchy desc, parent nulls first, rank, i_class
+    # nulls last — composed as stable sorts, least significant first
+    u = u.sort_values("i_class", na_position="last", kind="stable")
+    u = u.sort_values("rank_within_parent", kind="stable")
+    u = u.sort_values("parent", na_position="first", kind="stable")
+    u = u.sort_values("lochierarchy", ascending=False, kind="stable")
+    return u.drop(columns=["parent"]).reset_index(drop=True)
+
+
+def q36(t):
+    u = _margin_hierarchy(
+        t, "store_sales", "ss", "ss_net_profit", "ss_ext_sales_price", True,
+        lambda f: f[f.d_year == 2000],
+        [("store", "ss_store_sk", "s_store_sk")],
+    )
+    u = u.rename(columns={"m": "gross_margin"})
+    return u[["gross_margin", "i_category", "i_class",
+              "lochierarchy", "rank_within_parent"]].head(100)
+
+
+def q86(t):
+    u = _margin_hierarchy(
+        t, "web_sales", "ws", "ws_net_paid", None, False,
+        lambda f: f[f.d_month_seq.between(1200, 1211)], [],
+    )
+    u = u.rename(columns={"m": "total_sum"})
+    return u[["total_sum", "i_category", "i_class",
+              "lochierarchy", "rank_within_parent"]].head(100)
+
+
 ORACLES = {
     name: globals()[name]
-    for name in ["q3", "q7", "q12", "q19", "q20", "q26", "q42", "q52", "q53",
-                 "q55", "q89", "q98"]
+    for name in ["q1", "q3", "q7", "q12", "q13", "q15", "q16", "q17", "q19",
+                 "q20", "q21", "q22", "q25", "q26", "q29", "q32", "q33",
+                 "q34", "q36", "q37", "q42", "q43", "q45", "q46", "q48",
+                 "q52", "q53", "q55", "q56", "q60", "q62", "q65", "q68",
+                 "q71", "q73", "q76", "q79", "q85", "q86", "q88", "q89",
+                 "q90", "q91", "q92", "q93", "q94", "q96", "q98", "q99"]
 }

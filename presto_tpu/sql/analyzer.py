@@ -47,7 +47,8 @@ from presto_tpu.types import (
     varchar,
 )
 
-AGG_FUNCS = {"count", "sum", "avg", "min", "max"}
+AGG_FUNCS = {"count", "sum", "avg", "min", "max",
+             "stddev_samp", "stddev", "var_samp", "variance"}
 
 _CMP_OPS = {"=": "eq", "<>": "ne", "<": "lt", "<=": "le", ">": "gt", ">=": "ge"}
 _ARITH_OPS = {"+": "add", "-": "sub", "*": "mul", "/": "div", "%": "mod"}
@@ -194,10 +195,12 @@ def _resolved_refs(n, out: set[str]):
 
 
 def _substitute_outside_aggs(n, mapping):
-    """Like substitute_nodes, but leaves aggregate-call subtrees (and
-    window calls) untouched — grouping-sets NULL substitution must not
-    rewrite aggregate arguments."""
-    if isinstance(n, A.FunctionCall) and (n.name in AGG_FUNCS or n.over is not None):
+    """Like substitute_nodes, but leaves plain aggregate-call subtrees
+    untouched — grouping-sets NULL substitution must not rewrite
+    aggregate arguments. Window calls ARE entered (their partition/
+    order specs reference grouping keys), and the aggregates inside
+    them stay opaque via the same rule."""
+    if isinstance(n, A.FunctionCall) and n.name in AGG_FUNCS and n.over is None:
         return n
     if isinstance(n, A.Node) and not isinstance(n, A.Query):
         try:
@@ -579,9 +582,13 @@ class Analyzer:
         # ---- ORDER BY / LIMIT -----------------------------------------
         if q.order_by:
             keys = []
+            src_map = {
+                e.name: n for n, e in out_exprs if isinstance(e, InputRef)
+            }
             for item in q.order_by:
                 e = self._order_expr(item.expr, out_scope, scope, outer, ctes,
-                                     scalar_binds, agg_map, key_map)
+                                     scalar_binds, agg_map, key_map,
+                                     src_map=src_map)
                 keys.append(SortKey(e, item.descending, bool(item.nulls_first)))
             if q.limit is not None:
                 plan = N.TopN(plan, tuple(keys), q.limit)
@@ -659,21 +666,29 @@ class Analyzer:
         group_keys = ()
         if isinstance(plan, N.Output) and isinstance(plan.child, N.Aggregate):
             group_keys = tuple(n for n, _ in plan.child.keys)
-        fields = [
-            FieldRef(f.name, f.dtype, binding, f.name, None) for f in plan.fields
-        ]
-        # strip Output: keep the projected child with internal names
+        # strip Output: keep the projected child, re-projected to FRESH
+        # internal names — two derived tables exposing the same client
+        # column name (q65's sb/sc both expose ss_store_sk) must not
+        # collide in the join's field namespace
         inner = plan.child if isinstance(plan, N.Output) else plan
-        # re-project to client names so the fields match
         if isinstance(plan, N.Output):
             exprs = []
+            fields = []
+            iname_of = {}
             smap = {f.name: f for f in inner.fields}
             for n, s in zip(plan.names, plan.sources):
-                exprs.append((n, InputRef(smap[s].dtype, s)))
+                iname = self.fresh(f"{binding}.{n}")
+                exprs.append((iname, InputRef(smap[s].dtype, s)))
+                fields.append(FieldRef(iname, smap[s].dtype, binding, n, None))
+                iname_of.setdefault(s, iname)
             inner = N.Project(inner, tuple(exprs))
             if group_keys:
-                name_of = dict(zip(plan.sources, plan.names))
-                group_keys = tuple(name_of.get(k, k) for k in group_keys)
+                group_keys = tuple(iname_of.get(k, k) for k in group_keys)
+        else:
+            fields = [
+                FieldRef(f.name, f.dtype, binding, f.name, None)
+                for f in plan.fields
+            ]
         rels.append(Rel(binding, inner, Scope(fields), None,
                         group_keys=group_keys, est_rows=1e5))
 
@@ -845,7 +860,22 @@ class Analyzer:
                 if best is None or key < best[0]:
                     best = (key, e, inner_rel)
             if best is None:
-                raise AnalysisError("cross join without join condition")
+                # cartesian product: no edge reaches the joined set
+                # (TPC-DS q88/q90 cross-join single-row derived counts).
+                # Join on a constant key — every probe row matches every
+                # build row; smallest relation first bounds the blowup.
+                bidx = min(remaining, key=lambda i: rels[i].est_rows)
+                build_rel = rels[bidx]
+                one = Literal(BIGINT, 1)
+                plan = N.Join(
+                    plan, plans[bidx], "inner", (one,), (one,),
+                    False,
+                    tuple(f.name for f in build_rel.scope.fields),
+                )
+                joined.add(bidx)
+                remaining.discard(bidx)
+                cur_fields += build_rel.scope.fields
+                continue
             _, e, bidx = best
             a, b = e["pair"]
             # merge every edge between `joined` and bidx into one
@@ -1343,13 +1373,9 @@ class Analyzer:
                 passengers.extend(ks)
                 continue
             grouping.extend(ks)
-        # wide BYTES cannot be grouping keys (no surrogate packing)
-        for n, e in grouping:
-            if e.dtype.kind is TypeKind.BYTES and e.dtype.width > 7:
-                raise AnalysisError(
-                    f"cannot group by wide BYTES column {n} "
-                    "(no covering unique key found)"
-                )
+        # wide BYTES group keys are supported directly (chunked int64
+        # surrogates); the unique-key/FD demotions above remain as
+        # optimizations, not requirements
         return grouping, passengers
 
     def _binding_of(self, scope, name):
@@ -1403,6 +1429,32 @@ class Analyzer:
             return [AggSpec("sum", arg, nm, t)], InputRef(t, nm)
         if a.name in ("min", "max"):
             return [AggSpec(a.name, arg, nm, arg.dtype)], InputRef(arg.dtype, nm)
+        if a.name in ("stddev_samp", "stddev", "var_samp", "variance"):
+            # decompose to (sum x, sum x^2, count): var = (q - s^2/c)/(c-1)
+            # c<=1 yields NULL for free (div-by-zero invalidates)
+            d = Call(DOUBLE, "cast_double", (arg,))
+            s = self.fresh("vsum")
+            qn = self.fresh("vsq")
+            c = self.fresh("vcnt")
+            specs = [
+                AggSpec("sum", d, s, DOUBLE),
+                AggSpec("sum", Call(DOUBLE, "mul", (d, d)), qn, DOUBLE),
+                AggSpec("count", arg, c, BIGINT),
+            ]
+            sr, qr, cr = InputRef(DOUBLE, s), InputRef(DOUBLE, qn), InputRef(BIGINT, c)
+            mean_sq = Call(DOUBLE, "div", (Call(DOUBLE, "mul", (sr, sr)), cr))
+            var = Call(DOUBLE, "div", (
+                Call(DOUBLE, "sub", (qr, mean_sq)),
+                Call(BIGINT, "sub", (cr, Literal(BIGINT, 1))),
+            ))
+            if a.name in ("stddev_samp", "stddev"):
+                # clamp fp cancellation noise below zero
+                clamped = Call(DOUBLE, "if", (
+                    Call(BOOLEAN, "lt", (var, Literal(DOUBLE, 0.0))),
+                    Literal(DOUBLE, 0.0), var,
+                ))
+                return specs, Call(DOUBLE, "sqrt", (clamped,))
+            return specs, var
         raise AnalysisError(f"unknown aggregate {a.name}")
 
     def _sum_type(self, t: DataType) -> DataType:
@@ -1497,11 +1549,18 @@ class Analyzer:
     # order-by resolution
     # ------------------------------------------------------------------
     def _order_expr(self, e, out_scope, pre_scope, outer, ctes, scalar_binds,
-                    agg_map, key_map):
+                    agg_map, key_map, src_map=None):
         if isinstance(e, A.Identifier) and len(e.parts) == 1:
             f = out_scope.try_resolve(e.parts)
             if f is not None:
                 return InputRef(f.dtype, f.name)
+        if isinstance(e, A.Identifier) and len(e.parts) > 1 and src_map:
+            # qualified ref (ORDER BY t.col): resolve in the FROM scope,
+            # then map back to the output column that projects it — the
+            # Sort sits above the projection
+            f = pre_scope.try_resolve(e.parts)
+            if f is not None and f.name in src_map:
+                return InputRef(f.dtype, src_map[f.name])
         if isinstance(e, A.NumberLit):
             idx = int(e.text) - 1
             f = out_scope.fields[idx]
@@ -1623,6 +1682,25 @@ class Analyzer:
             if n.name == "abs":
                 v = self._expr(n.args[0], scope, outer, ctes, scalar_binds, agg_map, key_map)
                 return Call(v.dtype, "abs", (v,))
+            if n.name in ("sqrt", "floor", "ceil", "ceiling"):
+                v = self._expr(n.args[0], scope, outer, ctes, scalar_binds, agg_map, key_map)
+                fn = "ceil" if n.name == "ceiling" else n.name
+                return Call(DOUBLE, fn, (v,))
+            if n.name == "round":
+                v = self._expr(n.args[0], scope, outer, ctes, scalar_binds, agg_map, key_map)
+                if len(n.args) == 2:
+                    if not isinstance(n.args[1], A.NumberLit):
+                        raise AnalysisError("round() scale must be a literal")
+                    nd = int(n.args[1].text)
+                    scale = Literal(DOUBLE, float(10 ** nd))
+                    scaled = Call(DOUBLE, "mul", (Call(DOUBLE, "cast_double", (v,)), scale))
+                    return Call(DOUBLE, "div", (Call(DOUBLE, "round", (scaled,)), scale))
+                return Call(DOUBLE, "round", (v,))
+            if n.name == "nullif":
+                a = self._expr(n.args[0], scope, outer, ctes, scalar_binds, agg_map, key_map)
+                b = self._expr(n.args[1], scope, outer, ctes, scalar_binds, agg_map, key_map)
+                eq = Call(BOOLEAN, "eq", (a, b))
+                return Call(a.dtype, "if", (eq, Literal(a.dtype, None), a))
             if n.name == "coalesce":
                 args = tuple(
                     self._expr(a, scope, outer, ctes, scalar_binds, agg_map, key_map)
@@ -1647,20 +1725,48 @@ class Analyzer:
         raise AnalysisError(f"unsupported expression {type(n).__name__}")
 
     def _case(self, n: A.CaseExpr, scope, outer, ctes, scalar_binds, agg_map, key_map):
+        def is_bare_null(x):
+            return isinstance(x, A.Identifier) and x.parts == ("null",)
+
+        # analyze each typed branch exactly ONCE (a branch may carry
+        # side effects — a scalar subquery appends a bind); bare NULL
+        # branches (THEN NULL / ELSE NULL) then take the common type
+        values = [v for _, v in n.whens]
+        if n.else_ is not None:
+            values.append(n.else_)
+        analyzed: list[Expr | None] = [
+            None if is_bare_null(v)
+            else self._expr(v, scope, outer, ctes, scalar_binds, agg_map,
+                            key_map)
+            for v in values
+        ]
+        if any(e is None for e in analyzed):
+            typed = [e for e in analyzed if e is not None]
+            if not typed:
+                raise AnalysisError("CASE with only NULL branches")
+            from presto_tpu.types import common_super_type
+
+            null_t = typed[0].dtype
+            for e in typed[1:]:
+                null_t = common_super_type(null_t, e.dtype)
+            analyzed = [
+                Literal(null_t, None) if e is None else e for e in analyzed
+            ]
+
         whens = []
-        for c, v in n.whens:
+        for (c, _), v in zip(n.whens, analyzed):
             if n.operand is not None:
                 c = A.BinaryOp("=", n.operand, c)
             whens.append((
                 self._expr(c, scope, outer, ctes, scalar_binds, agg_map, key_map),
-                self._expr(v, scope, outer, ctes, scalar_binds, agg_map, key_map),
+                v,
             ))
         args: list[Expr] = []
         for c, v in whens:
             args.extend([c, v])
         branch_types = [v.dtype for _, v in whens]
         if n.else_ is not None:
-            e = self._expr(n.else_, scope, outer, ctes, scalar_binds, agg_map, key_map)
+            e = analyzed[-1]
             args.append(e)
             branch_types.append(e.dtype)
         from presto_tpu.types import common_super_type

@@ -332,13 +332,22 @@ class Parser:
 
     def parse_primary_relation(self) -> A.Node:
         if self.op("(") and self._query_follows(self.i + 1):
-            # derived table, possibly a parenthesized UNION chain:
-            # FROM ((select ...) union all (select ...)) t
-            self.eat()
-            q = self.parse_query()
-            self.expect_op(")")
-            alias = self._maybe_alias()
-            return A.SubqueryRelation(q, alias)
+            # Ambiguous open: a derived table — possibly a parenthesized
+            # UNION chain, FROM ((select ...) union all (select ...)) t —
+            # or a parenthesized JOIN whose first relation is a subquery,
+            # FROM ((select ...) x join y on ...). Try the derived-table
+            # parse; backtrack to the join parse on failure (the parser
+            # state is just the token index).
+            save = self.i
+            try:
+                self.eat()
+                q = self.parse_query()
+                self.expect_op(")")
+            except ParseError:
+                self.i = save
+            else:
+                alias = self._maybe_alias()
+                return A.SubqueryRelation(q, alias)
         if self.accept_op("("):
             rel = self.parse_relation_list()
             self.expect_op(")")
