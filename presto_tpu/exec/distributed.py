@@ -72,7 +72,7 @@ from presto_tpu.ops.hashing import partition_ids
 from presto_tpu.ops.sort import sort_indices
 from presto_tpu.ops.join import build_lookup, probe_exists, probe_expand, probe_unique
 from presto_tpu.parallel.exchange import any_flag, exchange_multiround
-from presto_tpu.parallel.mesh import WORKERS, replicated, row_sharding
+from presto_tpu.parallel.mesh import replicated, row_sharding, worker_axes
 from presto_tpu.plan import nodes as N
 from presto_tpu.plan.catalog import Catalog
 from presto_tpu.spi import batch_capacity
@@ -86,7 +86,7 @@ class DistBatch:
     """One global Batch + its distribution over the workers axis."""
 
     batch: Batch
-    sharded: bool  # rows sharded over WORKERS vs fully replicated
+    sharded: bool  # rows sharded over the worker axes vs fully replicated
 
 
 def _sortable(v):
@@ -106,11 +106,12 @@ import functools
 def _compact_step(mesh, out_cap: int):
     """Compiled per-device compaction, cached per (mesh, capacity) so
     repeated guarded replications reuse the XLA program."""
+    ax = worker_axes(mesh)
     step = partial(
         shard_map,
         mesh=mesh,
-        in_specs=(P(WORKERS),),
-        out_specs=P(WORKERS),
+        in_specs=(P(ax),),
+        out_specs=P(ax),
         check_vma=False,
     )(lambda local: _compact_local(local, out_cap))
     return jax.jit(step)
@@ -173,6 +174,10 @@ class DistributedExecutor:
         self.catalog = catalog
         self.mesh = mesh
         self.nworkers = int(mesh.devices.size)
+        #: mesh axis names carrying the worker role: ("workers",) on a
+        #: 1-D mesh, ("dcn", "ici") on a multi-host mesh — every
+        #: collective/spec below uses the tuple
+        self.axes = worker_axes(mesh)
         self.broadcast_limit = broadcast_limit
         self.direct_group_limit = (
             DIRECT_LIMIT if direct_group_limit is None else direct_group_limit
@@ -272,12 +277,20 @@ class DistributedExecutor:
         types = {c: conn.schema(node.table)[c] for c in src_cols}
         dicts = {c: d for c, d in conn.dictionaries(node.table).items() if c in types}
         devices = list(self.mesh.devices.flat)
+        # multi-process: each host generates and places ONLY its own
+        # addressable devices' shards (device_put to a remote device is
+        # illegal, and make_array_from_single_device_arrays expects each
+        # process to contribute just its local pieces). Single-process
+        # meshes address every device, so this is the old loop there.
+        proc = jax.process_index()
         from presto_tpu.spi import split_valids
 
         data_shards: dict[str, list] = {c: [] for c in src_cols}
         valid_shards: dict[str, list] = {c: [] for c in src_cols}
         live_shards: list = []
         for d, sp in enumerate(assign):
+            if devices[d].process_index != proc:
+                continue
             if sp:
                 parts = [conn.scan_numpy(s, src_cols) for s in sp]
                 cat = {c: np.concatenate([p[c] for p in parts]) for c in parts[0]}
@@ -499,16 +512,17 @@ class DistributedExecutor:
 
         @partial(
             shard_map, mesh=mesh,
-            in_specs=(P(WORKERS),), out_specs=(P(WORKERS), P()),
+            in_specs=(P(self.axes),), out_specs=(P(self.axes), P()),
             check_vma=False,
         )
         def step(b: Batch):
             part, ovf1 = partial_phase(b)
             key_sort = [c for n, _ in keys for c in _sortables(part[n])]
             pids = partition_ids(key_sort, Pn)
-            exch, ovf2 = exchange_multiround(part, pids, Pn, quota, mgf)
+            exch, ovf2 = exchange_multiround(part, pids, Pn, quota, mgf,
+                                             axes=self.axes)
             out, ovf3 = final_phase(exch)
-            return out, any_flag(ovf1 | ovf2 | ovf3)
+            return out, any_flag(ovf1 | ovf2 | ovf3, self.axes)
 
         return jax.jit(step)
 
@@ -629,7 +643,7 @@ class DistributedExecutor:
 
         @partial(
             shard_map, mesh=self.mesh,
-            in_specs=(P(WORKERS), P(WORKERS)), out_specs=(P(WORKERS), P()),
+            in_specs=(P(self.axes), P(self.axes)), out_specs=(P(self.axes), P()),
             check_vma=False,
         )
         def step(lb: Batch, rb: Batch):
@@ -637,8 +651,10 @@ class DistributedExecutor:
             rv = evaluate(rkey, rb)
             lpids = partition_ids([lv.data.astype(jnp.int64)], Pn)
             rpids = partition_ids([rv.data.astype(jnp.int64)], Pn)
-            le, ovf1 = exchange_multiround(lb, lpids, Pn, lquota, lrecv)
-            re, ovf2 = exchange_multiround(rb, rpids, Pn, rquota, rrecv)
+            le, ovf1 = exchange_multiround(lb, lpids, Pn, lquota, lrecv,
+                                           axes=self.axes)
+            re, ovf2 = exchange_multiround(rb, rpids, Pn, rquota, rrecv,
+                                           axes=self.axes)
             bv = evaluate(rkey, re)
             build_cap = re.capacity
             side = build_lookup(bv.data, re.live & bv.valid, build_cap)
@@ -648,7 +664,7 @@ class DistributedExecutor:
             if kind in ("semi", "anti"):
                 exists = probe_exists(side, pv.data, pvalid)
                 keep = exists if kind == "semi" else le.live & ~exists
-                return le.with_live(le.live & keep), any_flag(ovf)
+                return le.with_live(le.live & keep), any_flag(ovf, self.axes)
             if unique:
                 res = probe_unique(side, pv.data, pvalid)
                 cols = dict(le.columns)
@@ -660,7 +676,7 @@ class DistributedExecutor:
                         src.dtype, src.dictionary,
                     )
                 live = le.live & res.matched if kind == "inner" else le.live
-                return Batch(cols, live), any_flag(ovf)
+                return Batch(cols, live), any_flag(ovf, self.axes)
             res = probe_expand(side, pv.data, pvalid, out_cap, left=(kind == "left"))
             cols = {}
             for name in le.names:
@@ -677,7 +693,7 @@ class DistributedExecutor:
                     gather_padded(src.valid, res.build_row, False),
                     src.dtype, src.dictionary,
                 )
-            return Batch(cols, res.live), any_flag(ovf | res.overflow)
+            return Batch(cols, res.live), any_flag(ovf | res.overflow, self.axes)
 
         return jax.jit(step)
 
@@ -729,7 +745,7 @@ class DistributedExecutor:
 
         @partial(
             shard_map, mesh=self.mesh,
-            in_specs=tuple(P(WORKERS) for _ in parts), out_specs=P(WORKERS),
+            in_specs=tuple(P(self.axes) for _ in parts), out_specs=P(self.axes),
             check_vma=False,
         )
         def step(*bs):
@@ -807,14 +823,15 @@ class DistributedExecutor:
 
         @partial(
             shard_map, mesh=self.mesh,
-            in_specs=(P(WORKERS),), out_specs=(P(WORKERS), P()),
+            in_specs=(P(self.axes),), out_specs=(P(self.axes), P()),
             check_vma=False,
         )
         def step(local: Batch):
             pids = partition_ids(hash_cols(local), Pn)
-            exch, ovf = exchange_multiround(local, pids, Pn, quota, recv_cap)
+            exch, ovf = exchange_multiround(local, pids, Pn, quota, recv_cap,
+                                            axes=self.axes)
             out = window_body(exch)
-            return out, any_flag(ovf)
+            return out, any_flag(ovf, self.axes)
 
         return jax.jit(step)
 
@@ -881,7 +898,7 @@ class DistributedExecutor:
 
         @partial(
             shard_map, mesh=self.mesh,
-            in_specs=(P(WORKERS),), out_specs=P(WORKERS),
+            in_specs=(P(self.axes),), out_specs=P(self.axes),
             check_vma=False,
         )
         def step(local: Batch):
@@ -917,7 +934,7 @@ class DistributedExecutor:
 
         @partial(
             shard_map, mesh=self.mesh,
-            in_specs=(P(WORKERS),), out_specs=P(WORKERS),
+            in_specs=(P(self.axes),), out_specs=P(self.axes),
             check_vma=False,
         )
         def step(local: Batch):
@@ -967,9 +984,11 @@ class DistributedExecutor:
         nsamples = min(64, cap_dev)
         k0 = keys[0]
 
+        from presto_tpu.parallel.exchange import _ag
+
         @partial(
             shard_map, mesh=self.mesh,
-            in_specs=(P(WORKERS),), out_specs=(P(WORKERS), P(WORKERS)),
+            in_specs=(P(self.axes),), out_specs=(P(), P()),
             check_vma=False,
         )
         def sample_step(local: Batch):
@@ -979,7 +998,9 @@ class DistributedExecutor:
             pos = (jnp.arange(nsamples) * jnp.maximum(cnt, 1)) // nsamples
             samp = gather_padded(cmp[order], pos, 0)
             ok = jnp.arange(nsamples) < cnt
-            return samp[None, :], ok[None, :]
+            # gather to every device so the host reads a fully
+            # addressable (replicated) array in multi-process runs
+            return _ag(samp, self.axes), _ag(ok, self.axes)
 
         samp, ok = jax.jit(sample_step)(b)
         samp = np.asarray(samp).reshape(-1)
@@ -1007,13 +1028,14 @@ class DistributedExecutor:
 
         @partial(
             shard_map, mesh=self.mesh,
-            in_specs=(P(WORKERS),), out_specs=(P(WORKERS), P()),
+            in_specs=(P(self.axes),), out_specs=(P(self.axes), P()),
             check_vma=False,
         )
         def step(local: Batch):
             cmp = self._sort_cmp(k0, local)
             pids = jnp.searchsorted(splitters, cmp, side="right").astype(jnp.int32)
-            exch, ovf = exchange_multiround(local, pids, Pn, quota, recv_cap)
+            exch, ovf = exchange_multiround(local, pids, Pn, quota, recv_cap,
+                                            axes=self.axes)
             vals = [evaluate(k.expr, exch) for k in keys]
             order = sort_indices(
                 [v.data for v in vals],
@@ -1031,7 +1053,7 @@ class DistributedExecutor:
                 for nm, c in exch.columns.items()
             }
             out = Batch(cols, gather_padded(exch.live, order, False))
-            return out, any_flag(ovf)
+            return out, any_flag(ovf, self.axes)
 
         return jax.jit(step)
 

@@ -36,24 +36,27 @@ import numpy as np
 
 from presto_tpu.batch import Batch, Column
 from presto_tpu.ops.partition import partition_layout, scatter_to_buffer
-from presto_tpu.parallel.mesh import WORKERS
+from presto_tpu.parallel.mesh import WORKERS, worker_axes
 
 
-def _a2a(x):
-    """all_to_all along the workers axis; bools ride as uint8."""
+def _a2a(x, axes=WORKERS):
+    """all_to_all along the worker axes (a 2-D dcn/ici mesh passes the
+    axis tuple — XLA splits the collective over DCN + ICI legs); bools
+    ride as uint8."""
     if x.dtype == jnp.bool_:
-        return _a2a(x.astype(jnp.uint8)).astype(jnp.bool_)
-    return jax.lax.all_to_all(x, WORKERS, split_axis=0, concat_axis=0)
+        return _a2a(x.astype(jnp.uint8), axes).astype(jnp.bool_)
+    return jax.lax.all_to_all(x, axes, split_axis=0, concat_axis=0)
 
 
-def _ag(x):
-    """Tiled all_gather along the workers axis (concat on rows)."""
+def _ag(x, axes=WORKERS):
+    """Tiled all_gather along the worker axes (concat on rows)."""
     if x.dtype == jnp.bool_:
-        return _ag(x.astype(jnp.uint8)).astype(jnp.bool_)
-    return jax.lax.all_gather(x, WORKERS, axis=0, tiled=True)
+        return _ag(x.astype(jnp.uint8), axes).astype(jnp.bool_)
+    return jax.lax.all_gather(x, axes, axis=0, tiled=True)
 
 
-def exchange_local(batch: Batch, pids, num_partitions: int, quota: int):
+def exchange_local(batch: Batch, pids, num_partitions: int, quota: int,
+                   axes=WORKERS):
     """Per-device hash-partitioned shuffle body.
 
     ``pids[cap]``: destination partition of each row (int32, computed by
@@ -71,7 +74,7 @@ def exchange_local(batch: Batch, pids, num_partitions: int, quota: int):
 
     def send_recv(values, fill=0):
         buf = scatter_to_buffer(values, slot, num_partitions, quota, fill)
-        out = _a2a(buf)
+        out = _a2a(buf, axes)
         return out.reshape((num_partitions * quota,) + values.shape[1:])
 
     cols = {}
@@ -93,6 +96,7 @@ def exchange_multiround(
     quota: int,
     recv_cap: int,
     max_rounds: int | None = None,
+    axes=WORKERS,
 ):
     """Skew-aware per-device shuffle body: multi-round, fixed wire quota.
 
@@ -133,7 +137,7 @@ def exchange_multiround(
     def any_pending(remaining):
         # psum lives in the body (a collective in the while cond is
         # not portable); the cond reads the carried flag
-        return jax.lax.psum(jnp.any(remaining).astype(jnp.int32), WORKERS) > 0
+        return jax.lax.psum(jnp.any(remaining).astype(jnp.int32), axes) > 0
 
     init = (
         batch.live,  # remaining: rows not yet delivered
@@ -155,7 +159,7 @@ def exchange_multiround(
 
         def send_recv(values, fill=0):
             buf = scatter_to_buffer(values, slot, P, quota, fill)
-            return _a2a(buf).reshape((P * quota,) + values.shape[1:])
+            return _a2a(buf, axes).reshape((P * quota,) + values.shape[1:])
 
         got = send_recv(sent, False)
         pos = off + jnp.cumsum(got.astype(jnp.int64)) - 1
@@ -196,19 +200,19 @@ def exchange_multiround(
     return Batch(cols, live), ovf | undrained
 
 
-def broadcast_local(batch: Batch) -> Batch:
+def broadcast_local(batch: Batch, axes=WORKERS) -> Batch:
     """Per-device broadcast body: every device ends up with all rows
     (reference: BroadcastOutputBuffer / REPLICATED join distribution)."""
     cols = {
-        n: Column(_ag(c.data), _ag(c.valid), c.dtype, c.dictionary)
+        n: Column(_ag(c.data, axes), _ag(c.valid, axes), c.dtype, c.dictionary)
         for n, c in batch.columns.items()
     }
-    return Batch(cols, _ag(batch.live))
+    return Batch(cols, _ag(batch.live, axes))
 
 
-def any_flag(flag):
+def any_flag(flag, axes=WORKERS):
     """Combine per-device overflow flags (inside shard_map)."""
-    return jax.lax.psum(flag.astype(jnp.int32), WORKERS) > 0
+    return jax.lax.psum(flag.astype(jnp.int32), axes) > 0
 
 
 # ---------------------------------------------------------------------------
@@ -225,16 +229,18 @@ def make_shuffle_step(mesh, num_partitions: int, quota: int):
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
+    axes = worker_axes(mesh)
+
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(P(WORKERS), P(WORKERS)),
-        out_specs=(P(WORKERS), P()),
+        in_specs=(P(axes), P(axes)),
+        out_specs=(P(axes), P()),
         check_vma=False,
     )
     def step(batch: Batch, pids):
-        out, ovf = exchange_local(batch, pids, num_partitions, quota)
-        return out, any_flag(ovf)
+        out, ovf = exchange_local(batch, pids, num_partitions, quota, axes)
+        return out, any_flag(ovf, axes)
 
     return jax.jit(step)
 
@@ -249,18 +255,20 @@ def make_multiround_shuffle_step(
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
+    axes = worker_axes(mesh)
+
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(P(WORKERS), P(WORKERS)),
-        out_specs=(P(WORKERS), P()),
+        in_specs=(P(axes), P(axes)),
+        out_specs=(P(axes), P()),
         check_vma=False,
     )
     def step(batch: Batch, pids):
         out, ovf = exchange_multiround(
-            batch, pids, num_partitions, quota, recv_cap
+            batch, pids, num_partitions, quota, recv_cap, axes=axes
         )
-        return out, any_flag(ovf)
+        return out, any_flag(ovf, axes)
 
     return jax.jit(step)
 
@@ -270,14 +278,16 @@ def make_broadcast_step(mesh):
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
+    axes = worker_axes(mesh)
+
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(P(WORKERS),),
+        in_specs=(P(axes),),
         out_specs=P(),
         check_vma=False,
     )
     def step(batch: Batch):
-        return broadcast_local(batch)
+        return broadcast_local(batch, axes)
 
     return jax.jit(step)

@@ -1,0 +1,64 @@
+"""Multi-host (DCN) bootstrap — the cluster-membership tier.
+
+Reference parity: Airlift discovery + ``DiscoveryNodeManager`` — how
+the reference's workers find each other and form a cluster
+[SURVEY §2.5 discovery row]. TPU-first (SURVEY §2.5 DCN row): cluster
+formation is ``jax.distributed`` — every host runs the SAME
+single-controller program, the coordination service rendezvouses them,
+and after initialization ``jax.devices()`` returns the GLOBAL device
+list. There is no worker announce/poll loop to build: gang-scheduled
+SPMD replaces the discovery protocol, and a host that dies kills the
+step (the failure posture in README — query-level retry).
+
+Usage, on every host of the cluster (identical program)::
+
+    from presto_tpu.parallel import multihost
+    multihost.initialize("10.0.0.1:8476", num_processes=4,
+                         process_id=<this host's rank>)
+    mesh = multihost.global_dcn_mesh()        # ("dcn", "ici") 2-D mesh
+    session = Session({"tpch": conn}, mesh=mesh)
+    df = session.sql("select ...")            # same program everywhere
+
+Every fragment step shards and exchanges over the mesh's combined
+axes (see ``parallel.mesh`` / ``parallel.exchange``), so the same
+compiled programs run single-host or multi-host; XLA routes the
+inter-host legs of each collective over DCN and the intra-host legs
+over ICI. On TPU pods, ``initialize()`` with no arguments picks the
+cluster configuration up from the TPU environment.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from presto_tpu.parallel.mesh import make_dcn_mesh, make_mesh
+
+
+def initialize(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+    local_device_ids=None,
+):
+    """Join (or form) the cluster. Arguments mirror
+    ``jax.distributed.initialize``; on TPU pods all of them are
+    auto-detected from the environment and may be omitted."""
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids,
+    )
+
+
+def num_hosts() -> int:
+    return jax.process_count()
+
+
+def global_dcn_mesh(per_host: int | None = None):
+    """The cluster-wide 2-D ("dcn", "ici") mesh: one dcn row per host.
+    Falls back to a flat single-axis mesh when there is one process."""
+    hosts = jax.process_count()
+    if hosts <= 1:
+        return make_mesh()
+    return make_dcn_mesh(hosts, per_host)

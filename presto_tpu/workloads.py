@@ -149,20 +149,23 @@ def combine_q1_states(a: dict, b: dict) -> dict:
 def q1_distributed_step(mesh):
     """Returns a jitted SPMD step: sharded Batch -> replicated Q1 state.
 
-    Rows are sharded over the ``workers`` axis (each device holds its
-    scan partition); partial aggregation runs per device; the final
-    combine is a ``psum`` over ICI — the degenerate (6-group) case of
-    the partitioned-exchange final aggregation.
+    Rows are sharded over the worker axes (each device holds its scan
+    partition; a dcn/ici mesh shards over both axes); partial
+    aggregation runs per device; the final combine is a ``psum`` over
+    the axes — the degenerate (6-group) case of the
+    partitioned-exchange final aggregation.
     """
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
-    from presto_tpu.parallel.mesh import WORKERS
+    from presto_tpu.parallel.mesh import worker_axes
+
+    axes = worker_axes(mesh)
 
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(P(WORKERS),),
+        in_specs=(P(axes),),
         out_specs=P(),
         check_vma=False,
     )
@@ -171,8 +174,8 @@ def q1_distributed_step(mesh):
 
         def allreduce(x):
             if x.dtype == jnp.bool_:
-                return jax.lax.psum(x.astype(jnp.int32), WORKERS) > 0
-            return jax.lax.psum(x, WORKERS)
+                return jax.lax.psum(x.astype(jnp.int32), axes) > 0
+            return jax.lax.psum(x, axes)
 
         return jax.tree.map(allreduce, state)
 

@@ -175,6 +175,72 @@ def test_broadcast_replicates_all_rows(mesh, rng):
 
 
 # ---------------------------------------------------------------------------
+# multi-host DCN mesh (2-D dcn/ici axes; SURVEY §2.5 DCN row)
+# ---------------------------------------------------------------------------
+
+
+def test_dcn_mesh_queries_match_flat_mesh():
+    """Metamorphic: results are independent of mesh shape — the same
+    queries over a 2-D ("dcn", "ici") mesh (the multi-host layout,
+    here 2 virtual hosts x 4 devices) must equal the flat 8-worker
+    mesh. Exercises the combined-axes all_to_all/all_gather/psum paths
+    end to end: sharded scan, partial->shuffle->final aggregation,
+    repartition + broadcast joins, range-partition sort."""
+    from presto_tpu.parallel.mesh import make_dcn_mesh
+
+    conn = TpchConnector(sf=0.005, units_per_split=1 << 14)
+    flat = Session({"tpch": conn}, mesh=make_mesh(8))
+    dcn = Session({"tpch": conn}, mesh=make_dcn_mesh(2, 4),
+                  properties={"broadcast_join_row_limit": 0})
+    queries = [
+        # grouped agg through the multiround exchange
+        "select l_suppkey, sum(l_quantity) q, count(*) c from lineitem "
+        "group by l_suppkey order by l_suppkey",
+        # repartition join (broadcast disabled on the dcn session)
+        "select o_orderpriority, count(*) c from orders, lineitem "
+        "where l_orderkey = o_orderkey and l_shipdate > date '1995-01-01' "
+        "group by o_orderpriority order by o_orderpriority",
+        # range-partition sort + topN
+        "select l_orderkey, l_extendedprice from lineitem "
+        "order by l_extendedprice desc, l_orderkey limit 20",
+    ]
+    for q in queries:
+        a = flat.sql(q)
+        b = dcn.sql(q)
+        pd.testing.assert_frame_equal(
+            a.reset_index(drop=True), b.reset_index(drop=True),
+            check_dtype=False,
+        )
+    # broadcast-join path (default broadcast limit: the small build
+    # side all_gathers over the combined axes, incl. _compact_step)
+    dcn_bc = Session({"tpch": conn}, mesh=make_dcn_mesh(2, 4))
+    q = ("select n_name, count(*) c from nation, customer "
+         "where c_nationkey = n_nationkey group by n_name order by n_name")
+    pd.testing.assert_frame_equal(
+        flat.sql(q).reset_index(drop=True),
+        dcn_bc.sql(q).reset_index(drop=True),
+        check_dtype=False,
+    )
+
+
+def test_dcn_mesh_window_partition_parallel():
+    from presto_tpu.parallel.mesh import make_dcn_mesh
+
+    conn = TpchConnector(sf=0.005, units_per_split=1 << 14)
+    dcn = Session({"tpch": conn}, mesh=make_dcn_mesh(2, 4),
+                  properties={"gather_row_limit": 1024})
+    df = dcn.sql(
+        "select l_orderkey, sum(l_quantity) over (partition by l_orderkey) q "
+        "from lineitem"
+    )
+    li = conn.table_pandas("lineitem")
+    want = li.groupby("l_orderkey")["l_quantity"].transform("sum")
+    got = df.sort_values(["l_orderkey", "q"]).reset_index(drop=True)
+    assert len(got) == len(li)
+    np.testing.assert_allclose(sorted(got["q"]), sorted(want), rtol=1e-9)
+
+
+# ---------------------------------------------------------------------------
 # distributed sort / topN / limit (no full replication)
 # ---------------------------------------------------------------------------
 
