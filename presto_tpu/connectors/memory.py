@@ -1,0 +1,221 @@
+"""The memory connector: writable in-process tables.
+
+Reference parity: ``presto-memory`` (``MemoryPagesStore`` — in-memory
+tables used by tests and as the CTAS target) and the write half of the
+SPI (``ConnectorPageSink``: the engine appends batches, the connector
+owns visibility) [SURVEY §2.1 SPI row, §2.2; reference tree
+unavailable, paths reconstructed].
+
+Storage is host-columnar (numpy arrays + ``$valid`` NULL masks), the
+same shape every scan source produces — a created table round-trips
+through the ordinary scan path with no special cases. Writes are
+all-or-nothing per statement: ``MemorySink`` buffers pages and
+publishes the table only on ``commit()`` (the reference's
+transactional ``finish``/``finishInsert`` posture [SURVEY §5.4]).
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Sequence
+
+import numpy as np
+
+from presto_tpu.batch import Batch, Dictionary
+from presto_tpu.spi import Split, batch_capacity, split_valids
+from presto_tpu.types import (
+    BIGINT,
+    BOOLEAN,
+    DATE,
+    DOUBLE,
+    INTEGER,
+    DataType,
+    TypeKind,
+    fixed_bytes,
+    varchar,
+)
+
+
+def _infer_column(values) -> tuple[DataType, np.ndarray, np.ndarray | None, Dictionary | None]:
+    """pandas/py values -> (dtype, physical array, valid mask, dict)."""
+    import pandas as pd
+
+    s = pd.Series(values)
+    valid = s.notna().to_numpy()
+    has_null = not valid.all()
+    if pd.api.types.is_bool_dtype(s):
+        return BOOLEAN, s.fillna(False).to_numpy(np.bool_), (
+            valid if has_null else None), None
+    if pd.api.types.is_integer_dtype(s):
+        a = s.fillna(0).to_numpy()
+        t = INTEGER if a.dtype.itemsize <= 4 else BIGINT
+        return t, a.astype(t.np_dtype), (valid if has_null else None), None
+    if pd.api.types.is_float_dtype(s):
+        # floats that are all integral + NaN came from a nullable int
+        # column (pandas promotes); keep them BIGINT
+        nz = s.dropna()
+        if len(nz) and (nz == nz.astype(np.int64)).all():
+            return BIGINT, s.fillna(0).to_numpy(np.int64), (
+                valid if has_null else None), None
+        return DOUBLE, s.fillna(0.0).to_numpy(DOUBLE.np_dtype), (
+            valid if has_null else None), None
+    if pd.api.types.is_datetime64_any_dtype(s):
+        days = (s.to_numpy("datetime64[D]")
+                - np.datetime64("1970-01-01", "D")).astype(np.int32)
+        days = np.where(valid, days, 0).astype(np.int32)
+        return DATE, days, (valid if has_null else None), None
+    # strings: dictionary-encode (ordered codes, the engine's VARCHAR)
+    strs = s.fillna("").astype(str)
+    d = Dictionary(sorted(set(strs[valid].tolist())) or [""])
+    codes = d.encode(strs.where(valid, d.values[0]).tolist()).astype(np.int32)
+    return varchar(), codes, (valid if has_null else None), d
+
+
+class MemorySink:
+    """The ConnectorPageSink analog: buffers appended batches; the
+    table becomes (or replaces) visible state only on ``commit()``."""
+
+    def __init__(self, connector: "MemoryConnector", table: str):
+        self.connector = connector
+        self.table = table
+        self.frames = []
+
+    def append_df(self, df) -> None:
+        self.frames.append(df)
+
+    def commit(self) -> int:
+        import pandas as pd
+
+        df = (pd.concat(self.frames, ignore_index=True)
+              if self.frames else None)
+        if df is None:
+            raise ValueError("empty sink: nothing to commit")
+        self.connector._store(self.table, df)
+        return len(df)
+
+
+class MemoryConnector:
+    name = "memory"
+
+    DEFAULT_UNITS_PER_SPLIT = 1 << 17
+
+    def __init__(self, units_per_split: int | None = None):
+        self.units_per_split = units_per_split or self.DEFAULT_UNITS_PER_SPLIT
+        self._tables: dict[str, dict] = {}
+
+    # ---- write path -----------------------------------------------------
+    def create_table(self, table: str, df) -> int:
+        """CTAS target: store a DataFrame as a columnar table."""
+        sink = MemorySink(self, table)
+        sink.append_df(df)
+        return sink.commit()
+
+    def insert(self, table: str, df) -> int:
+        """INSERT INTO: append rows (atomic per statement)."""
+        import pandas as pd
+
+        if table not in self._tables:
+            return self.create_table(table, df)
+        existing = self.table_pandas(table)
+        if list(df.columns) != list(existing.columns):
+            raise ValueError(
+                f"insert schema {list(df.columns)} != table "
+                f"{list(existing.columns)}"
+            )
+        sink = MemorySink(self, table)
+        sink.append_df(existing)
+        sink.append_df(df)
+        return sink.commit() - len(existing)
+
+    def drop_table(self, table: str) -> None:
+        del self._tables[table]
+
+    def _store(self, table: str, df) -> None:
+        cols: dict[str, np.ndarray] = {}
+        types: dict[str, DataType] = {}
+        dicts: dict[str, Dictionary] = {}
+        for c in df.columns:
+            t, data, valid, d = _infer_column(df[c])
+            types[c] = t
+            cols[c] = data
+            if valid is not None:
+                cols[c + "$valid"] = valid
+            if d is not None:
+                dicts[c] = d
+        self._tables[table] = {
+            "arrays": cols, "types": types, "dicts": dicts, "rows": len(df),
+        }
+
+    # ---- metadata -------------------------------------------------------
+    def tables(self) -> Sequence[str]:
+        return list(self._tables)
+
+    def schema(self, table: str) -> Mapping[str, DataType]:
+        return self._tables[table]["types"]
+
+    def dictionaries(self, table: str) -> Mapping[str, Dictionary]:
+        return self._tables[table]["dicts"]
+
+    def row_count(self, table: str) -> int:
+        return self._tables[table]["rows"]
+
+    def unique_keys(self, table: str):
+        return ()
+
+    def func_deps(self, table: str):
+        return {}
+
+    # ---- read path ------------------------------------------------------
+    def splits(self, table: str, target_splits: int = 0) -> Sequence[Split]:
+        rows = self._tables[table]["rows"]
+        per = self.units_per_split
+        if target_splits:
+            per = max(1, -(-rows // target_splits))
+        out = []
+        for chunk, lo in enumerate(range(0, max(rows, 1), per)):
+            hi = min(lo + per, rows)
+            out.append(Split(table, chunk, lo, hi, hi - lo))
+        return out or [Split(table, 0, 0, 0, 0)]
+
+    def scan_numpy(
+        self, split: Split, columns: Sequence[str] | None = None
+    ) -> Mapping[str, np.ndarray]:
+        t = self._tables[split.table]
+        keep = list(t["types"]) if columns is None else list(columns)
+        out = {}
+        for c in keep:
+            out[c] = t["arrays"][c][split.lo:split.hi]
+            v = t["arrays"].get(c + "$valid")
+            if v is not None:
+                out[c + "$valid"] = v[split.lo:split.hi]
+        return out
+
+    def scan(
+        self, split: Split, columns: Sequence[str] | None = None,
+        capacity: int | None = None,
+    ) -> Batch:
+        t = self._tables[split.table]
+        arrays, valids = split_valids(self.scan_numpy(split, columns))
+        n = split.hi - split.lo
+        cap = capacity or batch_capacity(max(n, 1))
+        types = {c: t["types"][c] for c in arrays}
+        dicts = {c: d for c, d in t["dicts"].items() if c in arrays}
+        return Batch.from_numpy(
+            arrays, types, capacity=cap, dictionaries=dicts, valids=valids
+        )
+
+    def table_pandas(self, table: str, columns: Sequence[str] | None = None):
+        import pandas as pd
+
+        from presto_tpu.batch import decode_values
+
+        t = self._tables[table]
+        arrays, valids = split_valids({
+            c: v for c, v in t["arrays"].items()
+            if columns is None or c in columns
+            or (c.endswith("$valid") and c[:-6] in columns)
+        })
+        return pd.DataFrame({
+            c: decode_values(v, valids.get(c), t["types"][c],
+                             t["dicts"].get(c))
+            for c, v in arrays.items()
+        })

@@ -343,6 +343,9 @@ class DistributedExecutor:
             b = op.process(b)[0]
         return DistBatch(b, sharded=True)
 
+    def _exec_values(self, node: N.Values, scalars) -> DistBatch:
+        return DistBatch(Batch({}, jnp.ones(1, jnp.bool_)), sharded=False)
+
     # ---- elementwise (sharding-transparent) ------------------------------
     def _exec_filter(self, node: N.Filter, scalars) -> DistBatch:
         d = self._exec(node.child, scalars)

@@ -544,6 +544,9 @@ class LocalExecutor:
         op = window_operator_from_node(node, scalars)
         return BatchStream.of(Pipeline(child, [op]).run())
 
+    def _exec_values(self, node: N.Values, scalars) -> BatchStream:
+        return BatchStream.of([Batch({}, jnp.ones(1, jnp.bool_))])
+
     # ---- set operations --------------------------------------------------
     def _exec_union(self, node: N.Union, scalars):
         """UNION ALL: lazy concatenation of the child streams. Columns

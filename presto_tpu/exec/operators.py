@@ -98,6 +98,21 @@ class FilterProjectOperator(Operator):
             src = batch.with_live(live)
             for name, e in projs.items():
                 v = evaluate(e, src)
+                if isinstance(v.data, str):
+                    # a projected VARCHAR literal: materialize it as a
+                    # one-entry dictionary column (literals normally
+                    # stay host-side to encode lazily against a peer's
+                    # dictionary, but an OUTPUT column must be device
+                    # data)
+                    from presto_tpu.batch import Dictionary
+
+                    d = Dictionary([v.data])
+                    cols[name] = Column(
+                        jnp.zeros(batch.capacity, jnp.int32),
+                        jnp.ones(batch.capacity, jnp.bool_),
+                        e.dtype, d,
+                    )
+                    continue
                 cols[name] = Column(v.data, v.valid, e.dtype, v.dictionary)
             return Batch(cols, live)
 

@@ -47,6 +47,10 @@ class Catalog:
     def connector(self, name: str):
         return self.connectors[name]
 
+    def invalidate(self, table: str) -> None:
+        """Drop cached metadata after DDL (CTAS/DROP) changes a table."""
+        self._meta_cache.pop(table, None)
+
     def resolve(self, table: str) -> TableMeta:
         cached = self._meta_cache.get(table)
         if cached is not None:

@@ -172,6 +172,17 @@ class SemiJoin(PlanNode):
 
 
 @dataclass(frozen=True)
+class Values(PlanNode):
+    """A single literal row with no columns — the FROM-less SELECT's
+    source (reference: ValuesNode). Projections over it evaluate the
+    select-list constants."""
+
+    @property
+    def fields(self):
+        return ()
+
+
+@dataclass(frozen=True)
 class Union(PlanNode):
     """UNION ALL: bag concatenation of children producing identical
     field names/types (the analyzer inserts coercing Projects;

@@ -101,6 +101,8 @@ def prune(node: N.PlanNode, needed: set[str] | None = None) -> N.PlanNode:
         return replace(node, child=prune(node.child, want))
     if isinstance(node, N.Limit):
         return replace(node, child=prune(node.child, needed))
+    if isinstance(node, N.Values):
+        return node
     if isinstance(node, N.Union):
         # children share field names; each child is a Project the
         # recursion narrows to the same needed set

@@ -46,11 +46,15 @@ class Session:
         over its ``workers`` axis (the DistributedQueryRunner shape).
         Session properties override engine defaults per query, the
         reference's SystemSessionProperties rule [SURVEY §5.6]."""
+        from presto_tpu.connectors.memory import MemoryConnector
         from presto_tpu.connectors.system import SystemConnector
         from presto_tpu.runtime.properties import validate_properties
 
         conns = dict(connectors)
         conns.setdefault("system", SystemConnector(self))
+        # the writable catalog: CREATE TABLE AS / INSERT INTO land here
+        # (reference: presto-memory as the default test/CTAS target)
+        conns.setdefault("memory", MemoryConnector())
         self.catalog = Catalog(conns)
         self.analyzer = Analyzer(self.catalog)
         self.properties = validate_properties(dict(properties or {}))
@@ -123,7 +127,13 @@ class Session:
         self.events.add(listener)
 
     def plan(self, sql: str) -> PlanNode:
+        from presto_tpu.sql import ast as A
+
         ast = parse(sql)
+        if isinstance(ast, (A.CreateTableAs, A.InsertInto, A.DropTable)):
+            raise ValueError(
+                "DDL statements execute via Session.sql(), not plan()/explain()"
+            )
         logical = self.analyzer.analyze(ast)
         return prune(logical)
 
@@ -139,18 +149,76 @@ class Session:
         return render_analyzed_plan(plan, recorder)
 
     def sql(self, sql: str):
-        """Execute and return a pandas DataFrame."""
+        """Execute and return a pandas DataFrame. DDL/DML statements
+        (CREATE TABLE AS / INSERT INTO / DROP TABLE) return a one-row
+        summary frame."""
+        from presto_tpu.sql import ast as A
+
+        stmt = parse(sql)
+        if isinstance(stmt, (A.CreateTableAs, A.InsertInto, A.DropTable)):
+            return self._run_ddl(sql, stmt)
         want = bool(self.prop("collect_node_stats"))
+        plan = prune(self.analyzer.analyze(stmt))
         df, _info = self._run_with_retries(
-            sql, (lambda: StatsRecorder()) if want else (lambda: None)
+            sql, plan, (lambda: StatsRecorder()) if want else (lambda: None)
         )
         return df
 
+    def _owning_catalog(self, table: str):
+        for cname, conn in self.catalog.connectors.items():
+            if table in conn.tables():
+                return cname
+        return None
+
+    def _run_ddl(self, sql: str, stmt):
+        """Write-path statements against the memory catalog
+        (reference: ConnectorPageSink + the coordinator's
+        finishInsert — all-or-nothing visibility [SURVEY §5.4]).
+        Target names must not shadow tables in read-only catalogs:
+        name resolution prefers user connectors, so a shadowed memory
+        table would be unreachable."""
+        import pandas as pd
+
+        from presto_tpu.sql import ast as A
+
+        mem = self.catalog.connector("memory")
+        owner = self._owning_catalog(stmt.name)
+        if isinstance(stmt, A.DropTable):
+            if owner == "memory":
+                mem.drop_table(stmt.name)
+            elif owner is not None:
+                raise ValueError(
+                    f"cannot drop {stmt.name}: it belongs to the read-only "
+                    f"{owner!r} catalog"
+                )
+            elif not stmt.if_exists:
+                raise ValueError(f"table not found in memory catalog: {stmt.name}")
+            self.catalog.invalidate(stmt.name)
+            return pd.DataFrame({"dropped": [stmt.name]})
+        # existence checks BEFORE running the (possibly expensive) query
+        if isinstance(stmt, A.CreateTableAs) and owner is not None:
+            raise ValueError(
+                f"table already exists in catalog {owner!r}: {stmt.name}"
+            )
+        if isinstance(stmt, A.InsertInto) and owner not in (None, "memory"):
+            raise ValueError(
+                f"cannot insert into {stmt.name}: the {owner!r} catalog "
+                "is read-only"
+            )
+        plan = prune(self.analyzer.analyze(stmt.query))
+        df, _info = self._run_with_retries(sql, plan, lambda: None)
+        if isinstance(stmt, A.CreateTableAs):
+            rows = mem.create_table(stmt.name, df)
+        else:
+            rows = mem.insert(stmt.name, df)
+        self.catalog.invalidate(stmt.name)
+        return pd.DataFrame({"rows": [rows]})
+
     def execute(self, sql: str):
         """Execute returning (DataFrame, QueryInfo)."""
-        return self._run_with_retries(sql, StatsRecorder)
+        return self._run_with_retries(sql, self.plan(sql), StatsRecorder)
 
-    def _run_with_retries(self, sql: str, make_recorder):
+    def _run_with_retries(self, sql: str, plan, make_recorder):
         """The engine's whole failure-recovery posture, like the
         reference's: no mid-query recovery — a failed attempt fails the
         query, and recovery is re-running it from the top
@@ -160,7 +228,7 @@ class Session:
         retries = self.prop("query_retries")
         for attempt in range(retries + 1):
             try:
-                return self._run_tracked(sql, self.plan(sql), make_recorder())
+                return self._run_tracked(sql, plan, make_recorder())
             except Exception:
                 if attempt == retries:
                     raise

@@ -806,7 +806,8 @@ class Analyzer:
     # ------------------------------------------------------------------
     def _build_join_tree(self, rels: list[Rel], edges: list[dict], scope: Scope):
         if not rels:
-            raise AnalysisError("queries without FROM are not supported")
+            # FROM-less SELECT: one literal row (reference: ValuesNode)
+            return N.Values()
         # apply pushdown filters
         plans: list[N.PlanNode] = []
         for r in rels:

@@ -235,6 +235,28 @@ class Query(Node):
 
 
 @dataclass(frozen=True)
+class CreateTableAs(Node):
+    """CREATE TABLE <name> AS <query> (CTAS into the memory catalog)."""
+
+    name: str
+    query: Node  # Query | SetQuery
+
+
+@dataclass(frozen=True)
+class InsertInto(Node):
+    """INSERT INTO <name> <query> (append, atomic per statement)."""
+
+    name: str
+    query: Node
+
+
+@dataclass(frozen=True)
+class DropTable(Node):
+    name: str
+    if_exists: bool = False
+
+
+@dataclass(frozen=True)
 class SetQuery(Node):
     """UNION [ALL] chain. ``ops[i]`` combines ``terms[i]`` into the
     running result ('union' dedups, 'union_all' keeps duplicates);

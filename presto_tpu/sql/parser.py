@@ -92,12 +92,40 @@ class Parser:
         return False
 
     # -- entry ------------------------------------------------------------
-    def parse(self) -> A.Query:
-        q = self.parse_query()
+    def parse(self) -> A.Node:
+        q = self.parse_statement()
         self.accept_op(";")
         if self.cur.kind != "EOF":
             raise ParseError("trailing input", self.cur)
         return q
+
+    def parse_statement(self) -> A.Node:
+        """Query, CREATE TABLE AS, INSERT INTO, or DROP TABLE."""
+        if self.word("create"):
+            self.eat()
+            if not self._accept_word("table"):
+                raise ParseError("expected TABLE", self.cur)
+            name = self.parse_name()
+            self.expect_kw("as")
+            return A.CreateTableAs(name, self.parse_query())
+        if self.word("insert"):
+            self.eat()
+            if not self._accept_word("into"):
+                raise ParseError("expected INTO", self.cur)
+            name = self.parse_name()
+            return A.InsertInto(name, self.parse_query())
+        if self.word("drop"):
+            self.eat()
+            if not self._accept_word("table"):
+                raise ParseError("expected TABLE", self.cur)
+            if_exists = False
+            if self.word("if"):
+                self.eat()
+                if not self._accept_word("exists"):
+                    raise ParseError("expected EXISTS", self.cur)
+                if_exists = True
+            return A.DropTable(self.parse_name(), if_exists)
+        return self.parse_query()
 
     # -- query ------------------------------------------------------------
     def parse_query(self) -> A.Node:
