@@ -50,12 +50,13 @@ def _infer_column(values) -> tuple[DataType, np.ndarray, np.ndarray | None, Dict
         t = INTEGER if a.dtype.itemsize <= 4 else BIGINT
         return t, a.astype(t.np_dtype), (valid if has_null else None), None
     if pd.api.types.is_float_dtype(s):
-        # floats that are all integral + NaN came from a nullable int
-        # column (pandas promotes); keep them BIGINT
+        # integral floats WITH NULLs came from a nullable int column
+        # (pandas promotes int+NaN to float); keep them BIGINT. A
+        # NULL-free float column stays DOUBLE even when its current
+        # values happen to be integral (2.0 is a double).
         nz = s.dropna()
-        if len(nz) and (nz == nz.astype(np.int64)).all():
-            return BIGINT, s.fillna(0).to_numpy(np.int64), (
-                valid if has_null else None), None
+        if has_null and len(nz) and (nz == nz.astype(np.int64)).all():
+            return BIGINT, s.fillna(0).to_numpy(np.int64), valid, None
         return DOUBLE, s.fillna(0.0).to_numpy(DOUBLE.np_dtype), (
             valid if has_null else None), None
     if pd.api.types.is_datetime64_any_dtype(s):
@@ -110,21 +111,44 @@ class MemoryConnector:
         return sink.commit()
 
     def insert(self, table: str, df) -> int:
-        """INSERT INTO: append rows (atomic per statement)."""
-        import pandas as pd
-
+        """INSERT INTO: append rows (atomic per statement; the source
+        frames are kept, so appends re-encode but never decode)."""
         if table not in self._tables:
-            return self.create_table(table, df)
-        existing = self.table_pandas(table)
-        if list(df.columns) != list(existing.columns):
+            raise KeyError(f"table not found: {table}")
+        t = self._tables[table]
+        existing_df = t["df"]
+        if list(df.columns) != list(existing_df.columns):
             raise ValueError(
                 f"insert schema {list(df.columns)} != table "
-                f"{list(existing.columns)}"
+                f"{list(existing_df.columns)}"
             )
+        self._check_types(table, df)
         sink = MemorySink(self, table)
-        sink.append_df(existing)
+        sink.append_df(existing_df)
         sink.append_df(df)
-        return sink.commit() - len(existing)
+        return sink.commit() - len(existing_df)
+
+    _NUMERIC = (TypeKind.INTEGER, TypeKind.BIGINT, TypeKind.DOUBLE,
+                TypeKind.DECIMAL)
+
+    def _check_types(self, table: str, df) -> None:
+        """Inserted values must stay in the column's type family — a
+        name-only check would let a mismatched insert silently re-infer
+        (and rewrite) the whole column."""
+        existing = self._tables[table]["types"]
+        for c in df.columns:
+            t_new, _, _, _ = _infer_column(df[c])
+            t_old = existing[c]
+            ok = (
+                t_new.kind is t_old.kind
+                or (t_new.kind in self._NUMERIC and t_old.kind in self._NUMERIC)
+                or {t_new.kind, t_old.kind} <= {TypeKind.VARCHAR, TypeKind.BYTES}
+            )
+            if not ok:
+                raise ValueError(
+                    f"insert type mismatch for {c!r}: {t_new.kind.value} "
+                    f"into {t_old.kind.value}"
+                )
 
     def drop_table(self, table: str) -> None:
         del self._tables[table]
@@ -141,8 +165,11 @@ class MemoryConnector:
                 cols[c + "$valid"] = valid
             if d is not None:
                 dicts[c] = d
+        # the source frame is kept so appends re-infer from original
+        # values (no decode round trip, no lossy re-inference)
         self._tables[table] = {
             "arrays": cols, "types": types, "dicts": dicts, "rows": len(df),
+            "df": df.reset_index(drop=True),
         }
 
     # ---- metadata -------------------------------------------------------

@@ -997,3 +997,44 @@ order by lochierarchy desc,
 limit 100
 """,
 })
+
+QUERIES.update({
+    # q38: customers who bought through ALL three channels in a year
+    "q38": """
+select count(*) cnt from (
+  select distinct c_last_name, c_first_name, d_date
+  from store_sales, date_dim, customer
+  where ss_sold_date_sk = d_date_sk and ss_customer_sk = c_customer_sk
+    and d_month_seq between 1200 and 1211
+  intersect
+  select distinct c_last_name, c_first_name, d_date
+  from catalog_sales, date_dim, customer
+  where cs_sold_date_sk = d_date_sk and cs_bill_customer_sk = c_customer_sk
+    and d_month_seq between 1200 and 1211
+  intersect
+  select distinct c_last_name, c_first_name, d_date
+  from web_sales, date_dim, customer
+  where ws_sold_date_sk = d_date_sk and ws_bill_customer_sk = c_customer_sk
+    and d_month_seq between 1200 and 1211
+) hot_cust
+""",
+    # q87: store-only customers (except the other two channels)
+    "q87": """
+select count(*) cnt from (
+  select distinct c_last_name, c_first_name, d_date
+  from store_sales, date_dim, customer
+  where ss_sold_date_sk = d_date_sk and ss_customer_sk = c_customer_sk
+    and d_month_seq between 1200 and 1211
+  except
+  select distinct c_last_name, c_first_name, d_date
+  from catalog_sales, date_dim, customer
+  where cs_sold_date_sk = d_date_sk and cs_bill_customer_sk = c_customer_sk
+    and d_month_seq between 1200 and 1211
+  except
+  select distinct c_last_name, c_first_name, d_date
+  from web_sales, date_dim, customer
+  where ws_sold_date_sk = d_date_sk and ws_bill_customer_sk = c_customer_sk
+    and d_month_seq between 1200 and 1211
+) cool_cust
+""",
+})

@@ -1091,12 +1091,36 @@ def q86(t):
               "lochierarchy", "rank_within_parent"]].head(100)
 
 
+
+
+def _channel_customer_days(t, fact, prefix, cust_col):
+    f = t[fact].merge(t["date_dim"], left_on=f"{prefix}_sold_date_sk",
+                      right_on="d_date_sk")
+    f = f[f.d_month_seq.between(1200, 1211)]
+    f = f.merge(t["customer"], left_on=cust_col, right_on="c_customer_sk")
+    return set(map(tuple, f[["c_last_name", "c_first_name", "d_date"]]
+                   .drop_duplicates().itertuples(index=False)))
+
+
+def q38(t):
+    ss = _channel_customer_days(t, "store_sales", "ss", "ss_customer_sk")
+    cs = _channel_customer_days(t, "catalog_sales", "cs", "cs_bill_customer_sk")
+    ws = _channel_customer_days(t, "web_sales", "ws", "ws_bill_customer_sk")
+    return pd.DataFrame({"cnt": [len(ss & cs & ws)]})
+
+
+def q87(t):
+    ss = _channel_customer_days(t, "store_sales", "ss", "ss_customer_sk")
+    cs = _channel_customer_days(t, "catalog_sales", "cs", "cs_bill_customer_sk")
+    ws = _channel_customer_days(t, "web_sales", "ws", "ws_bill_customer_sk")
+    return pd.DataFrame({"cnt": [len(ss - cs - ws)]})
+
 ORACLES = {
     name: globals()[name]
     for name in ["q1", "q3", "q7", "q12", "q13", "q15", "q16", "q17", "q19",
                  "q20", "q21", "q22", "q25", "q26", "q29", "q32", "q33",
-                 "q34", "q36", "q37", "q42", "q43", "q45", "q46", "q48",
+                 "q34", "q36", "q37", "q38", "q42", "q43", "q45", "q46", "q48",
                  "q52", "q53", "q55", "q56", "q60", "q62", "q65", "q68",
-                 "q71", "q73", "q76", "q79", "q85", "q86", "q88", "q89",
+                 "q71", "q73", "q76", "q79", "q85", "q86", "q87", "q88", "q89",
                  "q90", "q91", "q92", "q93", "q94", "q96", "q98", "q99"]
 }

@@ -122,6 +122,13 @@ SESSION_PROPERTIES: dict[str, PropertyDef] = {
             _non_negative,
         ),
         PropertyDef(
+            "profile_dir", str, None,
+            "When set, every query executes under jax.profiler.trace "
+            "writing an XLA op-level timeline (TensorBoard/xprof) to "
+            "this directory — the device-side complement to EXPLAIN "
+            "ANALYZE's host-level per-operator stats.",
+        ),
+        PropertyDef(
             "pallas_strings", bool, None,
             "Force the Pallas string-predicate kernels on or off "
             "(process-wide; default: on when running on TPU). Mirrors "

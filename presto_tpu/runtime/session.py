@@ -121,6 +121,20 @@ class Session:
             direct_group_limit=self.prop("direct_group_limit"),
         )
 
+    def _profiled(self):
+        """XLA op-level profiling per query when ``profile_dir`` is set
+        (jax.profiler trace -> TensorBoard/xprof), the device-side
+        complement to the host-level EXPLAIN ANALYZE node stats
+        [SURVEY §5.1 TPU-mapping row]."""
+        import contextlib
+
+        d = self.prop("profile_dir")
+        if not d:
+            return contextlib.nullcontext()
+        import jax
+
+        return jax.profiler.trace(d)
+
     # ------------------------------------------------------------------
     def add_event_listener(self, listener):
         """Register an EventListener (reference: EventListener SPI)."""
@@ -200,11 +214,14 @@ class Session:
             raise ValueError(
                 f"table already exists in catalog {owner!r}: {stmt.name}"
             )
-        if isinstance(stmt, A.InsertInto) and owner not in (None, "memory"):
-            raise ValueError(
-                f"cannot insert into {stmt.name}: the {owner!r} catalog "
-                "is read-only"
-            )
+        if isinstance(stmt, A.InsertInto):
+            if owner is None:
+                raise ValueError(f"table not found: {stmt.name}")
+            if owner != "memory":
+                raise ValueError(
+                    f"cannot insert into {stmt.name}: the {owner!r} catalog "
+                    "is read-only"
+                )
         plan = prune(self.analyzer.analyze(stmt.query))
         df, _info = self._run_with_retries(sql, plan, lambda: None)
         if isinstance(stmt, A.CreateTableAs):
@@ -251,7 +268,7 @@ class Session:
         executor = self._make_executor()
         executor.recorder = recorder
         try:
-            with REGISTRY.timer("query.execution").time():
+            with REGISTRY.timer("query.execution").time(), self._profiled():
                 df = executor.run(plan)
             info.state = "FINISHED"
             info.output_rows = len(df)

@@ -327,7 +327,11 @@ class Analyzer:
 
         acc = as_union_input(*planned[0])
         for op, (out, scope) in zip(q.ops, planned[1:]):
-            acc = N.Union((acc, as_union_input(out, scope)))
+            rhs = as_union_input(out, scope)
+            if op in ("intersect", "except"):
+                acc = self._plan_set_diff(acc, rhs, internal, types, op)
+                continue
+            acc = N.Union((acc, rhs))
             if op == "union":  # distinct: dedup everything so far
                 acc = N.Aggregate(
                     acc,
@@ -356,6 +360,43 @@ class Analyzer:
             plan = N.Limit(plan, q.limit)
         out = N.Output(plan, tuple(names), tuple(internal))
         return out, out_scope
+
+    def _plan_set_diff(self, left, right, internal, types, op: str):
+        """INTERSECT / EXCEPT (distinct) as a tagged union + grouped
+        tag sums — reuses the union machinery, so mixed dictionaries
+        and any groupable key types come for free (the reference plans
+        these as semi joins; a tagged re-aggregation is the
+        shuffle-once equivalent here):
+
+            UNION ALL(left tagged a=1, right tagged b=1)
+            GROUP BY all columns, suming the tags
+            HAVING a > 0 AND (b > 0 | b = 0)
+        """
+        la, lb = self.fresh("seta"), self.fresh("setb")
+        cols = tuple((n, InputRef(t, n)) for n, t in zip(internal, types))
+
+        def tagged(p, a, b):
+            return N.Project(
+                p,
+                cols + ((la, Literal(BIGINT, a)), (lb, Literal(BIGINT, b))),
+            )
+
+        u = N.Union((tagged(left, 1, 0), tagged(right, 0, 1)))
+        sa, sb = self.fresh("seta"), self.fresh("setb")
+        agg = N.Aggregate(
+            u,
+            cols,
+            (
+                AggSpec("sum", InputRef(BIGINT, la), sa, BIGINT),
+                AggSpec("sum", InputRef(BIGINT, lb), sb, BIGINT),
+            ),
+        )
+        in_a = Call(BOOLEAN, "gt", (InputRef(BIGINT, sa), Literal(BIGINT, 0)))
+        b_zero = Literal(BIGINT, 0)
+        in_b = Call(BOOLEAN, "gt", (InputRef(BIGINT, sb), b_zero))
+        not_b = Call(BOOLEAN, "eq", (InputRef(BIGINT, sb), b_zero))
+        cond = Call(BOOLEAN, "and", (in_a, in_b if op == "intersect" else not_b))
+        return N.Project(N.Filter(agg, cond), cols)
 
     def _coerce_to(self, e: Expr, t) -> Expr:
         """Lift ``e`` to the union-unified type ``t`` (already a common

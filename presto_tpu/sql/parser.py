@@ -141,22 +141,17 @@ class Parser:
                 self.expect_op(")")
                 if not self.accept_op(","):
                     break
-        first, first_parenthesized = self._parse_set_term()
+        first, first_parenthesized = self._parse_intersect_chain()
         terms = [first]
         ops: list[str] = []
-        while True:
-            if self.kw("union"):
-                self.eat()
-                if self.accept_kw("all"):
-                    ops.append("union_all")
-                else:
-                    self.accept_kw("distinct")
-                    ops.append("union")
-                terms.append(self._parse_set_term()[0])
-                continue
-            if self.word("intersect", "except"):
-                raise ParseError("INTERSECT/EXCEPT not supported", self.cur)
-            break
+        while self.kw("union"):
+            self.eat()
+            if self.accept_kw("all"):
+                ops.append("union_all")
+            else:
+                self.accept_kw("distinct")
+                ops.append("union")
+            terms.append(self._parse_intersect_chain()[0])
         order_by: list[A.OrderItem] = []
         if self.accept_kw("order"):
             self.expect_kw("by")
@@ -191,6 +186,24 @@ class Parser:
             limit=limit,
             ctes=tuple(ctes),
         )
+
+    def _parse_intersect_chain(self) -> tuple[A.Node, bool]:
+        """INTERSECT/EXCEPT bind tighter than UNION (standard SQL).
+        Both are set (distinct) operations; the ALL variants are
+        rejected explicitly."""
+        first, parenthesized = self._parse_set_term()
+        terms = [first]
+        ops: list[str] = []
+        while self.word("intersect", "except"):
+            op = self.eat().text.lower()
+            if self.kw("all"):
+                raise ParseError(f"{op.upper()} ALL not supported", self.cur)
+            self.accept_kw("distinct")
+            ops.append(op)
+            terms.append(self._parse_set_term()[0])
+        if len(terms) == 1:
+            return first, parenthesized
+        return A.SetQuery(terms=tuple(terms), ops=tuple(ops)), True
 
     def _parse_set_term(self) -> tuple[A.Node, bool]:
         """One UNION operand: a parenthesized query or a bare select

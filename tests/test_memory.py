@@ -118,3 +118,28 @@ def test_fromless_select_and_string_literals(session):
     assert df["z"][0] == "hello" and int(df["n"][0]) == 2
     df2 = session.sql("select 'tag' t, n_name from nation order by n_name limit 2")
     assert df2["t"].tolist() == ["tag", "tag"]
+
+
+def test_insert_type_and_existence_guards(session):
+    session.sql("create table typed as select 1 a, 2.5 x")
+    # double column stays double (no integral-float reclassification)
+    df = session.sql("select x from typed")
+    assert abs(float(df["x"][0]) - 2.5) < 1e-9
+    # type-family mismatch rejected, table unchanged
+    with pytest.raises(Exception, match="type mismatch"):
+        session.sql("insert into typed select 'str' a, 1.0 x")
+    assert int(session.sql("select a from typed")["a"][0]) == 1
+    # INSERT into a nonexistent table errors instead of creating it
+    with pytest.raises(ValueError, match="not found"):
+        session.sql("insert into never_created select 1 z")
+
+
+def test_double_stays_double_across_inserts():
+    conn = MemoryConnector()
+    conn.create_table("d", pd.DataFrame({"x": [2.0, 4.0]}))
+    from presto_tpu.types import TypeKind
+
+    assert conn.schema("d")["x"].kind is TypeKind.DOUBLE
+    conn.insert("d", pd.DataFrame({"x": [1.5]}))
+    assert conn.schema("d")["x"].kind is TypeKind.DOUBLE
+    assert conn.table_pandas("d")["x"].tolist() == [2.0, 4.0, 1.5]

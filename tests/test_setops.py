@@ -134,7 +134,47 @@ def test_union_in_subquery_and_cte(env):
     assert int(df2["c"][0]) == 5
 
 
-def test_intersect_rejected_clearly(env):
+def test_intersect_and_except(env):
     s, _ = env
-    with pytest.raises(Exception, match="INTERSECT/EXCEPT"):
-        s.sql("select 1 intersect select 2")
+    df = s.sql(
+        "select n_regionkey k from nation where n_regionkey < 3 "
+        "intersect select r_regionkey k from region where r_regionkey > 1 "
+        "order by k"
+    )
+    assert df["k"].tolist() == [2]
+    df2 = s.sql(
+        "select n_regionkey k from nation "
+        "except select r_regionkey k from region where r_regionkey >= 2 "
+        "order by k"
+    )
+    assert df2["k"].tolist() == [0, 1]
+
+
+def test_intersect_binds_tighter_than_union(env):
+    s, _ = env
+    # A union (B intersect C): standard precedence
+    df = s.sql(
+        "select 0 k from region where r_regionkey = 4 "
+        "union "
+        "select n_regionkey k from nation where n_regionkey < 3 "
+        "intersect select r_regionkey k from region where r_regionkey > 1 "
+        "order by k"
+    )
+    assert df["k"].tolist() == [0, 2]
+
+
+def test_intersect_over_dictionary_columns(env):
+    s, conn = env
+    df = s.sql(
+        "select l_returnflag f from lineitem "
+        "intersect select l_linestatus f from lineitem order by f"
+    )
+    li = conn.table_pandas("lineitem")
+    want = sorted(set(li.l_returnflag) & set(li.l_linestatus))
+    assert df["f"].tolist() == want
+
+
+def test_intersect_all_rejected(env):
+    s, _ = env
+    with pytest.raises(Exception, match="ALL not supported"):
+        s.sql("select 1 x intersect all select 1 x")
