@@ -128,23 +128,26 @@ class MemoryConnector:
         sink.append_df(df)
         return sink.commit() - len(existing_df)
 
-    _NUMERIC = (TypeKind.INTEGER, TypeKind.BIGINT, TypeKind.DOUBLE,
-                TypeKind.DECIMAL)
-
     def _check_types(self, table: str, df) -> None:
-        """Inserted values must stay in the column's type family — a
-        name-only check would let a mismatched insert silently re-infer
-        (and rewrite) the whole column."""
+        """Inserted values must be coercible INTO the column's existing
+        type (common_super_type(new, old) == old): a looser check would
+        let e.g. a DOUBLE insert silently re-infer and rewrite a whole
+        INTEGER column."""
+        from presto_tpu.types import common_super_type
+
         existing = self._tables[table]["types"]
         for c in df.columns:
             t_new, _, _, _ = _infer_column(df[c])
             t_old = existing[c]
-            ok = (
-                t_new.kind is t_old.kind
-                or (t_new.kind in self._NUMERIC and t_old.kind in self._NUMERIC)
-                or {t_new.kind, t_old.kind} <= {TypeKind.VARCHAR, TypeKind.BYTES}
-            )
-            if not ok:
+            if t_new.kind is t_old.kind:
+                continue
+            if {t_new.kind, t_old.kind} <= {TypeKind.VARCHAR, TypeKind.BYTES}:
+                continue
+            try:
+                widened = common_super_type(t_new, t_old)
+            except TypeError:
+                widened = None
+            if widened is None or widened.kind is not t_old.kind:
                 raise ValueError(
                     f"insert type mismatch for {c!r}: {t_new.kind.value} "
                     f"into {t_old.kind.value}"

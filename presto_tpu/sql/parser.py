@@ -144,13 +144,22 @@ class Parser:
         first, first_parenthesized = self._parse_intersect_chain()
         terms = [first]
         ops: list[str] = []
-        while self.kw("union"):
-            self.eat()
-            if self.accept_kw("all"):
-                ops.append("union_all")
-            else:
+        while self.kw("union") or self.word("except"):
+            # UNION and EXCEPT share a precedence level (standard SQL);
+            # INTERSECT binds tighter and is folded by the chain below
+            if self.word("except"):
+                self.eat()
+                if self.kw("all"):
+                    raise ParseError("EXCEPT ALL not supported", self.cur)
                 self.accept_kw("distinct")
-                ops.append("union")
+                ops.append("except")
+            else:
+                self.eat()
+                if self.accept_kw("all"):
+                    ops.append("union_all")
+                else:
+                    self.accept_kw("distinct")
+                    ops.append("union")
             terms.append(self._parse_intersect_chain()[0])
         order_by: list[A.OrderItem] = []
         if self.accept_kw("order"):
@@ -188,18 +197,17 @@ class Parser:
         )
 
     def _parse_intersect_chain(self) -> tuple[A.Node, bool]:
-        """INTERSECT/EXCEPT bind tighter than UNION (standard SQL).
-        Both are set (distinct) operations; the ALL variants are
-        rejected explicitly."""
+        """INTERSECT binds tighter than UNION/EXCEPT (standard SQL).
+        Set (distinct) semantics only; the ALL variant is rejected."""
         first, parenthesized = self._parse_set_term()
         terms = [first]
         ops: list[str] = []
-        while self.word("intersect", "except"):
-            op = self.eat().text.lower()
+        while self.word("intersect"):
+            self.eat()
             if self.kw("all"):
-                raise ParseError(f"{op.upper()} ALL not supported", self.cur)
+                raise ParseError("INTERSECT ALL not supported", self.cur)
             self.accept_kw("distinct")
-            ops.append(op)
+            ops.append("intersect")
             terms.append(self._parse_set_term()[0])
         if len(terms) == 1:
             return first, parenthesized
