@@ -1038,3 +1038,147 @@ select count(*) cnt from (
 ) cool_cust
 """,
 })
+
+# -- round-3 breadth batch 4. Adaptations: q28 keeps only the
+# count(distinct) per band block (the engine allows one DISTINCT
+# aggregate per grouped query); q30/q81 correlate on the refunded
+# address state (this schema's returns carry the refunded address);
+# q50 drops the unconstrained d1 alias and anchors the group on the
+# store PK.
+
+QUERIES.update({
+    # q28: distinct list prices in six quantity/price bands (cross join)
+    "q28": """
+select * from
+ (select count(distinct ss_list_price) b1_cntd from store_sales
+  where ss_quantity between 0 and 5
+    and (ss_list_price between 8 and 108 or ss_coupon_amt between 0 and 1000
+         or ss_wholesale_cost between 7 and 57)) b1,
+ (select count(distinct ss_list_price) b2_cntd from store_sales
+  where ss_quantity between 6 and 10
+    and (ss_list_price between 9 and 109 or ss_coupon_amt between 0 and 2000
+         or ss_wholesale_cost between 31 and 81)) b2,
+ (select count(distinct ss_list_price) b3_cntd from store_sales
+  where ss_quantity between 11 and 15
+    and (ss_list_price between 14 and 114 or ss_coupon_amt between 0 and 3000
+         or ss_wholesale_cost between 17 and 67)) b3,
+ (select count(distinct ss_list_price) b4_cntd from store_sales
+  where ss_quantity between 16 and 20
+    and (ss_list_price between 6 and 106 or ss_coupon_amt between 0 and 4000
+         or ss_wholesale_cost between 30 and 80)) b4,
+ (select count(distinct ss_list_price) b5_cntd from store_sales
+  where ss_quantity between 21 and 25
+    and (ss_list_price between 10 and 110 or ss_coupon_amt between 0 and 5000
+         or ss_wholesale_cost between 37 and 87)) b5,
+ (select count(distinct ss_list_price) b6_cntd from store_sales
+  where ss_quantity between 26 and 30
+    and (ss_list_price between 17 and 117 or ss_coupon_amt between 0 and 6000
+         or ss_wholesale_cost between 33 and 83)) b6
+""",
+    # q30: web returners above 1.2x their state's average
+    "q30": """
+with customer_total_return as
+ (select wr_returning_customer_sk as ctr_customer_sk, ca_state as ctr_state,
+         sum(wr_return_amt) as ctr_total_return
+  from web_returns, date_dim, customer_address
+  where wr_returned_date_sk = d_date_sk and d_year = 2000
+    and wr_refunded_addr_sk = ca_address_sk
+  group by wr_returning_customer_sk, ca_state)
+select c_customer_id, c_salutation, c_first_name, c_last_name,
+       ctr_total_return
+from customer_total_return ctr1, customer
+where ctr1.ctr_total_return > (select avg(ctr_total_return) * 1.2
+                               from customer_total_return ctr2
+                               where ctr1.ctr_state = ctr2.ctr_state)
+  and ctr1.ctr_customer_sk = c_customer_sk
+order by c_customer_id, ctr_total_return
+limit 100
+""",
+    # q50: store return-lag buckets
+    "q50": """
+select s_store_name, s_store_id, s_state,
+       sum(case when sr_returned_date_sk - ss_sold_date_sk <= 30
+                then 1 else 0 end) as d30,
+       sum(case when sr_returned_date_sk - ss_sold_date_sk > 30
+                 and sr_returned_date_sk - ss_sold_date_sk <= 60
+                then 1 else 0 end) as d60,
+       sum(case when sr_returned_date_sk - ss_sold_date_sk > 60
+                 and sr_returned_date_sk - ss_sold_date_sk <= 90
+                then 1 else 0 end) as d90,
+       sum(case when sr_returned_date_sk - ss_sold_date_sk > 90
+                then 1 else 0 end) as d120
+from store_sales, store_returns, store, date_dim d2
+where d2.d_year = 2000 and d2.d_moy = 8
+  and ss_ticket_number = sr_ticket_number and ss_item_sk = sr_item_sk
+  and ss_customer_sk = sr_customer_sk
+  and sr_returned_date_sk = d2.d_date_sk
+  and ss_store_sk = s_store_sk
+group by s_store_sk, s_store_name, s_store_id, s_state
+order by s_store_name, s_store_id, s_state
+limit 100
+""",
+    # q61: promoted share of one category's store revenue
+    "q61": """
+select promotions, total,
+       cast(promotions as double) / cast(total as double) * 100 as share
+from (select sum(ss_ext_sales_price) promotions
+      from store_sales, store, promotion, date_dim, customer,
+           customer_address, item
+      where ss_sold_date_sk = d_date_sk and ss_store_sk = s_store_sk
+        and ss_promo_sk = p_promo_sk and ss_customer_sk = c_customer_sk
+        and ca_address_sk = c_current_addr_sk and ss_item_sk = i_item_sk
+        and ca_gmt_offset <= -5 and i_category = 'Jewelry'
+        and (p_channel_dmail = 'Y' or p_channel_email = 'Y'
+             or p_channel_tv = 'Y')
+        and d_year = 2000) promotional_sales,
+     (select sum(ss_ext_sales_price) total
+      from store_sales, store, date_dim, customer, customer_address, item
+      where ss_sold_date_sk = d_date_sk and ss_store_sk = s_store_sk
+        and ss_customer_sk = c_customer_sk
+        and ca_address_sk = c_current_addr_sk and ss_item_sk = i_item_sk
+        and ca_gmt_offset <= -5 and i_category = 'Jewelry'
+        and d_year = 2000) all_sales
+""",
+    # q69: demographics of store-only shoppers in selected states
+    "q69": """
+select cd_gender, cd_marital_status, cd_education_status, count(*) cnt1,
+       cd_purchase_estimate, count(*) cnt2
+from customer c, customer_address ca, customer_demographics
+where c.c_current_addr_sk = ca.ca_address_sk
+  and ca_state in ('KY', 'GA', 'NM', 'CA', 'TX', 'OH')
+  and cd_demo_sk = c.c_current_cdemo_sk
+  and exists (select * from store_sales, date_dim
+              where c.c_customer_sk = ss_customer_sk
+                and ss_sold_date_sk = d_date_sk and d_year = 2001)
+  and not exists (select * from web_sales, date_dim
+                  where c.c_customer_sk = ws_bill_customer_sk
+                    and ws_sold_date_sk = d_date_sk and d_year = 2001)
+  and not exists (select * from catalog_sales, date_dim
+                  where c.c_customer_sk = cs_bill_customer_sk
+                    and cs_sold_date_sk = d_date_sk and d_year = 2001)
+group by cd_gender, cd_marital_status, cd_education_status,
+         cd_purchase_estimate
+order by cd_gender, cd_marital_status, cd_education_status,
+         cd_purchase_estimate
+limit 100
+""",
+    # q81: q30's catalog twin (returning address state)
+    "q81": """
+with customer_total_return as
+ (select cr_returning_customer_sk as ctr_customer_sk, ca_state as ctr_state,
+         sum(cr_return_amount) as ctr_total_return
+  from catalog_returns, date_dim, customer_address
+  where cr_returned_date_sk = d_date_sk and d_year = 2000
+    and cr_returning_addr_sk = ca_address_sk
+  group by cr_returning_customer_sk, ca_state)
+select c_customer_id, c_salutation, c_first_name, c_last_name,
+       ctr_total_return
+from customer_total_return ctr1, customer
+where ctr1.ctr_total_return > (select avg(ctr_total_return) * 1.2
+                               from customer_total_return ctr2
+                               where ctr1.ctr_state = ctr2.ctr_state)
+  and ctr1.ctr_customer_sk = c_customer_sk
+order by c_customer_id, ctr_total_return
+limit 100
+""",
+})

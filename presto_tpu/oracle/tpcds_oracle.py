@@ -1115,12 +1115,152 @@ def q87(t):
     ws = _channel_customer_days(t, "web_sales", "ws", "ws_bill_customer_sk")
     return pd.DataFrame({"cnt": [len(ss - cs - ws)]})
 
+
+# -- round-3 breadth (batch 4)
+
+
+def q28(t):
+    ss = t["store_sales"]
+    bands = [
+        ((0, 5), (8, 108), (0, 1000), (7, 57)),
+        ((6, 10), (9, 109), (0, 2000), (31, 81)),
+        ((11, 15), (14, 114), (0, 3000), (17, 67)),
+        ((16, 20), (6, 106), (0, 4000), (30, 80)),
+        ((21, 25), (10, 110), (0, 5000), (37, 87)),
+        ((26, 30), (17, 117), (0, 6000), (33, 83)),
+    ]
+    out = {}
+    for i, (q, lp, cp, wc) in enumerate(bands, 1):
+        f = ss[ss.ss_quantity.between(*q)
+               & (ss.ss_list_price.between(*lp)
+                  | ss.ss_coupon_amt.between(*cp)
+                  | ss.ss_wholesale_cost.between(*wc))]
+        out[f"b{i}_cntd"] = [f.ss_list_price.dropna().nunique()]
+    return pd.DataFrame(out)
+
+
+def _returners_above_state_avg(t, returns, cust_col, addr_col, amt_col):
+    date_col = [c for c in t[returns].columns
+                if c.endswith("returned_date_sk")][0]
+    ctr = t[returns].merge(
+        t["date_dim"], left_on=date_col, right_on="d_date_sk"
+    )
+    ctr = ctr[ctr.d_year == 2000]
+    ctr = ctr.merge(t["customer_address"], left_on=addr_col,
+                    right_on="ca_address_sk")
+    g = ctr.groupby([cust_col, "ca_state"], as_index=False).agg(
+        ctr_total_return=(amt_col, "sum")
+    )
+    ave = g.groupby("ca_state")["ctr_total_return"].mean().rename(
+        "state_avg"
+    ).reset_index()
+    j = g.merge(ave, on="ca_state")
+    j = j[j.ctr_total_return > 1.2 * j.state_avg]
+    j = j.merge(t["customer"], left_on=cust_col, right_on="c_customer_sk")
+    out = j[["c_customer_id", "c_salutation", "c_first_name", "c_last_name",
+             "ctr_total_return"]]
+    return _srt(out, ["c_customer_id", "ctr_total_return"]).head(100)
+
+
+def q30(t):
+    return _returners_above_state_avg(
+        t, "web_returns", "wr_returning_customer_sk", "wr_refunded_addr_sk",
+        "wr_return_amt",
+    )
+
+
+def q81(t):
+    return _returners_above_state_avg(
+        t, "catalog_returns", "cr_returning_customer_sk",
+        "cr_returning_addr_sk", "cr_return_amount",
+    )
+
+
+def q50(t):
+    j = t["store_sales"].merge(
+        t["store_returns"],
+        left_on=["ss_ticket_number", "ss_item_sk", "ss_customer_sk"],
+        right_on=["sr_ticket_number", "sr_item_sk", "sr_customer_sk"],
+    )
+    dd = t["date_dim"]
+    dd = dd[(dd.d_year == 2000) & (dd.d_moy == 8)]
+    j = j.merge(dd, left_on="sr_returned_date_sk", right_on="d_date_sk")
+    j = j.merge(t["store"], left_on="ss_store_sk", right_on="s_store_sk")
+    lag = j.sr_returned_date_sk - j.ss_sold_date_sk
+    j = j.assign(
+        d30=(lag <= 30).astype(int),
+        d60=((lag > 30) & (lag <= 60)).astype(int),
+        d90=((lag > 60) & (lag <= 90)).astype(int),
+        d120=(lag > 90).astype(int),
+    )
+    g = j.groupby(["s_store_sk", "s_store_name", "s_store_id", "s_state"],
+                  as_index=False)[["d30", "d60", "d90", "d120"]].sum()
+    g = g.drop(columns=["s_store_sk"])
+    return _srt(g, ["s_store_name", "s_store_id", "s_state"]).head(100)
+
+
+def q61(t):
+    def revenue(with_promo):
+        f = t["store_sales"].merge(
+            t["date_dim"], left_on="ss_sold_date_sk", right_on="d_date_sk"
+        )
+        f = f[f.d_year == 2000]
+        f = f.merge(t["store"], left_on="ss_store_sk", right_on="s_store_sk")
+        f = f.merge(t["customer"], left_on="ss_customer_sk",
+                    right_on="c_customer_sk")
+        f = f.merge(t["customer_address"], left_on="c_current_addr_sk",
+                    right_on="ca_address_sk")
+        f = f[f.ca_gmt_offset <= -5]
+        it = t["item"]
+        f = f.merge(it[it.i_category == "Jewelry"], left_on="ss_item_sk",
+                    right_on="i_item_sk")
+        if with_promo:
+            p = t["promotion"]
+            p = p[(p.p_channel_dmail == "Y") | (p.p_channel_email == "Y")
+                  | (p.p_channel_tv == "Y")]
+            f = f.merge(p, left_on="ss_promo_sk", right_on="p_promo_sk")
+        return f.ss_ext_sales_price.sum()
+
+    promo = revenue(True)
+    total = revenue(False)
+    share = (float(promo) / float(total) * 100) if total else np.nan
+    return pd.DataFrame({"promotions": [promo], "total": [total],
+                         "share": [share]})
+
+
+def q69(t):
+    c = t["customer"].merge(
+        t["customer_address"], left_on="c_current_addr_sk",
+        right_on="ca_address_sk",
+    )
+    c = c[c.ca_state.isin(["KY", "GA", "NM", "CA", "TX", "OH"])]
+    c = c.merge(t["customer_demographics"], left_on="c_current_cdemo_sk",
+                right_on="cd_demo_sk")
+
+    def buyers(fact, prefix, cust_col):
+        f = t[fact].merge(t["date_dim"], left_on=f"{prefix}_sold_date_sk",
+                          right_on="d_date_sk")
+        return set(f[f.d_year == 2001][cust_col].dropna())
+
+    ss = buyers("store_sales", "ss", "ss_customer_sk")
+    ws = buyers("web_sales", "ws", "ws_bill_customer_sk")
+    cs = buyers("catalog_sales", "cs", "cs_bill_customer_sk")
+    c = c[c.c_customer_sk.isin(ss - ws - cs)]
+    g = c.groupby(["cd_gender", "cd_marital_status", "cd_education_status",
+                   "cd_purchase_estimate"], as_index=False).size()
+    g["cnt1"] = g["size"]
+    g["cnt2"] = g["size"]
+    g = g[["cd_gender", "cd_marital_status", "cd_education_status", "cnt1",
+           "cd_purchase_estimate", "cnt2"]]
+    return _srt(g, ["cd_gender", "cd_marital_status", "cd_education_status",
+                    "cd_purchase_estimate"]).head(100)
+
 ORACLES = {
     name: globals()[name]
     for name in ["q1", "q3", "q7", "q12", "q13", "q15", "q16", "q17", "q19",
-                 "q20", "q21", "q22", "q25", "q26", "q29", "q32", "q33",
-                 "q34", "q36", "q37", "q38", "q42", "q43", "q45", "q46", "q48",
-                 "q52", "q53", "q55", "q56", "q60", "q62", "q65", "q68",
-                 "q71", "q73", "q76", "q79", "q85", "q86", "q87", "q88", "q89",
+                 "q20", "q21", "q22", "q25", "q26", "q28", "q29", "q30", "q32", "q33",
+                 "q34", "q36", "q37", "q38", "q42", "q43", "q45", "q46", "q48", "q50",
+                 "q52", "q53", "q55", "q56", "q60", "q61", "q62", "q65", "q68", "q69",
+                 "q71", "q73", "q76", "q79", "q81", "q85", "q86", "q87", "q88", "q89",
                  "q90", "q91", "q92", "q93", "q94", "q96", "q98", "q99"]
 }
