@@ -114,16 +114,22 @@ def _narrowest(arr):
     return arr
 
 
-def put_table(table, arrays, dev, tile: int = 1):
-    """Host columnar arrays -> canonical device Batch, minimal transfer.
+def put_table(table, arrays, dev, tile: int = 1, narrow: bool = False):
+    """Host columnar arrays -> device Batch, minimal transfer.
 
     Values cross the tunnel in the narrowest integer dtype that holds
-    them; a single on-device jit widens to the canonical physical dtype
-    and materializes the validity/live masks (all-true for generated
-    TPC-H data — never transferred). 2-D BYTES columns ship as-is.
-    ``tile`` repeats the rows that many times (the resident-batch
-    benchmark's amortization trick) — tiles are written directly into
-    the padded buffer, no transient tiled copy.
+    them; by default a single on-device jit widens to the canonical
+    physical dtype and materializes the validity/live masks (all-true
+    for generated TPC-H data — never transferred). 2-D BYTES columns
+    ship as-is. ``tile`` repeats the rows that many times (the
+    resident-batch benchmark's amortization trick) — tiles are written
+    directly into the padded buffer, no transient tiled copy.
+
+    ``narrow=True`` keeps the wire dtypes as the RESIDENT storage: the
+    fused kernels widen per-use inside their single pass (XLA fuses the
+    casts), so HBM reads stay narrow — measured ~10% on Q1 (notes/
+    PERF.md §6). The engine's scan path materializes canonical dtypes;
+    the narrow number is the kernel's rate under narrow storage.
     """
     import jax
     import jax.numpy as jnp
@@ -154,6 +160,13 @@ def put_table(table, arrays, dev, tile: int = 1):
         }
         return Batch(cols, live)
 
+    if narrow:
+        live = jax.jit(lambda: jnp.arange(cap, dtype=jnp.int32) < n)()
+        batch = Batch(
+            {c: Column(w, live, types[c], dicts.get(c)) for c, w in wire.items()},
+            live,
+        )
+        return batch, n
     batch = jax.jit(widen)(wire)
     jax.block_until_ready(batch)
     return batch, n
@@ -355,24 +368,49 @@ def bench_q1_resident(li_arrays, n1, dev, factor: int = 10):
     fixed shapes, no data-dependent control flow, the same per-row
     masked segment-sum work, the same 6-group key distribution — while
     moving host-side generation out of the driver's wall-clock budget
-    (SF10 generation alone costs ~50 s of the 150 s budget). Validation
-    is exact: the result must equal ``factor`` x the independently
-    recomputed SF1 integer sums.
+    (SF10 generation alone costs ~50 s of the 150 s budget).
+
+    ONE transfer, TWO timings: the wire arrays land once in their
+    narrow dtypes; the narrow-storage rate times the kernel directly on
+    them (the fused pass widens per-use — HBM reads stay narrow), then
+    the canonical rate times it on an on-device widened copy (what the
+    engine's scan materializes today). Validation is exact for both:
+    results must equal ``factor`` x the independently recomputed SF1
+    integer sums.
+
+    Returns ``(canonical_rows_per_sec, narrow_rows_per_sec)``.
     """
     import jax
+    import jax.numpy as jnp
     import numpy as np
 
+    from presto_tpu.batch import Batch, Column
+    from presto_tpu.connectors.tpch import schema as S
     from presto_tpu.workloads import Q1_COLS, q1_fused_step
 
     arrays = {c: li_arrays[c] for c in Q1_COLS}
-    batch, n = put_table("lineitem", arrays, dev, tile=factor)
+    batch_narrow, n = put_table("lineitem", arrays, dev, tile=factor,
+                                narrow=True)
     step = jax.jit(q1_fused_step)
-    secs, state = _time_dispatches(step, batch)
-    got = {k: np.asarray(v) for k, v in state.items()}
-    assert not bool(got["value_overflow"])
+    secs_n, state_n = _time_dispatches(step, batch_narrow)
+
+    types = S.TABLES["lineitem"]
+
+    @jax.jit
+    def widen(b: Batch):
+        cols = {
+            c: Column(col.data.astype(types[c].jnp_dtype), col.valid,
+                      col.dtype, col.dictionary)
+            for c, col in b.columns.items()
+        }
+        return Batch(cols, b.live)
+
+    batch_wide = widen(batch_narrow)
+    jax.block_until_ready(batch_wide)
+    secs_w, state_w = _time_dispatches(step, batch_wide)
 
     # independent numpy recomputation over SF1 (int64-exact, no pandas);
-    # the tiled result must be exactly factor x these sums
+    # both results must be exactly factor x these sums
     m = arrays["l_shipdate"] <= 10471  # date '1998-09-02'
     gid = (arrays["l_returnflag"].astype(np.int64) * 2
            + arrays["l_linestatus"].astype(np.int64))[m]
@@ -387,14 +425,22 @@ def bench_q1_resident(li_arrays, n1, dev, factor: int = 10):
         np.add.at(out, gid, v)
         return out
 
-    np.testing.assert_array_equal(got["sum_qty"], factor * seg(qty))
-    np.testing.assert_array_equal(got["sum_base_price"], factor * seg(ep))
-    np.testing.assert_array_equal(got["sum_disc_price"], factor * seg(dp))
-    np.testing.assert_array_equal(got["sum_charge"], factor * seg(ch))
-    np.testing.assert_array_equal(
-        got["count_order"], factor * np.bincount(gid, minlength=6)
-    )
-    return n / secs
+    for tag, state in (("narrow", state_n), ("canonical", state_w)):
+        got = {k: np.asarray(v) for k, v in state.items()}
+        assert not bool(got["value_overflow"]), f"resident {tag}: value_bits"
+        np.testing.assert_array_equal(got["sum_qty"], factor * seg(qty),
+                                      err_msg=f"resident {tag}")
+        np.testing.assert_array_equal(got["sum_base_price"], factor * seg(ep),
+                                      err_msg=f"resident {tag}")
+        np.testing.assert_array_equal(got["sum_disc_price"], factor * seg(dp),
+                                      err_msg=f"resident {tag}")
+        np.testing.assert_array_equal(got["sum_charge"], factor * seg(ch),
+                                      err_msg=f"resident {tag}")
+        np.testing.assert_array_equal(
+            got["count_order"], factor * np.bincount(gid, minlength=6),
+            err_msg=f"resident {tag}",
+        )
+    return n / secs_w, n / secs_n
 
 
 def bench_q1_streaming(sf: float, dev, split_units: int = 1 << 22):
@@ -539,11 +585,14 @@ def main() -> None:
                 #    probe, 3) the alternative probe kernels, 4) shuffle.
                 if _remaining() > 45:
                     # device-resident 10x batch (tiled SF1, ~60M rows):
-                    # the dispatch-floor-amortized per-chip number
-                    _phase("extras: resident 10x Q1")
-                    key = f"tpch_q1_rows_per_sec_per_chip_sf{sf:g}x10_resident"
-                    extra[key] = round(bench_q1_resident(li_arrays, n_li, dev))
-                if _remaining() > 60:
+                    # the dispatch-floor-amortized per-chip numbers,
+                    # canonical + narrow storage from ONE transfer
+                    _phase("extras: resident 10x Q1 (canonical + narrow)")
+                    wide_r, narrow_r = bench_q1_resident(li_arrays, n_li, dev)
+                    base = f"tpch_q1_rows_per_sec_per_chip_sf{sf:g}x10_resident"
+                    extra[base] = round(wide_r)
+                    extra[base + "_narrow"] = round(narrow_r)
+                if _remaining() > 45:
                     # orders generation/decode is extras-only work: it
                     # stays inside the guard so it can never starve Q1
                     _phase("extras: orders generate/transfer")
