@@ -1182,3 +1182,138 @@ order by c_customer_id, ctr_total_return
 limit 100
 """,
 })
+
+# -- round-3 breadth batch 5. Adaptations: q59 joins its two half-year
+# derived tables on the store surrogate key (wide-BYTES join keys are
+# not join-packable); q6's HAVING threshold is 1 at toy SF.
+
+QUERIES.update({
+    # q6: states whose customers buy premium-priced items
+    "q6": """
+select a.ca_state as state, count(*) cnt
+from customer_address a, customer c, store_sales s, date_dim d, item i
+where a.ca_address_sk = c.c_current_addr_sk
+  and c.c_customer_sk = s.ss_customer_sk
+  and s.ss_sold_date_sk = d.d_date_sk
+  and s.ss_item_sk = i.i_item_sk
+  and d.d_month_seq = (select distinct d_month_seq from date_dim
+                       where d_year = 2001 and d_moy = 1)
+  and i.i_current_price > (select 1.2 * avg(j.i_current_price)
+                           from item j
+                           where j.i_category = i.i_category)
+group by a.ca_state
+having count(*) >= 1
+order by cnt, a.ca_state
+limit 100
+""",
+    # q9: five quantity-band spend profiles via CASE'd scalar subqueries
+    "q9": """
+select case when (select count(*) from store_sales
+                  where ss_quantity between 1 and 20) > 1000
+            then (select avg(ss_ext_discount_amt) from store_sales
+                  where ss_quantity between 1 and 20)
+            else (select avg(ss_net_paid) from store_sales
+                  where ss_quantity between 1 and 20) end bucket1,
+       case when (select count(*) from store_sales
+                  where ss_quantity between 21 and 40) > 1000
+            then (select avg(ss_ext_discount_amt) from store_sales
+                  where ss_quantity between 21 and 40)
+            else (select avg(ss_net_paid) from store_sales
+                  where ss_quantity between 21 and 40) end bucket2,
+       case when (select count(*) from store_sales
+                  where ss_quantity between 41 and 60) > 1000
+            then (select avg(ss_ext_discount_amt) from store_sales
+                  where ss_quantity between 41 and 60)
+            else (select avg(ss_net_paid) from store_sales
+                  where ss_quantity between 41 and 60) end bucket3,
+       case when (select count(*) from store_sales
+                  where ss_quantity between 61 and 80) > 1000
+            then (select avg(ss_ext_discount_amt) from store_sales
+                  where ss_quantity between 61 and 80)
+            else (select avg(ss_net_paid) from store_sales
+                  where ss_quantity between 61 and 80) end bucket4,
+       case when (select count(*) from store_sales
+                  where ss_quantity between 81 and 100) > 1000
+            then (select avg(ss_ext_discount_amt) from store_sales
+                  where ss_quantity between 81 and 100)
+            else (select avg(ss_net_paid) from store_sales
+                  where ss_quantity between 81 and 100) end bucket5
+from reason
+where r_reason_sk = 1
+""",
+    # q59: week-over-week store revenue ratios, one year apart
+    "q59": """
+with wss as
+ (select d_week_seq, ss_store_sk,
+         sum(case when d_day_name = 'Sunday' then ss_sales_price end) sun_sales,
+         sum(case when d_day_name = 'Monday' then ss_sales_price end) mon_sales,
+         sum(case when d_day_name = 'Friday' then ss_sales_price end) fri_sales,
+         sum(case when d_day_name = 'Saturday' then ss_sales_price end) sat_sales
+  from store_sales, date_dim
+  where d_date_sk = ss_sold_date_sk
+  group by d_week_seq, ss_store_sk)
+select y.s_store_name1, y.d_week_seq1,
+       y.sun_sales1 / x.sun_sales2 as sun_r,
+       y.mon_sales1 / x.mon_sales2 as mon_r,
+       y.fri_sales1 / x.fri_sales2 as fri_r,
+       y.sat_sales1 / x.sat_sales2 as sat_r
+from (select s_store_name s_store_name1, wss.d_week_seq d_week_seq1,
+             ss_store_sk store_sk1, sun_sales sun_sales1,
+             mon_sales mon_sales1, fri_sales fri_sales1,
+             sat_sales sat_sales1
+      from wss, store, date_dim d
+      where d.d_week_seq = wss.d_week_seq and ss_store_sk = s_store_sk
+        and d_month_seq between 1200 and 1211) y,
+     (select s_store_name s_store_name2, wss.d_week_seq d_week_seq2,
+             ss_store_sk store_sk2, sun_sales sun_sales2,
+             mon_sales mon_sales2, fri_sales fri_sales2,
+             sat_sales sat_sales2
+      from wss, store, date_dim d
+      where d.d_week_seq = wss.d_week_seq and ss_store_sk = s_store_sk
+        and d_month_seq between 1212 and 1223) x
+where y.store_sk1 = x.store_sk2
+  and y.d_week_seq1 = x.d_week_seq2 - 52
+order by y.s_store_name1, y.d_week_seq1
+limit 100
+""",
+    # q63: q53's manager-group twin
+    "q63": """
+select * from (
+  select i_manager_id,
+         sum(ss_sales_price) as sum_sales,
+         avg(sum(ss_sales_price))
+           over (partition by i_manager_id) as avg_monthly_sales
+  from item, store_sales, date_dim, store
+  where ss_item_sk = i_item_sk
+    and ss_sold_date_sk = d_date_sk
+    and ss_store_sk = s_store_sk
+    and d_month_seq in (1200, 1201, 1202, 1203, 1204, 1205,
+                        1206, 1207, 1208, 1209, 1210, 1211)
+    and ((i_category in ('Books', 'Children', 'Electronics')
+          and i_class in ('books-accent', 'children-accent',
+                          'electronics-accent'))
+      or (i_category in ('Women', 'Music', 'Men')
+          and i_class in ('women-pants', 'music-pants', 'men-pants')))
+  group by i_manager_id, d_moy
+) tmp1
+where case when avg_monthly_sales > 0
+           then abs(sum_sales - avg_monthly_sales) / avg_monthly_sales
+           else 0.0 end > 0.1
+order by i_manager_id, avg_monthly_sales, sum_sales
+limit 100
+""",
+    # q82: q37's store twin
+    "q82": """
+select i_item_id, i_item_desc, i_current_price
+from item, inventory, date_dim, store_sales
+where i_current_price between 20.00 and 70.00
+  and inv_item_sk = i_item_sk and d_date_sk = inv_date_sk
+  and d_date between date '2000-05-25' and date '2000-07-24'
+  and i_manufact_id <= 400
+  and inv_quantity_on_hand between 100 and 500
+  and ss_item_sk = i_item_sk
+group by i_item_id, i_item_desc, i_current_price
+order by i_item_id
+limit 100
+""",
+})

@@ -1255,12 +1255,129 @@ def q69(t):
     return _srt(g, ["cd_gender", "cd_marital_status", "cd_education_status",
                     "cd_purchase_estimate"]).head(100)
 
+
+# -- round-3 breadth (batch 5)
+
+
+def q6(t):
+    dd = t["date_dim"]
+    mseq = dd[(dd.d_year == 2001) & (dd.d_moy == 1)].d_month_seq.unique()
+    assert len(mseq) == 1
+    it = t["item"]
+    cat_avg = it.groupby("i_category")["i_current_price"].mean().rename(
+        "cat_avg"
+    ).reset_index()
+    it = it.merge(cat_avg, on="i_category")
+    it = it[it.i_current_price > 1.2 * it.cat_avg]
+    j = t["store_sales"].merge(dd[dd.d_month_seq == mseq[0]],
+                               left_on="ss_sold_date_sk",
+                               right_on="d_date_sk")
+    j = j.merge(it, left_on="ss_item_sk", right_on="i_item_sk")
+    j = j.merge(t["customer"], left_on="ss_customer_sk",
+                right_on="c_customer_sk")
+    j = j.merge(t["customer_address"], left_on="c_current_addr_sk",
+                right_on="ca_address_sk")
+    g = j.groupby("ca_state", dropna=False, as_index=False).size().rename(
+        columns={"size": "cnt", "ca_state": "state"}
+    )
+    g = g[g.cnt >= 1]
+    return _srt(g[["state", "cnt"]], ["cnt", "state"]).head(100)
+
+
+def q9(t):
+    ss = t["store_sales"]
+    out = {}
+    for i, (lo, hi) in enumerate(
+        [(1, 20), (21, 40), (41, 60), (61, 80), (81, 100)], 1
+    ):
+        f = ss[ss.ss_quantity.between(lo, hi)]
+        v = (f.ss_ext_discount_amt.mean() if len(f) > 1000
+             else f.ss_net_paid.mean())
+        out[f"bucket{i}"] = [v]
+    return pd.DataFrame(out)
+
+
+def q59(t):
+    j = t["store_sales"].merge(t["date_dim"], left_on="ss_sold_date_sk",
+                               right_on="d_date_sk")
+    days = [("Sunday", "sun"), ("Monday", "mon"), ("Friday", "fri"),
+            ("Saturday", "sat")]
+    for d, tag in days:
+        j[f"{tag}_sales"] = j.ss_sales_price.where(j.d_day_name == d)
+    wss = j.groupby(["d_week_seq", "ss_store_sk"], as_index=False)[
+        [f"{tag}_sales" for _, tag in days]
+    ].sum(min_count=1)
+    # the SQL joins every date_dim DAY row of the week (multiplicity
+    # up to 7, split across month boundaries) - mirror it exactly
+    dd = t["date_dim"][["d_week_seq", "d_month_seq"]]
+    wss = wss.merge(dd, on="d_week_seq")
+    wss = wss.merge(t["store"], left_on="ss_store_sk", right_on="s_store_sk")
+    y = wss[wss.d_month_seq.between(1200, 1211)]
+    x = wss[wss.d_month_seq.between(1212, 1223)]
+    m = y.merge(x, left_on=["ss_store_sk"], right_on=["ss_store_sk"],
+                suffixes=("1", "2"))
+    m = m[m.d_week_seq1 == m.d_week_seq2 - 52]
+    out = pd.DataFrame({
+        "s_store_name1": m.s_store_name1,
+        "d_week_seq1": m.d_week_seq1,
+        "sun_r": m.sun_sales1 / m.sun_sales2,
+        "mon_r": m.mon_sales1 / m.mon_sales2,
+        "fri_r": m.fri_sales1 / m.fri_sales2,
+        "sat_r": m.sat_sales1 / m.sat_sales2,
+    })
+    return _srt(out, ["s_store_name1", "d_week_seq1"]).head(100)
+
+
+def q63(t):
+    j = t["store_sales"].merge(t["date_dim"], left_on="ss_sold_date_sk",
+                               right_on="d_date_sk")
+    j = j[j.d_month_seq.between(1200, 1211)]
+    j = j.merge(t["store"], left_on="ss_store_sk", right_on="s_store_sk")
+    it = t["item"]
+    sel = (
+        (it.i_category.isin(["Books", "Children", "Electronics"])
+         & it.i_class.isin(["books-accent", "children-accent",
+                            "electronics-accent"]))
+        | (it.i_category.isin(["Women", "Music", "Men"])
+           & it.i_class.isin(["women-pants", "music-pants", "men-pants"]))
+    )
+    j = j.merge(it[sel], left_on="ss_item_sk", right_on="i_item_sk")
+    g = j.groupby(["i_manager_id", "d_moy"], as_index=False).agg(
+        sum_sales=("ss_sales_price", "sum")
+    )
+    g["avg_monthly_sales"] = g.groupby("i_manager_id")[
+        "sum_sales"
+    ].transform("mean")
+    g = g[np.where(
+        g.avg_monthly_sales > 0,
+        np.abs(g.sum_sales - g.avg_monthly_sales) / g.avg_monthly_sales,
+        0.0,
+    ) > 0.1]
+    out = g[["i_manager_id", "sum_sales", "avg_monthly_sales"]]
+    return _srt(out, ["i_manager_id", "avg_monthly_sales", "sum_sales"]).head(100)
+
+
+def q82(t):
+    it = t["item"]
+    it = it[it.i_current_price.between(20.0, 70.0) & (it.i_manufact_id <= 400)]
+    j = it.merge(t["inventory"], left_on="i_item_sk", right_on="inv_item_sk")
+    j = j.merge(t["date_dim"], left_on="inv_date_sk", right_on="d_date_sk")
+    j = j[(j.d_date >= D("2000-05-25")) & (j.d_date <= D("2000-07-24"))]
+    j = j[pd.to_numeric(j.inv_quantity_on_hand).between(100, 500)]
+    j = j.merge(t["store_sales"][["ss_item_sk"]], left_on="i_item_sk",
+                right_on="ss_item_sk")
+    g = j.groupby(["i_item_id", "i_item_desc", "i_current_price"],
+                  as_index=False).size()[
+        ["i_item_id", "i_item_desc", "i_current_price"]
+    ]
+    return _srt(g, ["i_item_id"]).head(100)
+
 ORACLES = {
     name: globals()[name]
-    for name in ["q1", "q3", "q7", "q12", "q13", "q15", "q16", "q17", "q19",
+    for name in ["q1", "q3", "q6", "q7", "q9", "q12", "q13", "q15", "q16", "q17", "q19",
                  "q20", "q21", "q22", "q25", "q26", "q28", "q29", "q30", "q32", "q33",
                  "q34", "q36", "q37", "q38", "q42", "q43", "q45", "q46", "q48", "q50",
-                 "q52", "q53", "q55", "q56", "q60", "q61", "q62", "q65", "q68", "q69",
-                 "q71", "q73", "q76", "q79", "q81", "q85", "q86", "q87", "q88", "q89",
+                 "q52", "q53", "q55", "q56", "q59", "q60", "q61", "q62", "q63", "q65", "q68", "q69",
+                 "q71", "q73", "q76", "q79", "q81", "q82", "q85", "q86", "q87", "q88", "q89",
                  "q90", "q91", "q92", "q93", "q94", "q96", "q98", "q99"]
 }
