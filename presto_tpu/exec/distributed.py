@@ -897,7 +897,10 @@ class DistributedExecutor:
     def _local_topn(self, d: DistBatch, keys, n: int) -> DistBatch:
         b = d.batch
         cap_dev = max(b.capacity // self.nworkers, 1)
-        cap_out = batch_capacity(min(n, cap_dev), minimum=16)
+        # never exceed the local shard (a union-shaped input's capacity
+        # need not be a power of two, so the bucket rounding could
+        # otherwise overshoot it)
+        cap_out = min(cap_dev, batch_capacity(min(n, cap_dev), minimum=16))
 
         @partial(
             shard_map, mesh=self.mesh,
@@ -933,7 +936,7 @@ class DistributedExecutor:
 
         b = d.batch
         cap_dev = max(b.capacity // self.nworkers, 1)
-        cap_out = batch_capacity(min(n, cap_dev), minimum=16)
+        cap_out = min(cap_dev, batch_capacity(min(n, cap_dev), minimum=16))
 
         @partial(
             shard_map, mesh=self.mesh,
