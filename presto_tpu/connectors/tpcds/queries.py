@@ -1317,3 +1317,132 @@ order by i_item_id
 limit 100
 """,
 })
+
+# -- round-3 breadth batch 6. Adaptations: q31 uses ws_ship_addr_sk
+# (this schema's web address key); q39's cov threshold fits the
+# generator's uniform quantities; q44 drops the null-address baseline
+# arm (this generator's ss_addr_sk is never NULL) and keeps the
+# 0.9 x store-average screen.
+
+QUERIES.update({
+    # q2: web+catalog weekly sales, year-over-year ratios by weekday
+    "q2": """
+with wscs as
+ (select sold_date_sk, sales_price
+  from (select ws_sold_date_sk sold_date_sk, ws_ext_sales_price sales_price
+        from web_sales
+        union all
+        select cs_sold_date_sk, cs_ext_sales_price
+        from catalog_sales) x),
+ wswscs as
+ (select d_week_seq,
+         sum(case when d_day_name = 'Sunday' then sales_price end) sun_sales,
+         sum(case when d_day_name = 'Monday' then sales_price end) mon_sales,
+         sum(case when d_day_name = 'Friday' then sales_price end) fri_sales,
+         sum(case when d_day_name = 'Saturday' then sales_price end) sat_sales
+  from wscs, date_dim
+  where d_date_sk = sold_date_sk
+  group by d_week_seq)
+select y.d_week_seq1,
+       round(y.sun_sales1 / z.sun_sales2, 2) r_sun,
+       round(y.mon_sales1 / z.mon_sales2, 2) r_mon,
+       round(y.fri_sales1 / z.fri_sales2, 2) r_fri,
+       round(y.sat_sales1 / z.sat_sales2, 2) r_sat
+from (select wswscs.d_week_seq d_week_seq1, sun_sales sun_sales1,
+             mon_sales mon_sales1, fri_sales fri_sales1,
+             sat_sales sat_sales1
+      from wswscs, date_dim
+      where date_dim.d_week_seq = wswscs.d_week_seq and d_year = 2000) y,
+     (select wswscs.d_week_seq d_week_seq2, sun_sales sun_sales2,
+             mon_sales mon_sales2, fri_sales fri_sales2,
+             sat_sales sat_sales2
+      from wswscs, date_dim
+      where date_dim.d_week_seq = wswscs.d_week_seq and d_year = 2001) z
+where y.d_week_seq1 = z.d_week_seq2 - 53
+order by y.d_week_seq1
+limit 100
+""",
+    # q31: county quarter-over-quarter growth, web vs store
+    "q31": """
+with ss as
+ (select ca_county, d_qoy, d_year, sum(ss_ext_sales_price) as store_sales
+  from store_sales, date_dim, customer_address
+  where ss_sold_date_sk = d_date_sk and ss_addr_sk = ca_address_sk
+  group by ca_county, d_qoy, d_year),
+ ws as
+ (select ca_county, d_qoy, d_year, sum(ws_ext_sales_price) as web_sales
+  from web_sales, date_dim, customer_address
+  where ws_sold_date_sk = d_date_sk and ws_ship_addr_sk = ca_address_sk
+  group by ca_county, d_qoy, d_year)
+select ss1.ca_county, ss1.d_year,
+       ws2.web_sales / ws1.web_sales web_q1_q2_increase,
+       ss2.store_sales / ss1.store_sales store_q1_q2_increase
+from ss ss1, ss ss2, ws ws1, ws ws2
+where ss1.d_qoy = 1 and ss1.d_year = 2000
+  and ss2.d_qoy = 2 and ss2.d_year = 2000
+  and ws1.d_qoy = 1 and ws1.d_year = 2000
+  and ws2.d_qoy = 2 and ws2.d_year = 2000
+  and ss1.ca_county = ss2.ca_county
+  and ss1.ca_county = ws1.ca_county
+  and ss1.ca_county = ws2.ca_county
+  and case when ws1.web_sales > 0 then ws2.web_sales / ws1.web_sales
+           else null end
+    > case when ss1.store_sales > 0 then ss2.store_sales / ss1.store_sales
+           else null end
+order by ss1.ca_county
+limit 100
+""",
+    # q39: warehouse items with volatile inventory, month over month
+    "q39": """
+with inv as
+ (select w_warehouse_sk, i_item_sk, d_moy, stdev, mean,
+         case mean when 0 then null else stdev / mean end cov
+  from (select w_warehouse_sk, i_item_sk, d_moy,
+               stddev_samp(inv_quantity_on_hand) stdev,
+               avg(inv_quantity_on_hand) mean
+        from inventory, item, warehouse, date_dim
+        where inv_item_sk = i_item_sk
+          and inv_warehouse_sk = w_warehouse_sk
+          and inv_date_sk = d_date_sk and d_year = 2000
+        group by w_warehouse_sk, i_item_sk, d_moy) foo
+  where case mean when 0 then 0.0 else stdev / mean end > 0.5)
+select inv1.w_warehouse_sk wsk1, inv1.i_item_sk isk1, inv1.d_moy moy1,
+       inv1.mean mean1, inv1.cov cov1,
+       inv2.d_moy moy2, inv2.mean mean2, inv2.cov cov2
+from inv inv1, inv inv2
+where inv1.i_item_sk = inv2.i_item_sk
+  and inv1.w_warehouse_sk = inv2.w_warehouse_sk
+  and inv1.d_moy = 1 and inv2.d_moy = 2
+order by wsk1, isk1, moy1, mean1, cov1
+limit 100
+""",
+    # q44: best and worst items of one store, paired by rank
+    "q44": """
+select asceding.rnk, i1.i_product_name best_performing,
+       i2.i_product_name worst_performing
+from (select * from (select item_sk,
+             rank() over (order by rank_col asc) rnk
+      from (select ss_item_sk item_sk, avg(ss_net_profit) rank_col
+            from store_sales where ss_store_sk = 4
+            group by ss_item_sk
+            having avg(ss_net_profit) > 0.9 * (
+              select avg(ss_net_profit) rank_col from store_sales
+              where ss_store_sk = 4 group by ss_store_sk)) v1) v11
+      where rnk < 11) asceding,
+     (select * from (select item_sk,
+             rank() over (order by rank_col desc) rnk
+      from (select ss_item_sk item_sk, avg(ss_net_profit) rank_col
+            from store_sales where ss_store_sk = 4
+            group by ss_item_sk
+            having avg(ss_net_profit) > 0.9 * (
+              select avg(ss_net_profit) rank_col from store_sales
+              where ss_store_sk = 4 group by ss_store_sk)) v2) v21
+      where rnk < 11) descending,
+     item i1, item i2
+where asceding.rnk = descending.rnk
+  and i1.i_item_sk = asceding.item_sk
+  and i2.i_item_sk = descending.item_sk
+order by asceding.rnk
+limit 100
+""",
+})

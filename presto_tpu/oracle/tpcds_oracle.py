@@ -1372,11 +1372,131 @@ def q82(t):
     ]
     return _srt(g, ["i_item_id"]).head(100)
 
+
+# -- round-3 breadth (batch 6)
+
+
+def q2(t):
+    parts = []
+    for fact, prefix in (("web_sales", "ws"), ("catalog_sales", "cs")):
+        f = t[fact]
+        parts.append(pd.DataFrame({
+            "sold_date_sk": f[f"{prefix}_sold_date_sk"],
+            "sales_price": f[f"{prefix}_ext_sales_price"],
+        }))
+    wscs = pd.concat(parts, ignore_index=True)
+    j = wscs.merge(t["date_dim"], left_on="sold_date_sk",
+                   right_on="d_date_sk")
+    for d, tag in (("Sunday", "sun"), ("Monday", "mon"), ("Friday", "fri"),
+                   ("Saturday", "sat")):
+        j[f"{tag}_sales"] = j.sales_price.where(j.d_day_name == d)
+    wswscs = j.groupby("d_week_seq", as_index=False)[
+        ["sun_sales", "mon_sales", "fri_sales", "sat_sales"]
+    ].sum(min_count=1)
+    dd = t["date_dim"][["d_week_seq", "d_year"]]
+    wk = wswscs.merge(dd, on="d_week_seq")  # per-day multiplicity
+    y = wk[wk.d_year == 2000]
+    z = wk[wk.d_year == 2001]
+    m = y.merge(z, how="cross", suffixes=("1", "2"))
+    m = m[m.d_week_seq1 == m.d_week_seq2 - 53]
+    out = pd.DataFrame({
+        "d_week_seq1": m.d_week_seq1,
+        "r_sun": (m.sun_sales1 / m.sun_sales2).round(2),
+        "r_mon": (m.mon_sales1 / m.mon_sales2).round(2),
+        "r_fri": (m.fri_sales1 / m.fri_sales2).round(2),
+        "r_sat": (m.sat_sales1 / m.sat_sales2).round(2),
+    })
+    return _srt(out, ["d_week_seq1"]).head(100)
+
+
+def q31(t):
+    def channel(fact, prefix, addr_col, out_col):
+        f = t[fact].merge(t["date_dim"], left_on=f"{prefix}_sold_date_sk",
+                          right_on="d_date_sk")
+        f = f.merge(t["customer_address"], left_on=addr_col,
+                    right_on="ca_address_sk")
+        return f.groupby(["ca_county", "d_qoy", "d_year"],
+                         as_index=False).agg(
+            **{out_col: (f"{prefix}_ext_sales_price", "sum")}
+        )
+
+    ss = channel("store_sales", "ss", "ss_addr_sk", "store_sales")
+    ws = channel("web_sales", "ws", "ws_ship_addr_sk", "web_sales")
+
+    def pick(g, q, col):
+        f = g[(g.d_qoy == q) & (g.d_year == 2000)]
+        return f[["ca_county", col]].rename(columns={col: f"{col}{q}"})
+
+    m = pick(ss, 1, "store_sales").merge(pick(ss, 2, "store_sales"),
+                                         on="ca_county")
+    m = m.merge(pick(ws, 1, "web_sales"), on="ca_county")
+    m = m.merge(pick(ws, 2, "web_sales"), on="ca_county")
+    web_r = np.where(m.web_sales1 > 0, m.web_sales2 / m.web_sales1, np.nan)
+    store_r = np.where(m.store_sales1 > 0,
+                       m.store_sales2 / m.store_sales1, np.nan)
+    keep = web_r > store_r  # NULL comparisons are false
+    out = pd.DataFrame({
+        "ca_county": m.ca_county[keep], "d_year": 2000,
+        "web_q1_q2_increase": web_r[keep],
+        "store_q1_q2_increase": store_r[keep],
+    })
+    return _srt(out, ["ca_county"]).head(100)
+
+
+def q39(t):
+    j = t["inventory"].merge(t["item"], left_on="inv_item_sk",
+                             right_on="i_item_sk")
+    j = j.merge(t["warehouse"], left_on="inv_warehouse_sk",
+                right_on="w_warehouse_sk")
+    j = j.merge(t["date_dim"], left_on="inv_date_sk", right_on="d_date_sk")
+    j = j[j.d_year == 2000]
+    j = j.assign(q=pd.to_numeric(j.inv_quantity_on_hand))
+    g = j.groupby(["w_warehouse_sk", "i_item_sk", "d_moy"],
+                  as_index=False).agg(stdev=("q", "std"), mean=("q", "mean"))
+    g["cov"] = np.where(g["mean"] == 0, np.nan, g.stdev / g["mean"])
+    g = g[np.where(g["mean"] == 0, 0.0, g.stdev / g["mean"]) > 0.5]
+    a = g[g.d_moy == 1]
+    b = g[g.d_moy == 2]
+    m = a.merge(b, on=["w_warehouse_sk", "i_item_sk"], suffixes=("1", "2"))
+    out = pd.DataFrame({
+        "wsk1": m.w_warehouse_sk, "isk1": m.i_item_sk, "moy1": m.d_moy1,
+        "mean1": m.mean1, "cov1": m.cov1, "moy2": m.d_moy2,
+        "mean2": m.mean2, "cov2": m.cov2,
+    })
+    return _srt(out, ["wsk1", "isk1", "moy1", "mean1", "cov1"]).head(100)
+
+
+def q44(t):
+    ss = t["store_sales"]
+    ss = ss[ss.ss_store_sk == 4]
+    base = ss.ss_net_profit.mean()
+    g = ss.groupby("ss_item_sk", as_index=False).agg(
+        rank_col=("ss_net_profit", "mean")
+    )
+    g = g[g.rank_col > 0.9 * base]
+    g["rnk_asc"] = g.rank_col.rank(method="min", ascending=True).astype(int)
+    g["rnk_desc"] = g.rank_col.rank(method="min", ascending=False).astype(int)
+    a = g[g.rnk_asc < 11][["ss_item_sk", "rnk_asc"]].rename(
+        columns={"rnk_asc": "rnk"}
+    )
+    d = g[g.rnk_desc < 11][["ss_item_sk", "rnk_desc"]].rename(
+        columns={"rnk_desc": "rnk"}
+    )
+    m = a.merge(d, on="rnk", suffixes=("_a", "_d"))
+    it = t["item"][["i_item_sk", "i_product_name"]]
+    m = m.merge(it, left_on="ss_item_sk_a", right_on="i_item_sk")
+    m = m.rename(columns={"i_product_name": "best_performing"})
+    m = m.merge(it, left_on="ss_item_sk_d", right_on="i_item_sk",
+                suffixes=("", "_d"))
+    m = m.rename(columns={"i_product_name": "worst_performing"})
+    out = m[["rnk", "best_performing", "worst_performing"]]
+    return _srt(out, ["rnk"]).head(100)
+
 ORACLES = {
     name: globals()[name]
-    for name in ["q1", "q3", "q6", "q7", "q9", "q12", "q13", "q15", "q16", "q17", "q19",
-                 "q20", "q21", "q22", "q25", "q26", "q28", "q29", "q30", "q32", "q33",
-                 "q34", "q36", "q37", "q38", "q42", "q43", "q45", "q46", "q48", "q50",
+    for name in ["q1", "q2", "q3", "q6", "q7", "q9", "q12", "q13", "q15", "q16", "q17", "q19",
+                 "q20", "q21", "q22", "q25", "q26", "q28", "q29", "q30", "q31", "q32", "q33",
+                 "q34", "q36", "q37", "q38", "q39", "q42", "q43", "q44", "q45", "q46", "q48", "q50",
                  "q52", "q53", "q55", "q56", "q59", "q60", "q61", "q62", "q63", "q65", "q68", "q69",
                  "q71", "q73", "q76", "q79", "q81", "q82", "q85", "q86", "q87", "q88", "q89",
                  "q90", "q91", "q92", "q93", "q94", "q96", "q98", "q99"]
