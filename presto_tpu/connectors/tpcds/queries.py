@@ -1446,3 +1446,60 @@ order by asceding.rnk
 limit 100
 """,
 })
+
+# -- q47/q57: year-over-year monthly screens, written with lag/lead
+# over the grouped window (the standard rewrite of the official rn
+# self-joins - identical semantics, one window pass).
+
+QUERIES.update({
+    # q47: store monthly outliers vs the year's average, with neighbors
+    "q47": """
+select * from (
+  select i_category, i_brand, s_store_name, d_year, d_moy,
+         sum(ss_sales_price) sum_sales,
+         avg(sum(ss_sales_price)) over (partition by i_category, i_brand,
+                                        s_store_name, d_year)
+           avg_monthly_sales,
+         lag(sum(ss_sales_price)) over (partition by i_category, i_brand,
+                                        s_store_name
+                                        order by d_year, d_moy) psum,
+         lead(sum(ss_sales_price)) over (partition by i_category, i_brand,
+                                         s_store_name
+                                         order by d_year, d_moy) nsum
+  from item, store_sales, date_dim, store
+  where ss_item_sk = i_item_sk and ss_sold_date_sk = d_date_sk
+    and ss_store_sk = s_store_sk
+    and (d_year = 2000 or (d_year = 1999 and d_moy = 12)
+         or (d_year = 2001 and d_moy = 1))
+  group by i_category, i_brand, s_store_name, d_year, d_moy) v1
+where d_year = 2000 and avg_monthly_sales > 0
+  and abs(sum_sales - avg_monthly_sales) / avg_monthly_sales > 0.1
+order by sum_sales - avg_monthly_sales, i_category, i_brand,
+         s_store_name, d_moy
+limit 100
+""",
+    # q57: q47's catalog twin over call centers
+    "q57": """
+select * from (
+  select i_category, i_brand, cc_name, d_year, d_moy,
+         sum(cs_sales_price) sum_sales,
+         avg(sum(cs_sales_price)) over (partition by i_category, i_brand,
+                                        cc_name, d_year) avg_monthly_sales,
+         lag(sum(cs_sales_price)) over (partition by i_category, i_brand,
+                                        cc_name
+                                        order by d_year, d_moy) psum,
+         lead(sum(cs_sales_price)) over (partition by i_category, i_brand,
+                                         cc_name
+                                         order by d_year, d_moy) nsum
+  from item, catalog_sales, date_dim, call_center
+  where cs_item_sk = i_item_sk and cs_sold_date_sk = d_date_sk
+    and cs_call_center_sk = cc_call_center_sk
+    and (d_year = 2000 or (d_year = 1999 and d_moy = 12)
+         or (d_year = 2001 and d_moy = 1))
+  group by i_category, i_brand, cc_name, d_year, d_moy) v1
+where d_year = 2000 and avg_monthly_sales > 0
+  and abs(sum_sales - avg_monthly_sales) / avg_monthly_sales > 0.1
+order by sum_sales - avg_monthly_sales, i_category, i_brand, cc_name, d_moy
+limit 100
+""",
+})

@@ -141,6 +141,8 @@ class AggSpec:
     # passes; 63 is always safe. Only per-batch per-row values see this
     # bound — merge stages aggregate accumulated sums and always use 63.
     value_bits: int = 63
+    #: row offset for the lag/lead window kinds (unused elsewhere)
+    offset: int = 1
 
     @property
     def merge_kind(self) -> str:
@@ -821,7 +823,11 @@ class WindowOperator(CollectingOperator):
         self.frame = frame
         if frame not in ("range", "rows", "full"):
             raise ValueError(f"unsupported window frame {frame!r}")
-        ranked = [f for f in funcs if f.kind in ("row_number", "rank", "dense_rank")]
+        ranked = [
+            f for f in funcs
+            if f.kind in ("row_number", "rank", "dense_rank",
+                          "lag", "lead", "first_value")
+        ]
         if ranked and not self.order_keys:
             raise ValueError(f"{ranked[0].kind}() requires ORDER BY in its window")
         self._step = jax.jit(self._make_step())
@@ -911,7 +917,31 @@ class WindowOperator(CollectingOperator):
             # ---- functions ------------------------------------------
             row_number, rank, dense = rank_values(part_change, peer_change)
             all_valid = jnp.ones(cap, jnp.bool_)
+            idx = jnp.arange(cap)
+            seg_start = None  # offset functions' partition fence, lazy
             for f in self.funcs:
+                if f.kind in ("lag", "lead", "first_value"):
+                    if seg_start is None:
+                        from presto_tpu.ops.window import segment_starts
+
+                        seg_start = segment_starts(part_change)
+                    v = evaluate(f.input, sorted_batch)
+                    cvalid = live & v.valid
+                    if f.kind == "first_value":
+                        src = seg_start
+                        ok = jnp.ones(cap, jnp.bool_)
+                    elif f.kind == "lag":
+                        src = jnp.maximum(idx - f.offset, 0)
+                        ok = (idx - f.offset) >= seg_start
+                    else:  # lead: same segment iff its start matches
+                        src = jnp.minimum(idx + f.offset, cap - 1)
+                        ok = ((idx + f.offset) < cap) & (
+                            seg_start[src] == seg_start
+                        )
+                    data = v.data[src]
+                    valid = ok & cvalid[src] & live
+                    cols[f.name] = Column(data, valid, f.dtype, v.dictionary)
+                    continue
                 if f.kind == "row_number":
                     cols[f.name] = Column(row_number, all_valid, f.dtype)
                     continue
@@ -969,7 +999,7 @@ def window_operator_from_node(node, scalars) -> WindowOperator:
     aggs = [
         AggSpec(f.kind,
                 bind_scalars(f.input, scalars) if f.input is not None else None,
-                f.name, f.dtype)
+                f.name, f.dtype, offset=f.offset)
         for f in node.funcs
     ]
     return WindowOperator(part, keys, aggs, node.frame)

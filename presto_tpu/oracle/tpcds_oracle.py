@@ -1492,12 +1492,48 @@ def q44(t):
     out = m[["rnk", "best_performing", "worst_performing"]]
     return _srt(out, ["rnk"]).head(100)
 
+
+def _q47_like(t, fact, prefix, dim, fkey, pkey, dname, price_col):
+    f = t[fact].merge(t["date_dim"], left_on=f"{prefix}_sold_date_sk",
+                      right_on="d_date_sk")
+    f = f[(f.d_year == 2000) | ((f.d_year == 1999) & (f.d_moy == 12))
+          | ((f.d_year == 2001) & (f.d_moy == 1))]
+    f = f.merge(t[dim], left_on=fkey, right_on=pkey)
+    f = f.merge(t["item"], left_on=f"{prefix}_item_sk", right_on="i_item_sk")
+    keys = ["i_category", "i_brand", dname]
+    g = f.groupby(keys + ["d_year", "d_moy"], as_index=False).agg(
+        sum_sales=(price_col, "sum")
+    )
+    g["avg_monthly_sales"] = g.groupby(keys + ["d_year"])[
+        "sum_sales"
+    ].transform("mean")
+    g = g.sort_values(keys + ["d_year", "d_moy"], kind="stable")
+    g["psum"] = g.groupby(keys)["sum_sales"].shift(1)
+    g["nsum"] = g.groupby(keys)["sum_sales"].shift(-1)
+    g = g[(g.d_year == 2000) & (g.avg_monthly_sales > 0)]
+    g = g[np.abs(g.sum_sales - g.avg_monthly_sales) / g.avg_monthly_sales > 0.1]
+    g["delta"] = g.sum_sales - g.avg_monthly_sales
+    out = _srt(g, ["delta", "i_category", "i_brand", dname, "d_moy"]).head(100)
+    return out[["i_category", "i_brand", dname, "d_year", "d_moy",
+                "sum_sales", "avg_monthly_sales", "psum", "nsum"]]
+
+
+def q47(t):
+    return _q47_like(t, "store_sales", "ss", "store", "ss_store_sk",
+                     "s_store_sk", "s_store_name", "ss_sales_price")
+
+
+def q57(t):
+    return _q47_like(t, "catalog_sales", "cs", "call_center",
+                     "cs_call_center_sk", "cc_call_center_sk", "cc_name",
+                     "cs_sales_price")
+
 ORACLES = {
     name: globals()[name]
     for name in ["q1", "q2", "q3", "q6", "q7", "q9", "q12", "q13", "q15", "q16", "q17", "q19",
                  "q20", "q21", "q22", "q25", "q26", "q28", "q29", "q30", "q31", "q32", "q33",
-                 "q34", "q36", "q37", "q38", "q39", "q42", "q43", "q44", "q45", "q46", "q48", "q50",
-                 "q52", "q53", "q55", "q56", "q59", "q60", "q61", "q62", "q63", "q65", "q68", "q69",
+                 "q34", "q36", "q37", "q38", "q39", "q42", "q43", "q44", "q45", "q46", "q47", "q48", "q50",
+                 "q52", "q53", "q55", "q56", "q57", "q59", "q60", "q61", "q62", "q63", "q65", "q68", "q69",
                  "q71", "q73", "q76", "q79", "q81", "q82", "q85", "q86", "q87", "q88", "q89",
                  "q90", "q91", "q92", "q93", "q94", "q96", "q98", "q99"]
 }

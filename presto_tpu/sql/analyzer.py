@@ -1559,6 +1559,26 @@ class Analyzer:
             if not spec.order_by:
                 raise AnalysisError(f"{w.name}() requires ORDER BY in its window")
             return [AggSpec(w.name, None, nm, BIGINT)], InputRef(BIGINT, nm)
+        if w.name in ("lag", "lead", "first_value"):
+            if not spec.order_by:
+                raise AnalysisError(f"{w.name}() requires ORDER BY in its window")
+            offset = 1
+            if w.name in ("lag", "lead") and len(w.args) == 2:
+                if not isinstance(w.args[1], A.NumberLit):
+                    raise AnalysisError(f"{w.name}() offset must be a literal")
+                try:
+                    offset = int(w.args[1].text)
+                except ValueError:
+                    raise AnalysisError(
+                        f"{w.name}() offset must be an integer literal, "
+                        f"got {w.args[1].text!r}"
+                    ) from None
+            elif len(w.args) != 1:
+                raise AnalysisError(f"{w.name}() takes one argument")
+            arg = self._expr(w.args[0], scope, outer, ctes, scalar_binds,
+                             agg_map, key_map)
+            spec_ = AggSpec(w.name, arg, nm, arg.dtype, offset=offset)
+            return [spec_], InputRef(arg.dtype, nm)
         if w.name == "count":
             if w.is_star or not w.args:
                 return [AggSpec("count_star", None, nm, BIGINT)], InputRef(BIGINT, nm)

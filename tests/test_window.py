@@ -319,3 +319,36 @@ def test_window_distributed_matches_local(env):
         "from nation"
     )
     compare(dist.sql(q), session.sql(q), "window_distributed")
+
+
+def test_lag_lead_first_value(env):
+    session, tables = env
+    import numpy as np
+
+    df = session.sql(
+        "select l_orderkey k, l_linenumber ln, "
+        "lag(l_quantity) over (partition by l_orderkey order by l_linenumber) p1, "
+        "lag(l_quantity, 2) over (partition by l_orderkey order by l_linenumber) p2, "
+        "lead(l_quantity) over (partition by l_orderkey order by l_linenumber) nx, "
+        "first_value(l_quantity) over (partition by l_orderkey order by l_linenumber) fv "
+        "from lineitem order by k, ln limit 300"
+    )
+    li = tables["lineitem"].sort_values(["l_orderkey", "l_linenumber"])
+    g = li.groupby("l_orderkey")["l_quantity"]
+    want = li.assign(p1=g.shift(1), p2=g.shift(2), nx=g.shift(-1),
+                     fv=g.transform("first")).head(300)
+    for c in ("p1", "p2", "nx", "fv"):
+        np.testing.assert_allclose(
+            df[c].astype(float).to_numpy(), want[c].astype(float).to_numpy(),
+            rtol=1e-9, equal_nan=True, err_msg=c,
+        )
+
+
+def test_lag_requires_order_by(env):
+    session, _ = env
+    import pytest
+
+    with pytest.raises(Exception, match="requires ORDER BY"):
+        session.sql(
+            "select lag(l_quantity) over (partition by l_orderkey) x from lineitem"
+        )
