@@ -1503,3 +1503,53 @@ order by sum_sales - avg_monthly_sales, i_category, i_brand, cc_name, d_moy
 limit 100
 """,
 })
+
+# -- q40/q18: multi-key outer join with returns netting; geographic
+# rollup of demographic averages (q18 drops the household cd2 join).
+
+QUERIES.update({
+    # q40: warehouse sales net of returns, before/after a pivot date
+    "q40": """
+select w_state, i_item_id,
+       sum(case when d_date < date '2000-03-11'
+                then cs_sales_price - coalesce(cr_refunded_cash, 0)
+                else 0 end) as sales_before,
+       sum(case when d_date >= date '2000-03-11'
+                then cs_sales_price - coalesce(cr_refunded_cash, 0)
+                else 0 end) as sales_after
+from catalog_sales left outer join catalog_returns
+       on (cs_order_number = cr_order_number and cs_item_sk = cr_item_sk),
+     warehouse, item, date_dim
+where i_current_price between 10.00 and 60.00
+  and i_item_sk = cs_item_sk
+  and cs_warehouse_sk = w_warehouse_sk
+  and cs_sold_date_sk = d_date_sk
+  and d_date between (date '2000-03-11' - interval '30' day)
+                 and (date '2000-03-11' + interval '30' day)
+group by w_state, i_item_id
+order by w_state, i_item_id
+limit 100
+""",
+    # q18: catalog demographic averages over the geography hierarchy
+    "q18": """
+select i_item_id, ca_country, ca_state, ca_county,
+       avg(cast(cs_quantity as double)) agg1,
+       avg(cast(cs_list_price as double)) agg2,
+       avg(cast(cs_coupon_amt as double)) agg3,
+       avg(cast(cs_sales_price as double)) agg4,
+       avg(cast(cs_net_profit as double)) agg5,
+       avg(cast(c_birth_year as double)) agg6,
+       avg(cast(cd_dep_count as double)) agg7
+from catalog_sales, customer_demographics, customer, customer_address,
+     date_dim, item
+where cs_sold_date_sk = d_date_sk and cs_item_sk = i_item_sk
+  and cs_bill_cdemo_sk = cd_demo_sk and cs_bill_customer_sk = c_customer_sk
+  and cd_gender = 'F' and cd_education_status = 'Unknown'
+  and c_current_addr_sk = ca_address_sk
+  and d_year = 2001 and c_birth_month in (1, 2, 6, 8, 9, 12)
+group by rollup(i_item_id, ca_country, ca_state, ca_county)
+order by ca_country nulls last, ca_state nulls last, ca_county nulls last,
+         i_item_id nulls last
+limit 100
+""",
+})

@@ -709,8 +709,9 @@ def evaluate(expr: Expr, batch: Batch) -> Val:
         cap = batch.capacity
         if expr.value is None:
             t = expr.dtype
+            shape = (cap, t.width) if t.kind is TypeKind.BYTES else (cap,)
             return Val(
-                jnp.zeros(cap, dtype=t.jnp_dtype),
+                jnp.zeros(shape, dtype=t.jnp_dtype),
                 jnp.zeros(cap, dtype=jnp.bool_),
                 t,
             )

@@ -1528,11 +1528,83 @@ def q57(t):
                      "cs_call_center_sk", "cc_call_center_sk", "cc_name",
                      "cs_sales_price")
 
+
+def q40(t):
+    j = t["catalog_sales"].merge(
+        t["catalog_returns"][["cr_order_number", "cr_item_sk",
+                              "cr_refunded_cash"]],
+        left_on=["cs_order_number", "cs_item_sk"],
+        right_on=["cr_order_number", "cr_item_sk"], how="left",
+    )
+    it = t["item"]
+    it = it[it.i_current_price.between(10.0, 60.0)]
+    j = j.merge(it, left_on="cs_item_sk", right_on="i_item_sk")
+    j = j.merge(t["warehouse"], left_on="cs_warehouse_sk",
+                right_on="w_warehouse_sk")
+    j = j.merge(t["date_dim"], left_on="cs_sold_date_sk",
+                right_on="d_date_sk")
+    lo = D("2000-03-11") - np.timedelta64(30, "D")
+    hi = D("2000-03-11") + np.timedelta64(30, "D")
+    j = j[(j.d_date >= lo) & (j.d_date <= hi)]
+    net = j.cs_sales_price - j.cr_refunded_cash.fillna(0)
+    pivot = D("2000-03-11")
+    j = j.assign(
+        sales_before=np.where(j.d_date < pivot, net, 0.0),
+        sales_after=np.where(j.d_date >= pivot, net, 0.0),
+    )
+    g = j.groupby(["w_state", "i_item_id"], as_index=False)[
+        ["sales_before", "sales_after"]
+    ].sum()
+    return _srt(g, ["w_state", "i_item_id"]).head(100)
+
+
+def q18(t):
+    cd = t["customer_demographics"]
+    cd = cd[(cd.cd_gender == "F") & (cd.cd_education_status == "Unknown")]
+    c = t["customer"]
+    c = c[c.c_birth_month.isin([1, 2, 6, 8, 9, 12])]
+    j = t["catalog_sales"].merge(t["date_dim"], left_on="cs_sold_date_sk",
+                                 right_on="d_date_sk")
+    j = j[j.d_year == 2001]
+    j = j.merge(t["item"], left_on="cs_item_sk", right_on="i_item_sk")
+    j = j.merge(cd, left_on="cs_bill_cdemo_sk", right_on="cd_demo_sk")
+    j = j.merge(c, left_on="cs_bill_customer_sk", right_on="c_customer_sk")
+    j = j.merge(t["customer_address"], left_on="c_current_addr_sk",
+                right_on="ca_address_sk")
+    aggs = {
+        "agg1": "cs_quantity", "agg2": "cs_list_price",
+        "agg3": "cs_coupon_amt", "agg4": "cs_sales_price",
+        "agg5": "cs_net_profit", "agg6": "c_birth_year",
+        "agg7": "cd_dep_count",
+    }
+    levels = [["i_item_id", "ca_country", "ca_state", "ca_county"],
+              ["i_item_id", "ca_country", "ca_state"],
+              ["i_item_id", "ca_country"], ["i_item_id"], []]
+    parts = []
+    for lv in levels:
+        if lv:
+            g = j.groupby(lv, as_index=False).agg(
+                **{k: (v, "mean") for k, v in aggs.items()}
+            )
+        else:
+            g = pd.DataFrame({k: [j[v].mean()] for k, v in aggs.items()})
+        for col in ["i_item_id", "ca_country", "ca_state", "ca_county"]:
+            if col not in g:
+                g[col] = None
+        parts.append(g[["i_item_id", "ca_country", "ca_state", "ca_county"]
+                       + list(aggs)])
+    u = pd.concat(parts, ignore_index=True)
+    u = u.sort_values("i_item_id", na_position="last", kind="stable")
+    u = u.sort_values("ca_county", na_position="last", kind="stable")
+    u = u.sort_values("ca_state", na_position="last", kind="stable")
+    u = u.sort_values("ca_country", na_position="last", kind="stable")
+    return u.reset_index(drop=True).head(100)
+
 ORACLES = {
     name: globals()[name]
-    for name in ["q1", "q2", "q3", "q6", "q7", "q9", "q12", "q13", "q15", "q16", "q17", "q19",
+    for name in ["q1", "q2", "q3", "q6", "q7", "q9", "q12", "q13", "q15", "q16", "q17", "q18", "q19",
                  "q20", "q21", "q22", "q25", "q26", "q28", "q29", "q30", "q31", "q32", "q33",
-                 "q34", "q36", "q37", "q38", "q39", "q42", "q43", "q44", "q45", "q46", "q47", "q48", "q50",
+                 "q34", "q36", "q37", "q38", "q39", "q40", "q42", "q43", "q44", "q45", "q46", "q47", "q48", "q50",
                  "q52", "q53", "q55", "q56", "q57", "q59", "q60", "q61", "q62", "q63", "q65", "q68", "q69",
                  "q71", "q73", "q76", "q79", "q81", "q82", "q85", "q86", "q87", "q88", "q89",
                  "q90", "q91", "q92", "q93", "q94", "q96", "q98", "q99"]
