@@ -1,4 +1,4 @@
-"""TPC-DS query suite (modeled subset, adapted dialect) — 49 queries.
+"""TPC-DS query suite (modeled subset, adapted dialect) — 70 queries.
 
 Reference parity: the TPC-DS SQL templates shipped with
 ``presto-tpcds`` / run by its query tests [SURVEY §2.2, §4; reference
