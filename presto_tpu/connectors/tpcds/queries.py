@@ -1,4 +1,4 @@
-"""TPC-DS query suite (modeled subset, adapted dialect) — 70 queries.
+"""TPC-DS query suite (modeled subset, adapted dialect) — 71 queries.
 
 Reference parity: the TPC-DS SQL templates shipped with
 ``presto-tpcds`` / run by its query tests [SURVEY §2.2, §4; reference
@@ -1550,6 +1550,89 @@ where cs_sold_date_sk = d_date_sk and cs_item_sk = i_item_sk
 group by rollup(i_item_id, ca_country, ca_state, ca_county)
 order by ca_country nulls last, ca_state nulls last, ca_county nulls last,
          i_item_id nulls last
+limit 100
+""",
+})
+
+# -- q5: per-channel sales vs returns report over a sales+returns
+# union, rolled up. Adaptations: integer channel tags (no string
+# concat); catalog ids are call centers (no catalog_page table here);
+# web returns reach their site through the web_sales join.
+
+QUERIES.update({
+    "q5": """
+with ssr as (
+  select s_store_sk as id, sum(sales_price) as sales,
+         sum(return_amt) as returns_, sum(profit) as profit,
+         sum(net_loss) as profit_loss
+  from (select ss_store_sk as unit_sk, ss_sold_date_sk as date_sk,
+               ss_ext_sales_price as sales_price, ss_net_profit as profit,
+               cast(0 as decimal(12,2)) as return_amt,
+               cast(0 as decimal(12,2)) as net_loss
+        from store_sales
+        union all
+        select sr_store_sk, sr_returned_date_sk,
+               cast(0 as decimal(12,2)), cast(0 as decimal(12,2)),
+               sr_return_amt, sr_net_loss
+        from store_returns) salesreturns, date_dim, store
+  where date_sk = d_date_sk
+    and d_date between date '2000-08-03'
+                   and (date '2000-08-03' + interval '14' day)
+    and unit_sk = s_store_sk
+  group by s_store_sk),
+ csr as (
+  select cc_call_center_sk as id, sum(sales_price) as sales,
+         sum(return_amt) as returns_, sum(profit) as profit,
+         sum(net_loss) as profit_loss
+  from (select cs_call_center_sk as unit_sk, cs_sold_date_sk as date_sk,
+               cs_ext_sales_price as sales_price, cs_net_profit as profit,
+               cast(0 as decimal(12,2)) as return_amt,
+               cast(0 as decimal(12,2)) as net_loss
+        from catalog_sales
+        union all
+        select cr_call_center_sk, cr_returned_date_sk,
+               cast(0 as decimal(12,2)), cast(0 as decimal(12,2)),
+               cr_return_amount, cr_net_loss
+        from catalog_returns) salesreturns, date_dim, call_center
+  where date_sk = d_date_sk
+    and d_date between date '2000-08-03'
+                   and (date '2000-08-03' + interval '14' day)
+    and unit_sk = cc_call_center_sk
+  group by cc_call_center_sk),
+ wsr as (
+  select web_site_sk as id, sum(sales_price) as sales,
+         sum(return_amt) as returns_, sum(profit) as profit,
+         sum(net_loss) as profit_loss
+  from (select ws_web_site_sk as unit_sk, ws_sold_date_sk as date_sk,
+               ws_ext_sales_price as sales_price, ws_net_profit as profit,
+               cast(0 as decimal(12,2)) as return_amt,
+               cast(0 as decimal(12,2)) as net_loss
+        from web_sales
+        union all
+        select ws_web_site_sk, wr_returned_date_sk,
+               cast(0 as decimal(12,2)), cast(0 as decimal(12,2)),
+               wr_return_amt, wr_net_loss
+        from web_returns, web_sales
+        where wr_item_sk = ws_item_sk
+          and wr_order_number = ws_order_number) salesreturns,
+       date_dim, web_site
+  where date_sk = d_date_sk
+    and d_date between date '2000-08-03'
+                   and (date '2000-08-03' + interval '14' day)
+    and unit_sk = web_site_sk
+  group by web_site_sk)
+select channel, id, sum(sales) sales, sum(returns_) returns_,
+       sum(profit) profit
+from (select 1 as channel, id, sales, returns_,
+             profit - profit_loss as profit from ssr
+      union all
+      select 2 as channel, id, sales, returns_,
+             profit - profit_loss as profit from csr
+      union all
+      select 3 as channel, id, sales, returns_,
+             profit - profit_loss as profit from wsr) x
+group by rollup(channel, id)
+order by channel nulls last, id nulls last
 limit 100
 """,
 })

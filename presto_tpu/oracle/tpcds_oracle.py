@@ -1600,9 +1600,84 @@ def q18(t):
     u = u.sort_values("ca_country", na_position="last", kind="stable")
     return u.reset_index(drop=True).head(100)
 
+
+def q5(t):
+    lo = D("2000-08-03")
+    hi = lo + np.timedelta64(14, "D")
+    dd = t["date_dim"]
+    dd = dd[(dd.d_date >= lo) & (dd.d_date <= hi)][["d_date_sk"]]
+
+    def channel(sales, s_unit, s_date, s_price, s_profit,
+                rets, r_unit, r_date, r_amt, r_loss, dim_keys):
+        s = pd.DataFrame({
+            "unit_sk": sales[s_unit], "date_sk": sales[s_date],
+            "sales_price": sales[s_price], "profit": sales[s_profit],
+            "return_amt": 0.0, "net_loss": 0.0,
+        })
+        r = pd.DataFrame({
+            "unit_sk": rets[r_unit], "date_sk": rets[r_date],
+            "sales_price": 0.0, "profit": 0.0,
+            "return_amt": rets[r_amt], "net_loss": rets[r_loss],
+        })
+        u = pd.concat([s, r], ignore_index=True)
+        u = u.merge(dd, left_on="date_sk", right_on="d_date_sk")
+        u = u[u.unit_sk.isin(dim_keys)]
+        g = u.groupby("unit_sk", as_index=False).agg(
+            sales=("sales_price", "sum"), returns_=("return_amt", "sum"),
+            profit=("profit", "sum"), profit_loss=("net_loss", "sum"),
+        )
+        g["profit"] = g.profit - g.profit_loss
+        return g.rename(columns={"unit_sk": "id"})[
+            ["id", "sales", "returns_", "profit"]
+        ]
+
+    wr = t["web_returns"].merge(
+        t["web_sales"][["ws_item_sk", "ws_order_number", "ws_web_site_sk"]],
+        left_on=["wr_item_sk", "wr_order_number"],
+        right_on=["ws_item_sk", "ws_order_number"],
+    )
+    parts = [
+        channel(t["store_sales"], "ss_store_sk", "ss_sold_date_sk",
+                "ss_ext_sales_price", "ss_net_profit",
+                t["store_returns"], "sr_store_sk", "sr_returned_date_sk",
+                "sr_return_amt", "sr_net_loss",
+                set(t["store"].s_store_sk)).assign(channel=1),
+        channel(t["catalog_sales"], "cs_call_center_sk", "cs_sold_date_sk",
+                "cs_ext_sales_price", "cs_net_profit",
+                t["catalog_returns"], "cr_call_center_sk",
+                "cr_returned_date_sk", "cr_return_amount", "cr_net_loss",
+                set(t["call_center"].cc_call_center_sk)).assign(channel=2),
+        channel(t["web_sales"], "ws_web_site_sk", "ws_sold_date_sk",
+                "ws_ext_sales_price", "ws_net_profit",
+                wr, "ws_web_site_sk", "wr_returned_date_sk",
+                "wr_return_amt", "wr_net_loss",
+                set(t["web_site"].web_site_sk)).assign(channel=3),
+    ]
+    x = pd.concat(parts, ignore_index=True)
+    detail = x.groupby(["channel", "id"], as_index=False)[
+        ["sales", "returns_", "profit"]
+    ].sum()
+    per_ch = x.groupby("channel", as_index=False)[
+        ["sales", "returns_", "profit"]
+    ].sum()
+    per_ch["id"] = None
+    total = pd.DataFrame({
+        "channel": [None], "id": [None], "sales": [x.sales.sum()],
+        "returns_": [x.returns_.sum()], "profit": [x.profit.sum()],
+    })
+    u = pd.concat(
+        [detail, per_ch[["channel", "id", "sales", "returns_", "profit"]],
+         total], ignore_index=True,
+    )
+    u = u.sort_values("id", na_position="last", kind="stable")
+    u = u.sort_values("channel", na_position="last", kind="stable")
+    return u[["channel", "id", "sales", "returns_", "profit"]].reset_index(
+        drop=True
+    ).head(100)
+
 ORACLES = {
     name: globals()[name]
-    for name in ["q1", "q2", "q3", "q6", "q7", "q9", "q12", "q13", "q15", "q16", "q17", "q18", "q19",
+    for name in ["q1", "q2", "q3", "q5", "q6", "q7", "q9", "q12", "q13", "q15", "q16", "q17", "q18", "q19",
                  "q20", "q21", "q22", "q25", "q26", "q28", "q29", "q30", "q31", "q32", "q33",
                  "q34", "q36", "q37", "q38", "q39", "q40", "q42", "q43", "q44", "q45", "q46", "q47", "q48", "q50",
                  "q52", "q53", "q55", "q56", "q57", "q59", "q60", "q61", "q62", "q63", "q65", "q68", "q69",
