@@ -52,13 +52,52 @@ from __future__ import annotations
 import json
 import os
 import signal
+import subprocess
 import sys
+import threading
 import time
 
 BASELINE_ROWS_PER_SEC = 1.9e8  # equal-cost CPU estimate (see docstring)
 
 T0 = time.monotonic()
 BUDGET = float(os.environ.get("PRESTO_TPU_BENCH_BUDGET", "150"))
+
+# The one JSON line the driver parses. Filled incrementally so that the
+# watchdog / fatal-error paths can emit everything measured so far — the
+# round-1..3 lesson: three driver runs produced parsed:null because a
+# hang or exception reached process exit before any line was printed.
+RESULT: dict = {"metric": "tpch_q1_rows_per_sec_per_chip", "value": 0,
+                "unit": "rows/s", "vs_baseline": 0.0}
+_PHASES: list = []
+_EMIT_LOCK = threading.Lock()
+_EMITTED = False
+
+
+def _emit() -> None:
+    """Print RESULT exactly once (normal exit, fatal error, or watchdog).
+
+    The watchdog thread can call this while the main thread is still
+    mutating RESULT's nested ``extra`` dict, so serialization retries on
+    concurrent-mutation errors and falls back to the scalar fields; the
+    emitted flag is only set once a line has actually been printed.
+    """
+    global _EMITTED
+    with _EMIT_LOCK:
+        if _EMITTED:
+            return
+        line = None
+        for _ in range(3):
+            try:
+                line = json.dumps(RESULT)
+                break
+            except RuntimeError:  # dict mutated mid-dump by the other thread
+                time.sleep(0.05)
+        if line is None:
+            snap = {k: RESULT.get(k) for k in
+                    ("metric", "value", "unit", "vs_baseline", "error")}
+            line = json.dumps(snap)
+        print(line, flush=True)
+        _EMITTED = True
 
 
 def _remaining() -> float:
@@ -67,7 +106,79 @@ def _remaining() -> float:
 
 def _phase(name: str) -> None:
     """Elapsed-time breadcrumbs on stderr (the driver parses stdout)."""
+    _PHASES.append(f"+{time.monotonic() - T0:.0f}s {name}")
     print(f"[bench +{time.monotonic() - T0:6.1f}s] {name}", file=sys.stderr)
+
+
+def _watchdog() -> None:
+    """Emit whatever has been measured before the driver's timeout hits.
+
+    The tunnel TPU backend can hang indefinitely inside a C call (no
+    Python signal delivery — notes/PERF.md §1, BENCH_r02 rc:124). A
+    daemon thread is the only reliable escape: shortly before the
+    wall-clock budget expires it prints the (partial) RESULT line and
+    force-exits, so the driver always gets a parseable record.
+    """
+    # clamp the safety margin so tiny smoke budgets still get to run
+    margin = min(12.0, BUDGET * 0.15)
+    delay = BUDGET - margin - (time.monotonic() - T0)
+    if delay > 0:
+        time.sleep(delay)
+    with _EMIT_LOCK:
+        done = _EMITTED
+    if not done:
+        try:
+            RESULT.setdefault(
+                "error",
+                f"watchdog: budget {BUDGET:.0f}s exhausted at phase "
+                f"{_PHASES[-1] if _PHASES else '<start>'}",
+            )
+            RESULT["phases"] = _PHASES[-8:]
+            _emit()
+        finally:
+            os._exit(3)
+
+
+def _acquire_backend() -> None:
+    """Poll the TPU backend in subprocesses until it answers or ~1/3 of
+    the budget is gone (VERDICT r03 item 1: BENCH_r03 died because
+    ``jax.devices()`` was called exactly once while the tunnel was down).
+
+    Probing in a *subprocess* is load-bearing twice over: a hung tunnel
+    blocks inside C (in-process timeouts can't fire), and a failed jax
+    backend init is sticky for the process lifetime (no in-process
+    retry). Each probe pays one backend init (~5-15 s healthy), bounded
+    by its own timeout when not.
+    """
+    if os.environ.get("PRESTO_TPU_BENCH_CPU"):
+        return  # CPU smoke mode: nothing to probe
+    deadline = T0 + BUDGET / 3.0
+    attempt = 0
+    last_err = "no probe ran"
+    while True:
+        attempt += 1
+        # cap each probe at 30 s so a hung first probe can't consume the
+        # whole acquisition deadline (guarantees >=2 attempts at the
+        # default 150 s budget)
+        per_try = max(15.0, min(30.0, deadline - time.monotonic()))
+        try:
+            p = subprocess.run(
+                [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
+                timeout=per_try, capture_output=True, text=True,
+            )
+            if p.returncode == 0 and (p.stdout or "").strip().isdigit():
+                _phase(f"backend probe ok (attempt {attempt})")
+                return
+            last_err = (p.stderr or p.stdout or "").strip()[-200:]
+        except subprocess.TimeoutExpired:
+            last_err = f"probe hung >{per_try:.0f}s (tunnel down?)"
+        if time.monotonic() >= deadline:
+            raise RuntimeError(
+                f"TPU backend unavailable after {attempt} probes over "
+                f"{time.monotonic() - T0:.0f}s: {last_err}"
+            )
+        _phase(f"backend probe {attempt} failed ({last_err[:80]}); retrying")
+        time.sleep(min(10.0, 2.0 * attempt))
 
 
 def _chunk() -> int:
@@ -507,10 +618,31 @@ class _ExtrasTimeout(Exception):
 
 
 def main() -> None:
+    threading.Thread(target=_watchdog, daemon=True).start()
+    try:
+        # argv parsing inside the guard: a malformed argument must still
+        # produce the JSON line
+        sf = float(sys.argv[1]) if len(sys.argv) > 1 else 1.0
+        stream_mode = "--stream" in sys.argv[2:]
+        RESULT["metric"] = (
+            f"tpch_q1_stream_rows_per_sec_sf{sf:g}" if stream_mode
+            else f"tpch_q1_rows_per_sec_per_chip_sf{sf:g}"
+        )
+        _run(sf, stream_mode)
+    except BaseException as e:  # noqa: BLE001 — the line must still print
+        RESULT.setdefault("error", f"{type(e).__name__}: {e}"[:300])
+        RESULT["phases"] = _PHASES[-8:]
+        _emit()
+        raise
+    _emit()
+
+
+def _run(sf: float, stream_mode: bool) -> None:
+    _phase("acquiring backend")
+    _acquire_backend()
+
     import jax
 
-    sf = float(sys.argv[1]) if len(sys.argv) > 1 else 1.0
-    stream_mode = "--stream" in sys.argv[2:]
     # Local smoke runs: PRESTO_TPU_BENCH_CPU=1 pins the CPU backend
     # before any accelerator plugin initializes (the TPU tunnel hangs
     # hard when unhealthy). The driver's real bench run uses the TPU.
@@ -521,22 +653,14 @@ def main() -> None:
     # Force the runtime into synchronous mode NOW (see module docstring):
     # honest timings, device-resident buffers.
     _ = int(jax.device_put(jax.numpy.arange(4), dev).sum())
+    _phase("backend attached; sync mode forced")
 
     if stream_mode:
         # config-2 capability mode: unbounded-SF streaming Q1 (one chip,
-        # bounded memory); prints its own single JSON line
+        # bounded memory)
         rows = bench_q1_streaming(sf, dev)
-        print(
-            json.dumps(
-                {
-                    "metric": f"tpch_q1_stream_rows_per_sec_sf{sf:g}",
-                    "value": round(rows),
-                    "unit": "rows/s",
-                    "vs_baseline": round(rows / BASELINE_ROWS_PER_SEC, 3),
-                }
-            ),
-            flush=True,
-        )
+        RESULT["value"] = round(rows)
+        RESULT["vs_baseline"] = round(rows / BASELINE_ROWS_PER_SEC, 3)
         return
 
     from presto_tpu.connectors.tpch import TpchConnector
@@ -557,12 +681,8 @@ def main() -> None:
     _phase("Q1 compile+time+validate")
     q1_rows = bench_q1(li_batch, n_li, li_df)
     _phase("Q1 done")
-    result = {
-        "metric": f"tpch_q1_rows_per_sec_per_chip_sf{sf:g}",
-        "value": round(q1_rows),
-        "unit": "rows/s",
-        "vs_baseline": round(q1_rows / BASELINE_ROWS_PER_SEC, 3),
-    }
+    RESULT["value"] = round(q1_rows)
+    RESULT["vs_baseline"] = round(q1_rows / BASELINE_ROWS_PER_SEC, 3)
 
     # ---- extras: only while budget remains; SIGALRM backstop -----------
     def _on_alarm(signum, frame):
@@ -570,8 +690,9 @@ def main() -> None:
 
     # Nothing below may prevent the validated primary line from printing:
     # any extras failure (timeout, OOM, validation assert) is recorded in
-    # extra["note"] instead of propagating.
-    extra = {}
+    # extra["note"] instead of propagating. extra lives inside RESULT so
+    # the watchdog's partial emit carries everything measured so far.
+    extra = RESULT.setdefault("extra", {})
     try:
         rem = _remaining()
         if rem > 45:  # Q3 adds two jit compiles + an orders transfer
@@ -622,10 +743,8 @@ def main() -> None:
             extra["note"] = "remaining extras skipped: wall-clock budget exhausted"
     except Exception as e:  # noqa: BLE001 — e.g. alarm raced into finally
         extra.setdefault("note", f"extras failed: {type(e).__name__}")
-    if extra:
-        result["extra"] = extra
-
-    print(json.dumps(result), flush=True)
+    if not extra:
+        del RESULT["extra"]
 
 
 if __name__ == "__main__":
