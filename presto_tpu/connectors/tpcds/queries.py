@@ -1636,3 +1636,961 @@ order by channel nulls last, id nulls last
 limit 100
 """,
 })
+
+QUERIES.update({
+    # q97: store/catalog customer-item overlap via FULL OUTER JOIN of
+    # the two grouped channel CTEs (official literals d_month_seq
+    # 1200-1211 = year 2000, inside this generator's span)
+    "q97": """
+with ssci as (
+  select ss_customer_sk as customer_sk, ss_item_sk as item_sk
+  from store_sales, date_dim
+  where ss_sold_date_sk = d_date_sk
+    and d_month_seq between 1200 and 1211
+  group by ss_customer_sk, ss_item_sk),
+csci as (
+  select cs_bill_customer_sk as customer_sk, cs_item_sk as item_sk
+  from catalog_sales, date_dim
+  where cs_sold_date_sk = d_date_sk
+    and d_month_seq between 1200 and 1211
+  group by cs_bill_customer_sk, cs_item_sk)
+select sum(case when ssci.customer_sk is not null and csci.customer_sk is null
+               then 1 else 0 end) as store_only,
+       sum(case when ssci.customer_sk is null and csci.customer_sk is not null
+               then 1 else 0 end) as catalog_only,
+       sum(case when ssci.customer_sk is not null and csci.customer_sk is not null
+               then 1 else 0 end) as store_and_catalog
+from ssci full outer join csci
+  on ssci.customer_sk = csci.customer_sk and ssci.item_sk = csci.item_sk
+limit 100
+""",
+    # q51: cumulative web-vs-store revenue crossover — windowed running
+    # sums inside the CTEs, FULL OUTER JOIN on (item, date), running max
+    # outside. Adaptation: the outermost `select *` lists its columns.
+    "q51": """
+with web_v1 as (
+  select ws_item_sk as item_sk, d_date,
+         sum(sum(ws_sales_price)) over (partition by ws_item_sk order by d_date
+           rows between unbounded preceding and current row) as cume_sales
+  from web_sales, date_dim
+  where ws_sold_date_sk = d_date_sk
+    and d_month_seq between 1200 and 1211
+    and ws_item_sk is not null
+  group by ws_item_sk, d_date),
+store_v1 as (
+  select ss_item_sk as item_sk, d_date,
+         sum(sum(ss_sales_price)) over (partition by ss_item_sk order by d_date
+           rows between unbounded preceding and current row) as cume_sales
+  from store_sales, date_dim
+  where ss_sold_date_sk = d_date_sk
+    and d_month_seq between 1200 and 1211
+    and ss_item_sk is not null
+  group by ss_item_sk, d_date)
+select item_sk, d_date, web_sales, store_sales, web_cumulative, store_cumulative
+from (select item_sk, d_date, web_sales, store_sales,
+             max(web_sales) over (partition by item_sk order by d_date
+               rows between unbounded preceding and current row) as web_cumulative,
+             max(store_sales) over (partition by item_sk order by d_date
+               rows between unbounded preceding and current row) as store_cumulative
+      from (select case when web.item_sk is not null then web.item_sk
+                        else store.item_sk end as item_sk,
+                   case when web.d_date is not null then web.d_date
+                        else store.d_date end as d_date,
+                   web.cume_sales as web_sales,
+                   store.cume_sales as store_sales
+            from web_v1 web full outer join store_v1 store
+              on web.item_sk = store.item_sk and web.d_date = store.d_date) x) y
+where web_cumulative > store_cumulative
+order by item_sk, d_date
+limit 100
+""",
+})
+
+QUERIES.update({
+    # q27: demographic averages by item/state under ROLLUP with
+    # grouping() (adapted: d_year 2000, the generator's three states)
+    "q27": """
+select i_item_id, s_state, grouping(s_state) as g_state,
+       avg(ss_quantity) as agg1, avg(ss_list_price) as agg2,
+       avg(ss_coupon_amt) as agg3, avg(ss_sales_price) as agg4
+from store_sales, customer_demographics, date_dim, store, item
+where ss_sold_date_sk = d_date_sk
+  and ss_item_sk = i_item_sk
+  and ss_store_sk = s_store_sk
+  and ss_cdemo_sk = cd_demo_sk
+  and cd_gender = 'M'
+  and cd_marital_status = 'S'
+  and cd_education_status = 'College'
+  and d_year = 2000
+  and s_state in ('HI', 'KY', 'LA')
+group by rollup(i_item_id, s_state)
+order by i_item_id nulls last, s_state nulls last
+limit 100
+""",
+    # q70: state/county profit hierarchy — rank within each rollup
+    # level, states pre-filtered by a windowed top-5 subquery
+    "q70": """
+select sum(ss_net_profit) as total_sum, s_state, s_county,
+       grouping(s_state) + grouping(s_county) as lochierarchy,
+       rank() over (
+         partition by grouping(s_state) + grouping(s_county),
+                      case when grouping(s_county) = 0 then s_state end
+         order by sum(ss_net_profit) desc) as rank_within_parent
+from store_sales, date_dim d1, store
+where d1.d_month_seq between 1200 and 1211
+  and d1.d_date_sk = ss_sold_date_sk
+  and s_store_sk = ss_store_sk
+  and s_state in (select s_state
+                  from (select s_state as s_state,
+                               rank() over (partition by s_state
+                                 order by sum(ss_net_profit) desc) as ranking
+                        from store_sales, store, date_dim
+                        where d_month_seq between 1200 and 1211
+                          and d_date_sk = ss_sold_date_sk
+                          and s_store_sk = ss_store_sk
+                        group by s_state) tmp1
+                  where ranking <= 5)
+group by rollup(s_state, s_county)
+order by lochierarchy desc,
+         case when lochierarchy = 0 then s_state end,
+         rank_within_parent, s_state nulls last, s_county nulls last
+limit 100
+""",
+    # q67: top stores per category over an 8-level ROLLUP with rank()
+    "q67": """
+select i_category, i_class, i_brand, i_product_name, d_year, d_qoy,
+       d_moy, s_store_id, sumsales, rk
+from (select i_category, i_class, i_brand, i_product_name, d_year,
+             d_qoy, d_moy, s_store_id,
+             sum(coalesce(ss_sales_price * ss_quantity, 0)) as sumsales,
+             rank() over (partition by i_category
+               order by sum(coalesce(ss_sales_price * ss_quantity, 0)) desc
+             ) as rk
+      from store_sales, date_dim, store, item
+      where ss_sold_date_sk = d_date_sk
+        and ss_item_sk = i_item_sk
+        and ss_store_sk = s_store_sk
+        and d_month_seq between 1200 and 1211
+      group by rollup(i_category, i_class, i_brand, i_product_name,
+                      d_year, d_qoy, d_moy, s_store_id)) dw
+where rk <= 100
+order by i_category nulls last, i_class nulls last, i_brand nulls last,
+         i_product_name nulls last, d_year nulls last, d_qoy nulls last,
+         d_moy nulls last, s_store_id nulls last, sumsales, rk
+limit 100
+""",
+    # q10: demographics of county customers active in stores AND on
+    # web-or-catalog (OR of correlated EXISTS -> mark joins)
+    "q10": """
+select cd_gender, cd_marital_status, cd_education_status, count(*) as cnt1,
+       cd_purchase_estimate, count(*) as cnt2, cd_credit_rating,
+       count(*) as cnt3, cd_dep_count, count(*) as cnt4,
+       cd_dep_employed_count, count(*) as cnt5, cd_dep_college_count,
+       count(*) as cnt6
+from customer c, customer_address ca, customer_demographics
+where c.c_current_addr_sk = ca.ca_address_sk
+  and ca_county in ('Williamson County', 'Huron County', 'Daviess County',
+                    'Maricopa County', 'Ziebach County')
+  and cd_demo_sk = c.c_current_cdemo_sk
+  and exists (select ss_sold_date_sk from store_sales, date_dim
+              where c.c_customer_sk = ss_customer_sk
+                and ss_sold_date_sk = d_date_sk
+                and d_year = 2000 and d_moy between 1 and 4)
+  and (exists (select ws_sold_date_sk from web_sales, date_dim
+               where c.c_customer_sk = ws_bill_customer_sk
+                 and ws_sold_date_sk = d_date_sk
+                 and d_year = 2000 and d_moy between 1 and 4)
+       or exists (select cs_sold_date_sk from catalog_sales, date_dim
+                  where c.c_customer_sk = cs_ship_customer_sk
+                    and cs_sold_date_sk = d_date_sk
+                    and d_year = 2000 and d_moy between 1 and 4))
+group by cd_gender, cd_marital_status, cd_education_status,
+         cd_purchase_estimate, cd_credit_rating, cd_dep_count,
+         cd_dep_employed_count, cd_dep_college_count
+order by cd_gender, cd_marital_status, cd_education_status,
+         cd_purchase_estimate, cd_credit_rating, cd_dep_count,
+         cd_dep_employed_count, cd_dep_college_count
+limit 100
+""",
+    # q35: q10's statewide twin with avg/max/sum dependent stats
+    "q35": """
+select ca_state, cd_gender, cd_marital_status, cd_dep_count,
+       count(*) as cnt1, avg(cd_dep_count) as a1, max(cd_dep_count) as m1,
+       sum(cd_dep_count) as s1, cd_dep_employed_count, count(*) as cnt2,
+       avg(cd_dep_employed_count) as a2, max(cd_dep_employed_count) as m2,
+       sum(cd_dep_employed_count) as s2, cd_dep_college_count,
+       count(*) as cnt3, avg(cd_dep_college_count) as a3,
+       max(cd_dep_college_count) as m3, sum(cd_dep_college_count) as s3
+from customer c, customer_address ca, customer_demographics
+where c.c_current_addr_sk = ca.ca_address_sk
+  and cd_demo_sk = c.c_current_cdemo_sk
+  and exists (select ss_sold_date_sk from store_sales, date_dim
+              where c.c_customer_sk = ss_customer_sk
+                and ss_sold_date_sk = d_date_sk
+                and d_year = 2000 and d_qoy < 4)
+  and (exists (select ws_sold_date_sk from web_sales, date_dim
+               where c.c_customer_sk = ws_bill_customer_sk
+                 and ws_sold_date_sk = d_date_sk
+                 and d_year = 2000 and d_qoy < 4)
+       or exists (select cs_sold_date_sk from catalog_sales, date_dim
+                  where c.c_customer_sk = cs_ship_customer_sk
+                    and cs_sold_date_sk = d_date_sk
+                    and d_year = 2000 and d_qoy < 4))
+group by ca_state, cd_gender, cd_marital_status, cd_dep_count,
+         cd_dep_employed_count, cd_dep_college_count
+order by ca_state nulls last, cd_gender, cd_marital_status, cd_dep_count,
+         cd_dep_employed_count, cd_dep_college_count
+limit 100
+""",
+})
+
+QUERIES.update({
+    # q41: product names of manufacturers with qualifying variants
+    # (adaptations: the shared `i_manufact = i1.i_manufact` correlation
+    # is factored out of the OR branches — algebraically identical; the
+    # branches constrain category+size only — quadruple-constraint
+    # branches are empty at toy SF where each manufact has ~1 item)
+    "q41": """
+select distinct i_product_name
+from item i1
+where i_manufact_id between 600 and 800
+  and (select count(*) as item_cnt
+       from item
+       where i_manufact = i1.i_manufact
+         and ((i_category = 'Home'
+               and (i_size = 'medium' or i_size = 'economy'))
+          or (i_category = 'Electronics'
+              and (i_size = 'petite' or i_size = 'medium'))
+          or (i_category = 'Men'
+              and (i_size = 'medium' or i_size = 'economy'))
+          or (i_category = 'Jewelry'
+              and (i_size = 'petite' or i_size = 'extra large')))) > 0
+order by i_product_name
+limit 100
+""",
+    # q84: customers of one city in an income band with store returns
+    # (adaptations: returns linked via sr_customer_sk — the cdemo link
+    # is empty at toy SF; city/band constants from this generator)
+    "q84": """
+select c_customer_id as customer_id,
+       coalesce(c_last_name, '') || ', ' || coalesce(c_first_name, '')
+         as customername
+from customer, customer_address, customer_demographics,
+     household_demographics, income_band, store_returns
+where ca_city = 'after'
+  and c_current_addr_sk = ca_address_sk
+  and ib_lower_bound >= 30001
+  and ib_upper_bound <= 80000
+  and ib_income_band_sk = hd_income_band_sk
+  and cd_demo_sk = c_current_cdemo_sk
+  and hd_demo_sk = c_current_hdemo_sk
+  and sr_customer_sk = c_customer_sk
+order by c_customer_id
+limit 100
+""",
+    # q8: store profit where the store's zip prefix matches preferred
+    # customers' zips (adaptations: expression join keys are
+    # materialized in derived tables; zip list + having threshold fit
+    # this generator)
+    "q8": """
+select s_store_name, sum(ss_net_profit) as profit
+from store_sales, date_dim,
+     (select s_store_sk, s_store_name, substring(s_zip, 1, 2) as s_zip2
+      from store) s,
+     (select substring(ca_zip5, 1, 2) as ca_zip2
+      from ((select substring(ca_zip, 1, 5) as ca_zip5 from customer_address
+             where substring(ca_zip, 1, 5) in
+               ('50183', '00355', '50970', '22225', '00565', '50602',
+                '22614', '68502', '45287', '98313'))
+            intersect
+            (select ca_zip5
+             from (select substring(ca_zip, 1, 5) as ca_zip5,
+                          count(*) as cnt
+                   from customer_address, customer
+                   where ca_address_sk = c_current_addr_sk
+                     and c_preferred_cust_flag = 'Y'
+                   group by substring(ca_zip, 1, 5)
+                   having count(*) > 1) a1)) a2) v1
+where ss_store_sk = s_store_sk
+  and ss_sold_date_sk = d_date_sk
+  and d_qoy = 2 and d_year = 2000
+  and s_zip2 = ca_zip2
+group by s_store_name
+order by s_store_name
+limit 100
+""",
+    # q83: returned-quantity share per channel for three chosen weeks
+    "q83": """
+with sr_items as (
+  select i_item_id as item_id, sum(sr_return_quantity) as sr_item_qty
+  from store_returns, item, date_dim
+  where sr_item_sk = i_item_sk
+    and d_date in (select d_date from date_dim
+                   where d_week_seq in (select d_week_seq from date_dim
+                                        where d_date in (date '2000-04-22',
+                                                         date '2000-07-01',
+                                                         date '2000-10-21')))
+    and sr_returned_date_sk = d_date_sk
+  group by i_item_id),
+cr_items as (
+  select i_item_id as item_id, sum(cr_return_quantity) as cr_item_qty
+  from catalog_returns, item, date_dim
+  where cr_item_sk = i_item_sk
+    and d_date in (select d_date from date_dim
+                   where d_week_seq in (select d_week_seq from date_dim
+                                        where d_date in (date '2000-04-22',
+                                                         date '2000-07-01',
+                                                         date '2000-10-21')))
+    and cr_returned_date_sk = d_date_sk
+  group by i_item_id),
+wr_items as (
+  select i_item_id as item_id, sum(wr_return_quantity) as wr_item_qty
+  from web_returns, item, date_dim
+  where wr_item_sk = i_item_sk
+    and d_date in (select d_date from date_dim
+                   where d_week_seq in (select d_week_seq from date_dim
+                                        where d_date in (date '2000-04-22',
+                                                         date '2000-07-01',
+                                                         date '2000-10-21')))
+    and wr_returned_date_sk = d_date_sk
+  group by i_item_id)
+select sr_items.item_id, sr_item_qty,
+       sr_item_qty / (sr_item_qty + cr_item_qty + wr_item_qty) / 3.0 * 100
+         as sr_dev,
+       cr_item_qty,
+       cr_item_qty / (sr_item_qty + cr_item_qty + wr_item_qty) / 3.0 * 100
+         as cr_dev,
+       wr_item_qty,
+       wr_item_qty / (sr_item_qty + cr_item_qty + wr_item_qty) / 3.0 * 100
+         as wr_dev,
+       (sr_item_qty + cr_item_qty + wr_item_qty) / 3.0 as average
+from sr_items, cr_items, wr_items
+where sr_items.item_id = cr_items.item_id
+  and sr_items.item_id = wr_items.item_id
+order by sr_items.item_id, sr_item_qty
+limit 100
+""",
+    # q58: items with balanced revenue across all three channels in one
+    # week (scalar subquery inside the date IN-subquery; adaptation:
+    # the official +-10% balance band widens to [0.1x, 10x] — weekly
+    # per-item channel revenues at toy SF differ by ~6x median)
+    "q58": """
+with ss_items as (
+  select i_item_id as item_id, sum(ss_ext_sales_price) as ss_item_rev
+  from store_sales, item, date_dim
+  where ss_item_sk = i_item_sk
+    and d_date in (select d_date from date_dim
+                   where d_week_seq = (select d_week_seq from date_dim
+                                       where d_date = date '2000-10-07'))
+    and ss_sold_date_sk = d_date_sk
+  group by i_item_id),
+cs_items as (
+  select i_item_id as item_id, sum(cs_ext_sales_price) as cs_item_rev
+  from catalog_sales, item, date_dim
+  where cs_item_sk = i_item_sk
+    and d_date in (select d_date from date_dim
+                   where d_week_seq = (select d_week_seq from date_dim
+                                       where d_date = date '2000-10-07'))
+    and cs_sold_date_sk = d_date_sk
+  group by i_item_id),
+ws_items as (
+  select i_item_id as item_id, sum(ws_ext_sales_price) as ws_item_rev
+  from web_sales, item, date_dim
+  where ws_item_sk = i_item_sk
+    and d_date in (select d_date from date_dim
+                   where d_week_seq = (select d_week_seq from date_dim
+                                       where d_date = date '2000-10-07'))
+    and ws_sold_date_sk = d_date_sk
+  group by i_item_id)
+select ss_items.item_id, ss_item_rev,
+       ss_item_rev / ((ss_item_rev + cs_item_rev + ws_item_rev) / 3) * 100
+         as ss_dev,
+       cs_item_rev,
+       cs_item_rev / ((ss_item_rev + cs_item_rev + ws_item_rev) / 3) * 100
+         as cs_dev,
+       ws_item_rev,
+       ws_item_rev / ((ss_item_rev + cs_item_rev + ws_item_rev) / 3) * 100
+         as ws_dev,
+       (ss_item_rev + cs_item_rev + ws_item_rev) / 3 as average
+from ss_items, cs_items, ws_items
+where ss_items.item_id = cs_items.item_id
+  and ss_items.item_id = ws_items.item_id
+  and ss_item_rev between 0.1 * cs_item_rev and 10.0 * cs_item_rev
+  and ss_item_rev between 0.1 * ws_item_rev and 10.0 * ws_item_rev
+  and cs_item_rev between 0.1 * ss_item_rev and 10.0 * ss_item_rev
+  and cs_item_rev between 0.1 * ws_item_rev and 10.0 * ws_item_rev
+  and ws_item_rev between 0.1 * ss_item_rev and 10.0 * ss_item_rev
+  and ws_item_rev between 0.1 * cs_item_rev and 10.0 * cs_item_rev
+order by item_id, ss_item_rev
+limit 100
+""",
+})
+
+QUERIES.update({
+    # q66: warehouse monthly shipping report, web + catalog UNION ALL
+    # (adaptations: ship_carriers is one literal — literal||literal
+    # folding is not supported; `year` aliased year_; catalog net uses
+    # cs_net_paid — this generator has no cs_net_paid_inc_tax)
+    "q66": """
+select w_warehouse_name, w_warehouse_sq_ft, w_city, w_county, w_state,
+       w_country, ship_carriers, year_,
+       sum(jan_sales) as jan_sales, sum(feb_sales) as feb_sales,
+       sum(mar_sales) as mar_sales, sum(apr_sales) as apr_sales,
+       sum(may_sales) as may_sales, sum(jun_sales) as jun_sales,
+       sum(jul_sales) as jul_sales, sum(aug_sales) as aug_sales,
+       sum(sep_sales) as sep_sales, sum(oct_sales) as oct_sales,
+       sum(nov_sales) as nov_sales, sum(dec_sales) as dec_sales,
+       sum(jan_sales / w_warehouse_sq_ft) as jan_sales_per_sq_foot,
+       sum(dec_sales / w_warehouse_sq_ft) as dec_sales_per_sq_foot,
+       sum(jan_net) as jan_net, sum(feb_net) as feb_net,
+       sum(mar_net) as mar_net, sum(apr_net) as apr_net,
+       sum(may_net) as may_net, sum(jun_net) as jun_net,
+       sum(jul_net) as jul_net, sum(aug_net) as aug_net,
+       sum(sep_net) as sep_net, sum(oct_net) as oct_net,
+       sum(nov_net) as nov_net, sum(dec_net) as dec_net
+from ((select w_warehouse_name, w_warehouse_sq_ft, w_city, w_county,
+              w_state, w_country, 'DHL,BARIAN' as ship_carriers,
+              d_year as year_,
+              sum(case when d_moy = 1 then ws_ext_sales_price * ws_quantity
+                       else 0 end) as jan_sales,
+              sum(case when d_moy = 2 then ws_ext_sales_price * ws_quantity
+                       else 0 end) as feb_sales,
+              sum(case when d_moy = 3 then ws_ext_sales_price * ws_quantity
+                       else 0 end) as mar_sales,
+              sum(case when d_moy = 4 then ws_ext_sales_price * ws_quantity
+                       else 0 end) as apr_sales,
+              sum(case when d_moy = 5 then ws_ext_sales_price * ws_quantity
+                       else 0 end) as may_sales,
+              sum(case when d_moy = 6 then ws_ext_sales_price * ws_quantity
+                       else 0 end) as jun_sales,
+              sum(case when d_moy = 7 then ws_ext_sales_price * ws_quantity
+                       else 0 end) as jul_sales,
+              sum(case when d_moy = 8 then ws_ext_sales_price * ws_quantity
+                       else 0 end) as aug_sales,
+              sum(case when d_moy = 9 then ws_ext_sales_price * ws_quantity
+                       else 0 end) as sep_sales,
+              sum(case when d_moy = 10 then ws_ext_sales_price * ws_quantity
+                       else 0 end) as oct_sales,
+              sum(case when d_moy = 11 then ws_ext_sales_price * ws_quantity
+                       else 0 end) as nov_sales,
+              sum(case when d_moy = 12 then ws_ext_sales_price * ws_quantity
+                       else 0 end) as dec_sales,
+              sum(case when d_moy = 1 then ws_net_paid * ws_quantity
+                       else 0 end) as jan_net,
+              sum(case when d_moy = 2 then ws_net_paid * ws_quantity
+                       else 0 end) as feb_net,
+              sum(case when d_moy = 3 then ws_net_paid * ws_quantity
+                       else 0 end) as mar_net,
+              sum(case when d_moy = 4 then ws_net_paid * ws_quantity
+                       else 0 end) as apr_net,
+              sum(case when d_moy = 5 then ws_net_paid * ws_quantity
+                       else 0 end) as may_net,
+              sum(case when d_moy = 6 then ws_net_paid * ws_quantity
+                       else 0 end) as jun_net,
+              sum(case when d_moy = 7 then ws_net_paid * ws_quantity
+                       else 0 end) as jul_net,
+              sum(case when d_moy = 8 then ws_net_paid * ws_quantity
+                       else 0 end) as aug_net,
+              sum(case when d_moy = 9 then ws_net_paid * ws_quantity
+                       else 0 end) as sep_net,
+              sum(case when d_moy = 10 then ws_net_paid * ws_quantity
+                       else 0 end) as oct_net,
+              sum(case when d_moy = 11 then ws_net_paid * ws_quantity
+                       else 0 end) as nov_net,
+              sum(case when d_moy = 12 then ws_net_paid * ws_quantity
+                       else 0 end) as dec_net
+       from web_sales, warehouse, date_dim, time_dim, ship_mode
+       where ws_warehouse_sk = w_warehouse_sk
+         and ws_sold_date_sk = d_date_sk
+         and ws_sold_time_sk = t_time_sk
+         and ws_ship_mode_sk = sm_ship_mode_sk
+         and d_year = 2001
+         and t_time between 30838 and 59638
+         and sm_carrier in ('DHL', 'BARIAN')
+       group by w_warehouse_name, w_warehouse_sq_ft, w_city, w_county,
+                w_state, w_country, d_year)
+      union all
+      (select w_warehouse_name, w_warehouse_sq_ft, w_city, w_county,
+              w_state, w_country, 'DHL,BARIAN' as ship_carriers,
+              d_year as year_,
+              sum(case when d_moy = 1 then cs_sales_price * cs_quantity
+                       else 0 end) as jan_sales,
+              sum(case when d_moy = 2 then cs_sales_price * cs_quantity
+                       else 0 end) as feb_sales,
+              sum(case when d_moy = 3 then cs_sales_price * cs_quantity
+                       else 0 end) as mar_sales,
+              sum(case when d_moy = 4 then cs_sales_price * cs_quantity
+                       else 0 end) as apr_sales,
+              sum(case when d_moy = 5 then cs_sales_price * cs_quantity
+                       else 0 end) as may_sales,
+              sum(case when d_moy = 6 then cs_sales_price * cs_quantity
+                       else 0 end) as jun_sales,
+              sum(case when d_moy = 7 then cs_sales_price * cs_quantity
+                       else 0 end) as jul_sales,
+              sum(case when d_moy = 8 then cs_sales_price * cs_quantity
+                       else 0 end) as aug_sales,
+              sum(case when d_moy = 9 then cs_sales_price * cs_quantity
+                       else 0 end) as sep_sales,
+              sum(case when d_moy = 10 then cs_sales_price * cs_quantity
+                       else 0 end) as oct_sales,
+              sum(case when d_moy = 11 then cs_sales_price * cs_quantity
+                       else 0 end) as nov_sales,
+              sum(case when d_moy = 12 then cs_sales_price * cs_quantity
+                       else 0 end) as dec_sales,
+              sum(case when d_moy = 1 then cs_net_paid * cs_quantity
+                       else 0 end) as jan_net,
+              sum(case when d_moy = 2 then cs_net_paid * cs_quantity
+                       else 0 end) as feb_net,
+              sum(case when d_moy = 3 then cs_net_paid * cs_quantity
+                       else 0 end) as mar_net,
+              sum(case when d_moy = 4 then cs_net_paid * cs_quantity
+                       else 0 end) as apr_net,
+              sum(case when d_moy = 5 then cs_net_paid * cs_quantity
+                       else 0 end) as may_net,
+              sum(case when d_moy = 6 then cs_net_paid * cs_quantity
+                       else 0 end) as jun_net,
+              sum(case when d_moy = 7 then cs_net_paid * cs_quantity
+                       else 0 end) as jul_net,
+              sum(case when d_moy = 8 then cs_net_paid * cs_quantity
+                       else 0 end) as aug_net,
+              sum(case when d_moy = 9 then cs_net_paid * cs_quantity
+                       else 0 end) as sep_net,
+              sum(case when d_moy = 10 then cs_net_paid * cs_quantity
+                       else 0 end) as oct_net,
+              sum(case when d_moy = 11 then cs_net_paid * cs_quantity
+                       else 0 end) as nov_net,
+              sum(case when d_moy = 12 then cs_net_paid * cs_quantity
+                       else 0 end) as dec_net
+       from catalog_sales, warehouse, date_dim, time_dim, ship_mode
+       where cs_warehouse_sk = w_warehouse_sk
+         and cs_sold_date_sk = d_date_sk
+         and cs_sold_time_sk = t_time_sk
+         and cs_ship_mode_sk = sm_ship_mode_sk
+         and d_year = 2001
+         and t_time between 30838 and 59638
+         and sm_carrier in ('DHL', 'BARIAN')
+       group by w_warehouse_name, w_warehouse_sq_ft, w_city, w_county,
+                w_state, w_country, d_year)) x
+group by w_warehouse_name, w_warehouse_sq_ft, w_city, w_county, w_state,
+         w_country, ship_carriers, year_
+order by w_warehouse_name
+limit 100
+""",
+})
+
+QUERIES.update({
+    # q74: customers whose web growth beat their store growth
+    # (adapted years 1999->2000 inside this generator's sales span)
+    "q74": """
+with year_total as (
+  select c_customer_id as customer_id, c_first_name, c_last_name,
+         d_year as year_, sum(ss_net_paid) as year_total, 's' as sale_type
+  from customer, store_sales, date_dim
+  where c_customer_sk = ss_customer_sk and ss_sold_date_sk = d_date_sk
+    and d_year in (1999, 2000)
+  group by c_customer_id, c_first_name, c_last_name, d_year
+  union all
+  select c_customer_id as customer_id, c_first_name, c_last_name,
+         d_year as year_, sum(ws_net_paid) as year_total, 'w' as sale_type
+  from customer, web_sales, date_dim
+  where c_customer_sk = ws_bill_customer_sk and ws_sold_date_sk = d_date_sk
+    and d_year in (1999, 2000)
+  group by c_customer_id, c_first_name, c_last_name, d_year)
+select t_s_secyear.customer_id, t_s_secyear.c_first_name as customer_first_name,
+       t_s_secyear.c_last_name as customer_last_name
+from year_total t_s_firstyear, year_total t_s_secyear,
+     year_total t_w_firstyear, year_total t_w_secyear
+where t_s_secyear.customer_id = t_s_firstyear.customer_id
+  and t_s_firstyear.customer_id = t_w_secyear.customer_id
+  and t_s_firstyear.customer_id = t_w_firstyear.customer_id
+  and t_s_firstyear.sale_type = 's' and t_w_firstyear.sale_type = 'w'
+  and t_s_secyear.sale_type = 's' and t_w_secyear.sale_type = 'w'
+  and t_s_firstyear.year_ = 1999 and t_s_secyear.year_ = 2000
+  and t_w_firstyear.year_ = 1999 and t_w_secyear.year_ = 2000
+  and t_s_firstyear.year_total > 0 and t_w_firstyear.year_total > 0
+  and case when t_w_firstyear.year_total > 0
+           then t_w_secyear.year_total / t_w_firstyear.year_total
+           else null end
+      > case when t_s_firstyear.year_total > 0
+             then t_s_secyear.year_total / t_s_firstyear.year_total
+             else null end
+order by customer_id, customer_first_name, customer_last_name
+limit 100
+""",
+    # q11: q74 with the list-minus-discount revenue formula and email
+    # carried (adaptation: birth_country/login columns do not exist in
+    # this generator; email replaces them in the grouping)
+    "q11": """
+with year_total as (
+  select c_customer_id as customer_id, c_first_name, c_last_name,
+         c_email_address, d_year as year_,
+         sum(ss_ext_list_price - ss_ext_discount_amt) as year_total,
+         's' as sale_type
+  from customer, store_sales, date_dim
+  where c_customer_sk = ss_customer_sk and ss_sold_date_sk = d_date_sk
+    and d_year in (1999, 2000)
+  group by c_customer_id, c_first_name, c_last_name, c_email_address, d_year
+  union all
+  select c_customer_id as customer_id, c_first_name, c_last_name,
+         c_email_address, d_year as year_,
+         sum(ws_ext_list_price - ws_ext_discount_amt) as year_total,
+         'w' as sale_type
+  from customer, web_sales, date_dim
+  where c_customer_sk = ws_bill_customer_sk and ws_sold_date_sk = d_date_sk
+    and d_year in (1999, 2000)
+  group by c_customer_id, c_first_name, c_last_name, c_email_address, d_year)
+select t_s_secyear.customer_id, t_s_secyear.c_first_name as customer_first_name,
+       t_s_secyear.c_last_name as customer_last_name,
+       t_s_secyear.c_email_address as customer_email_address
+from year_total t_s_firstyear, year_total t_s_secyear,
+     year_total t_w_firstyear, year_total t_w_secyear
+where t_s_secyear.customer_id = t_s_firstyear.customer_id
+  and t_s_firstyear.customer_id = t_w_secyear.customer_id
+  and t_s_firstyear.customer_id = t_w_firstyear.customer_id
+  and t_s_firstyear.sale_type = 's' and t_w_firstyear.sale_type = 'w'
+  and t_s_secyear.sale_type = 's' and t_w_secyear.sale_type = 'w'
+  and t_s_firstyear.year_ = 1999 and t_s_secyear.year_ = 2000
+  and t_w_firstyear.year_ = 1999 and t_w_secyear.year_ = 2000
+  and t_s_firstyear.year_total > 0 and t_w_firstyear.year_total > 0
+  and case when t_w_firstyear.year_total > 0
+           then t_w_secyear.year_total / t_w_firstyear.year_total
+           else 0.0 end
+      > case when t_s_firstyear.year_total > 0
+             then t_s_secyear.year_total / t_s_firstyear.year_total
+             else 0.0 end
+order by customer_id, customer_first_name, customer_last_name,
+         customer_email_address
+limit 100
+""",
+    # q4: three-channel growth comparison with the half-margin formula
+    "q4": """
+with year_total as (
+  select c_customer_id as customer_id, c_first_name, c_last_name,
+         d_year as year_,
+         sum(((ss_ext_list_price - ss_ext_wholesale_cost
+               - ss_ext_discount_amt) + ss_ext_sales_price) / 2)
+           as year_total,
+         's' as sale_type
+  from customer, store_sales, date_dim
+  where c_customer_sk = ss_customer_sk and ss_sold_date_sk = d_date_sk
+    and d_year in (1999, 2000)
+  group by c_customer_id, c_first_name, c_last_name, d_year
+  union all
+  select c_customer_id as customer_id, c_first_name, c_last_name,
+         d_year as year_,
+         sum(((cs_ext_list_price - cs_ext_wholesale_cost
+               - cs_ext_discount_amt) + cs_ext_sales_price) / 2)
+           as year_total,
+         'c' as sale_type
+  from customer, catalog_sales, date_dim
+  where c_customer_sk = cs_bill_customer_sk and cs_sold_date_sk = d_date_sk
+    and d_year in (1999, 2000)
+  group by c_customer_id, c_first_name, c_last_name, d_year
+  union all
+  select c_customer_id as customer_id, c_first_name, c_last_name,
+         d_year as year_,
+         sum(((ws_ext_list_price - ws_ext_wholesale_cost
+               - ws_ext_discount_amt) + ws_ext_sales_price) / 2)
+           as year_total,
+         'w' as sale_type
+  from customer, web_sales, date_dim
+  where c_customer_sk = ws_bill_customer_sk and ws_sold_date_sk = d_date_sk
+    and d_year in (1999, 2000)
+  group by c_customer_id, c_first_name, c_last_name, d_year)
+select t_s_secyear.customer_id, t_s_secyear.c_first_name as customer_first_name,
+       t_s_secyear.c_last_name as customer_last_name
+from year_total t_s_firstyear, year_total t_s_secyear,
+     year_total t_c_firstyear, year_total t_c_secyear,
+     year_total t_w_firstyear, year_total t_w_secyear
+where t_s_secyear.customer_id = t_s_firstyear.customer_id
+  and t_s_firstyear.customer_id = t_w_secyear.customer_id
+  and t_s_firstyear.customer_id = t_w_firstyear.customer_id
+  and t_s_firstyear.customer_id = t_c_secyear.customer_id
+  and t_s_firstyear.customer_id = t_c_firstyear.customer_id
+  and t_s_firstyear.sale_type = 's' and t_c_firstyear.sale_type = 'c'
+  and t_w_firstyear.sale_type = 'w' and t_s_secyear.sale_type = 's'
+  and t_c_secyear.sale_type = 'c' and t_w_secyear.sale_type = 'w'
+  and t_s_firstyear.year_ = 1999 and t_s_secyear.year_ = 2000
+  and t_c_firstyear.year_ = 1999 and t_c_secyear.year_ = 2000
+  and t_w_firstyear.year_ = 1999 and t_w_secyear.year_ = 2000
+  and t_s_firstyear.year_total > 0 and t_c_firstyear.year_total > 0
+  and t_w_firstyear.year_total > 0
+  and case when t_c_firstyear.year_total > 0
+           then t_c_secyear.year_total / t_c_firstyear.year_total
+           else null end
+      > case when t_s_firstyear.year_total > 0
+             then t_s_secyear.year_total / t_s_firstyear.year_total
+             else null end
+  and case when t_c_firstyear.year_total > 0
+           then t_c_secyear.year_total / t_c_firstyear.year_total
+           else null end
+      > case when t_w_firstyear.year_total > 0
+             then t_w_secyear.year_total / t_w_firstyear.year_total
+             else null end
+order by customer_id, customer_first_name, customer_last_name
+limit 100
+""",
+})
+
+QUERIES.update({
+    # q77: 30-day sales vs returns per channel location, ROLLUP over
+    # (channel, id). Adaptations: web returns reach their page via the
+    # originating sale (this generator's web_returns carries no page
+    # key — same device as q5); catalog keeps the official cs,cr
+    # cartesian quirk.
+    "q77": """
+with ss as (
+  select s_store_sk, sum(ss_ext_sales_price) as sales,
+         sum(ss_net_profit) as profit
+  from store_sales, date_dim, store
+  where ss_sold_date_sk = d_date_sk
+    and d_date between date '2000-08-03' and date '2000-08-03' + 30
+    and ss_store_sk = s_store_sk
+  group by s_store_sk),
+sr as (
+  select sr_store_sk, sum(sr_return_amt) as returns_,
+         sum(sr_net_loss) as profit_loss
+  from store_returns, date_dim
+  where sr_returned_date_sk = d_date_sk
+    and d_date between date '2000-08-03' and date '2000-08-03' + 30
+  group by sr_store_sk),
+cs as (
+  select cs_call_center_sk, sum(cs_ext_sales_price) as sales,
+         sum(cs_net_profit) as profit
+  from catalog_sales, date_dim
+  where cs_sold_date_sk = d_date_sk
+    and d_date between date '2000-08-03' and date '2000-08-03' + 30
+  group by cs_call_center_sk),
+cr as (
+  select sum(cr_return_amount) as returns_,
+         sum(cr_net_loss) as profit_loss
+  from catalog_returns, date_dim
+  where cr_returned_date_sk = d_date_sk
+    and d_date between date '2000-08-03' and date '2000-08-03' + 30),
+ws as (
+  select ws_web_page_sk, sum(ws_ext_sales_price) as sales,
+         sum(ws_net_profit) as profit
+  from web_sales, date_dim
+  where ws_sold_date_sk = d_date_sk
+    and d_date between date '2000-08-03' and date '2000-08-03' + 30
+    and ws_web_page_sk is not null
+  group by ws_web_page_sk),
+wr as (
+  select ws_web_page_sk, sum(wr_return_amt) as returns_,
+         sum(wr_net_loss) as profit_loss
+  from web_returns, web_sales, date_dim
+  where wr_order_number = ws_order_number and wr_item_sk = ws_item_sk
+    and wr_returned_date_sk = d_date_sk
+    and d_date between date '2000-08-03' and date '2000-08-03' + 30
+    and ws_web_page_sk is not null
+  group by ws_web_page_sk)
+select channel, id, sum(sales) as sales, sum(returns_) as returns_,
+       sum(profit) as profit
+from (select 'store channel' as channel, ss.s_store_sk as id, sales,
+             coalesce(returns_, 0) as returns_,
+             profit - coalesce(profit_loss, 0) as profit
+      from ss left join sr on ss.s_store_sk = sr.sr_store_sk
+      union all
+      select 'catalog channel' as channel, cs_call_center_sk as id, sales,
+             returns_, profit - profit_loss as profit
+      from cs, cr
+      union all
+      select 'web channel' as channel, ws.ws_web_page_sk as id, sales,
+             coalesce(returns_, 0) as returns_,
+             profit - coalesce(profit_loss, 0) as profit
+      from ws left join wr on ws.ws_web_page_sk = wr.ws_web_page_sk) x
+group by rollup(channel, id)
+order by channel nulls last, id nulls last, sales
+limit 100
+""",
+    # q80: promoted high-price items: per-location sales net of
+    # returns, three channels, ROLLUP. Adaptation: the catalog id is
+    # the call center (no catalog-page key in this generator).
+    "q80": """
+with ssr as (
+  select s_store_id,
+         sum(ss_ext_sales_price) as sales,
+         sum(coalesce(sr_return_amt, 0)) as returns_,
+         sum(ss_net_profit - coalesce(sr_net_loss, 0)) as profit
+  from store_sales left outer join store_returns
+         on ss_item_sk = sr_item_sk and ss_ticket_number = sr_ticket_number,
+       date_dim, store, item, promotion
+  where ss_sold_date_sk = d_date_sk
+    and d_date between date '2000-08-03' and date '2000-08-03' + 30
+    and ss_store_sk = s_store_sk
+    and ss_item_sk = i_item_sk and i_current_price > 50
+    and ss_promo_sk = p_promo_sk and p_channel_tv = 'N'
+  group by s_store_id),
+csr as (
+  select cc_call_center_id,
+         sum(cs_ext_sales_price) as sales,
+         sum(coalesce(cr_return_amount, 0)) as returns_,
+         sum(cs_net_profit - coalesce(cr_net_loss, 0)) as profit
+  from catalog_sales left outer join catalog_returns
+         on cs_item_sk = cr_item_sk and cs_order_number = cr_order_number,
+       date_dim, call_center, item, promotion
+  where cs_sold_date_sk = d_date_sk
+    and d_date between date '2000-08-03' and date '2000-08-03' + 30
+    and cs_call_center_sk = cc_call_center_sk
+    and cs_item_sk = i_item_sk and i_current_price > 50
+    and cs_promo_sk = p_promo_sk and p_channel_tv = 'N'
+  group by cc_call_center_id),
+wsr as (
+  select web_site_id,
+         sum(ws_ext_sales_price) as sales,
+         sum(coalesce(wr_return_amt, 0)) as returns_,
+         sum(ws_net_profit - coalesce(wr_net_loss, 0)) as profit
+  from web_sales left outer join web_returns
+         on ws_item_sk = wr_item_sk and ws_order_number = wr_order_number,
+       date_dim, web_site, item, promotion
+  where ws_sold_date_sk = d_date_sk
+    and d_date between date '2000-08-03' and date '2000-08-03' + 30
+    and ws_web_site_sk = web_site_sk
+    and ws_item_sk = i_item_sk and i_current_price > 50
+    and ws_promo_sk = p_promo_sk and p_channel_tv = 'N'
+  group by web_site_id)
+select channel, id, sum(sales) as sales, sum(returns_) as returns_,
+       sum(profit) as profit
+from (select 'store channel' as channel, s_store_id as id, sales,
+             returns_, profit
+      from ssr
+      union all
+      select 'catalog channel' as channel, cc_call_center_id as id, sales,
+             returns_, profit
+      from csr
+      union all
+      select 'web channel' as channel, web_site_id as id, sales, returns_,
+             profit
+      from wsr) x
+group by rollup(channel, id)
+order by channel nulls last, id nulls last, sales
+limit 100
+""",
+    # q75: categories whose current-year sales dropped below 90% of the
+    # prior year, net of returns, across all three channels (UNION
+    # dedup). Adaptation: the guard ratio divides directly (no
+    # DECIMAL(17,2) casts).
+    "q75": """
+with all_sales as (
+  select d_year, i_brand_id, i_class_id, i_category_id, i_manufact_id,
+         sum(sales_cnt) as sales_cnt, sum(sales_amt) as sales_amt
+  from (select d_year, i_brand_id, i_class_id, i_category_id,
+               i_manufact_id,
+               cs_quantity - coalesce(cr_return_quantity, 0) as sales_cnt,
+               cs_ext_sales_price - coalesce(cr_return_amount, 0.0)
+                 as sales_amt
+        from catalog_sales
+             join item on i_item_sk = cs_item_sk
+             join date_dim on d_date_sk = cs_sold_date_sk
+             left join catalog_returns
+               on cs_order_number = cr_order_number
+                  and cs_item_sk = cr_item_sk
+        where i_category = 'Books'
+        union
+        select d_year, i_brand_id, i_class_id, i_category_id,
+               i_manufact_id,
+               ss_quantity - coalesce(sr_return_quantity, 0) as sales_cnt,
+               ss_ext_sales_price - coalesce(sr_return_amt, 0.0)
+                 as sales_amt
+        from store_sales
+             join item on i_item_sk = ss_item_sk
+             join date_dim on d_date_sk = ss_sold_date_sk
+             left join store_returns
+               on ss_ticket_number = sr_ticket_number
+                  and ss_item_sk = sr_item_sk
+        where i_category = 'Books'
+        union
+        select d_year, i_brand_id, i_class_id, i_category_id,
+               i_manufact_id,
+               ws_quantity - coalesce(wr_return_quantity, 0) as sales_cnt,
+               ws_ext_sales_price - coalesce(wr_return_amt, 0.0)
+                 as sales_amt
+        from web_sales
+             join item on i_item_sk = ws_item_sk
+             join date_dim on d_date_sk = ws_sold_date_sk
+             left join web_returns
+               on ws_order_number = wr_order_number
+                  and ws_item_sk = wr_item_sk
+        where i_category = 'Books') sales_detail
+  group by d_year, i_brand_id, i_class_id, i_category_id, i_manufact_id)
+select prev_yr.d_year as prev_year, curr_yr.d_year as year_,
+       curr_yr.i_brand_id, curr_yr.i_class_id, curr_yr.i_category_id,
+       curr_yr.i_manufact_id, prev_yr.sales_cnt as prev_yr_cnt,
+       curr_yr.sales_cnt as curr_yr_cnt,
+       curr_yr.sales_cnt - prev_yr.sales_cnt as sales_cnt_diff,
+       curr_yr.sales_amt - prev_yr.sales_amt as sales_amt_diff
+from all_sales curr_yr, all_sales prev_yr
+where curr_yr.i_brand_id = prev_yr.i_brand_id
+  and curr_yr.i_class_id = prev_yr.i_class_id
+  and curr_yr.i_category_id = prev_yr.i_category_id
+  and curr_yr.i_manufact_id = prev_yr.i_manufact_id
+  and curr_yr.d_year = 2000
+  and prev_yr.d_year = 1999
+  and curr_yr.sales_cnt / prev_yr.sales_cnt < 0.9
+order by sales_cnt_diff, sales_amt_diff, i_brand_id, i_class_id,
+         i_manufact_id
+limit 100
+""",
+    # q78: store-loyalty ratio for unreturned sales by customer/item/
+    # year against the other two channels
+    "q78": """
+with ws as (
+  select d_year as ws_sold_year, ws_item_sk,
+         ws_bill_customer_sk as ws_customer_sk,
+         sum(ws_quantity) as ws_qty,
+         sum(ws_wholesale_cost) as ws_wc,
+         sum(ws_sales_price) as ws_sp
+  from web_sales
+       left join web_returns on wr_order_number = ws_order_number
+                                and ws_item_sk = wr_item_sk
+       join date_dim on ws_sold_date_sk = d_date_sk
+  where wr_order_number is null
+  group by d_year, ws_item_sk, ws_bill_customer_sk),
+cs as (
+  select d_year as cs_sold_year, cs_item_sk,
+         cs_bill_customer_sk as cs_customer_sk,
+         sum(cs_quantity) as cs_qty,
+         sum(cs_wholesale_cost) as cs_wc,
+         sum(cs_sales_price) as cs_sp
+  from catalog_sales
+       left join catalog_returns on cr_order_number = cs_order_number
+                                    and cs_item_sk = cr_item_sk
+       join date_dim on cs_sold_date_sk = d_date_sk
+  where cr_order_number is null
+  group by d_year, cs_item_sk, cs_bill_customer_sk),
+ss as (
+  select d_year as ss_sold_year, ss_item_sk,
+         ss_customer_sk,
+         sum(ss_quantity) as ss_qty,
+         sum(ss_wholesale_cost) as ss_wc,
+         sum(ss_sales_price) as ss_sp
+  from store_sales
+       left join store_returns on sr_ticket_number = ss_ticket_number
+                                  and ss_item_sk = sr_item_sk
+       join date_dim on ss_sold_date_sk = d_date_sk
+  where sr_ticket_number is null
+  group by d_year, ss_item_sk, ss_customer_sk)
+select ss_customer_sk,
+       round(ss_qty / (coalesce(ws_qty, 0) + coalesce(cs_qty, 0)), 2)
+         as ratio,
+       ss_qty as store_qty, ss_wc as store_wholesale_cost,
+       ss_sp as store_sales_price,
+       coalesce(ws_qty, 0) + coalesce(cs_qty, 0) as other_chan_qty,
+       coalesce(ws_wc, 0) + coalesce(cs_wc, 0)
+         as other_chan_wholesale_cost,
+       coalesce(ws_sp, 0) + coalesce(cs_sp, 0) as other_chan_sales_price
+from ss
+     left join ws on ws_sold_year = ss_sold_year
+                     and ws_item_sk = ss_item_sk
+                     and ws_customer_sk = ss_customer_sk
+     left join cs on cs_sold_year = ss_sold_year
+                     and cs_item_sk = ss_item_sk
+                     and cs_customer_sk = ss_customer_sk
+where (coalesce(ws_qty, 0) > 0 or coalesce(cs_qty, 0) > 0)
+  and ss_sold_year = 2000
+order by ss_customer_sk, ss_qty desc, ss_wc desc, ss_sp desc,
+         other_chan_qty, other_chan_wholesale_cost, other_chan_sales_price,
+         ratio
+limit 100
+""",
+})

@@ -136,14 +136,36 @@ class LookupJoinOperator(Operator):
         join_type: str = "inner",
         unique: bool = True,
         out_capacity: int | None = None,
+        verify: Sequence[tuple[Expr, Expr]] = (),
     ):
+        """``verify``: (probe_expr, build_expr) pairs re-checked on the
+        original values after a hash-key probe — wide string keys probe
+        on a 63-bit hash (expr ``bytes_hash``), so candidate matches
+        must be confirmed by comparing the actual bytes (the module
+        docstring's collision-verification contract). Unique probes
+        only."""
         self.build = build
         self.probe_key = probe_key
         self.build_outputs = list(build_outputs)
         self.join_type = join_type
         self.unique = unique
         self.out_capacity = out_capacity
+        self.verify = list(verify)
         self._step = None
+
+    def _verified(self, res, payload: Batch, batch: Batch):
+        """AND the probe result's matched mask with original-value
+        equality for each verify pair (hash-collision rejection)."""
+        matched = res.matched
+        for pe, be in self.verify:
+            pv = evaluate(pe, batch)
+            bv = evaluate(be, payload)
+            bd = gather_rows(bv.data, res.build_row, 0)
+            eq = pv.data == bd
+            if eq.ndim > 1:
+                eq = eq.all(axis=1)
+            matched = matched & eq
+        return matched
 
     def _ensure_step(self):
         if self._step is not None:
@@ -157,6 +179,10 @@ class LookupJoinOperator(Operator):
         use_dense = self.build.dense_side is not None
 
         if jt in ("semi", "anti"):
+            assert not self.verify, (
+                "hash-key verification requires unique probes; the "
+                "planner must not route wide-key semi joins here"
+            )
 
             @jax.jit
             def step(side, payload: Batch, batch: Batch) -> Batch:
@@ -176,13 +202,15 @@ class LookupJoinOperator(Operator):
                 v = evaluate(key, batch)
                 probe = probe_unique_dense if use_dense else probe_unique
                 res = probe(side, v.data, batch.live & v.valid)
+                matched = self._verified(res, payload, batch)
                 cols = dict(batch.columns)
                 for bo in outs:
                     src = payload[bo.source]
                     data = gather_rows(src.data, res.build_row, 0)
                     valid = gather_padded(src.valid, res.build_row, False)
-                    cols[bo.name] = Column(data, valid, src.dtype, src.dictionary)
-                live = batch.live & res.matched if jt == "inner" else batch.live
+                    cols[bo.name] = Column(data, valid & matched, src.dtype,
+                                           src.dictionary)
+                live = batch.live & matched if jt == "inner" else batch.live
                 return Batch(cols, live)
 
             self._step = step
@@ -190,11 +218,29 @@ class LookupJoinOperator(Operator):
 
         out_cap = self.out_capacity
         assert out_cap is not None, "expansion join requires out_capacity"
+        # verification on an expansion join is exact for INNER only: a
+        # collision adds a spurious pair that the equality check drops;
+        # under LEFT semantics an all-collision probe row would need to
+        # become a null-extended row instead (not implemented)
+        assert not (self.verify and jt != "inner"), (
+            "hash-key verification on expansion joins is inner-only"
+        )
         left = jt == "left"
+        verify = self.verify
 
         def step(side: BuildSide, payload: Batch, batch: Batch):
             v = evaluate(key, batch)
             res = probe_expand(side, v.data, batch.live & v.valid, out_cap, left=left)
+            live = res.live
+            for pe, be in verify:
+                pv = evaluate(pe, batch)
+                bv = evaluate(be, payload)
+                pd_ = gather_rows(pv.data, res.probe_row, 0)
+                bd = gather_rows(bv.data, res.build_row, 1)
+                eq = pd_ == bd
+                if eq.ndim > 1:
+                    eq = eq.all(axis=1)
+                live = live & eq
             cols = {}
             for name in batch.names:
                 src = batch[name]
@@ -212,7 +258,7 @@ class LookupJoinOperator(Operator):
                     src.dtype,
                     src.dictionary,
                 )
-            return Batch(cols, res.live), res.overflow
+            return Batch(cols, live), res.overflow
 
         self._step = jax.jit(step)
 
@@ -230,3 +276,129 @@ class LookupJoinOperator(Operator):
         if bool(overflow):
             raise CapacityOverflow("LookupJoin", self.out_capacity)
         return [out]
+
+    # ---- FULL OUTER probe pass -------------------------------------------
+    # join_type "full" probes with LEFT semantics while accumulating a
+    # matched-flags array over the build payload; after the probe stream
+    # is exhausted, ``full_tail`` emits the never-matched build rows with
+    # NULL probe columns (the reference's unmatched-build emission half
+    # of a full outer LookupJoin [SURVEY §2.1 operator row]). Flags are
+    # caller-owned so replayable streams restart them per replay and the
+    # expansion path's capacity retries can discard a failed attempt's
+    # partial update (the scatter is idempotent).
+
+    def _ensure_full_step(self):
+        if self._step is not None:
+            return
+        outs = self.build_outputs
+        key = self.probe_key
+        use_dense = self.build.dense_side is not None
+
+        if self.unique:
+
+            @jax.jit
+            def step(side, payload: Batch, flags, batch: Batch):
+                v = evaluate(key, batch)
+                probe = probe_unique_dense if use_dense else probe_unique
+                res = probe(side, v.data, batch.live & v.valid)
+                matched = self._verified(res, payload, batch)
+                cols = dict(batch.columns)
+                for bo in outs:
+                    src = payload[bo.source]
+                    data = gather_rows(src.data, res.build_row, 0)
+                    valid = gather_padded(src.valid, res.build_row, False)
+                    cols[bo.name] = Column(data, valid & matched, src.dtype,
+                                           src.dictionary)
+                # miss rows carry build_row == capacity -> dropped; a
+                # hash collision is a miss, so gate the scatter on the
+                # verified mask
+                cap = payload.capacity
+                rows = jnp.where(matched, res.build_row, cap)
+                flags = flags.at[rows].set(True, mode="drop")
+                return Batch(cols, batch.live), flags
+
+            self._step = step
+            return
+
+        out_cap = self.out_capacity
+        assert out_cap is not None, "expansion join requires out_capacity"
+
+        @jax.jit
+        def step(side: BuildSide, payload: Batch, flags, batch: Batch):
+            v = evaluate(key, batch)
+            res = probe_expand(side, v.data, batch.live & v.valid, out_cap, left=True)
+            cols = {}
+            for name in batch.names:
+                src = batch[name]
+                cols[name] = Column(
+                    gather_rows(src.data, res.probe_row, 0),
+                    gather_padded(src.valid, res.probe_row, False),
+                    src.dtype,
+                    src.dictionary,
+                )
+            for bo in outs:
+                src = payload[bo.source]
+                cols[bo.name] = Column(
+                    gather_rows(src.data, res.build_row, 0),
+                    gather_padded(src.valid, res.build_row, False),
+                    src.dtype,
+                    src.dictionary,
+                )
+            flags = flags.at[res.build_row].set(True, mode="drop")
+            return Batch(cols, res.live), flags, res.overflow
+
+        self._step = step
+
+    def process_full(self, batch: Batch, flags):
+        """One FULL OUTER probe step: returns (out_batch, new_flags).
+        Raises CapacityOverflow on expansion overflow — the caller
+        retries the same batch with the PREVIOUS flags."""
+        assert self.build.build_side is not None, "build side not finished"
+        self._ensure_full_step()
+        if self.unique:
+            side = (
+                self.build.dense_side
+                if self.build.dense_side is not None
+                else self.build.build_side
+            )
+            return self._step(side, self.build.payload, flags, batch)
+        out, new_flags, overflow = self._step(
+            self.build.build_side, self.build.payload, flags, batch
+        )
+        if bool(overflow):
+            raise CapacityOverflow("LookupJoin", self.out_capacity)
+        return out, new_flags
+
+
+def full_init_flags(build: JoinBuildOperator):
+    """Fresh matched-build flags for a FULL OUTER probe pass."""
+    return jnp.zeros(build.payload.capacity, dtype=bool)
+
+
+def full_tail(
+    build: JoinBuildOperator,
+    build_outputs: Sequence[BuildOutput],
+    flags,
+    probe_schema: Batch,
+) -> Batch:
+    """Unmatched build rows with NULL probe columns. ``probe_schema``
+    supplies probe-side names/dtypes/dictionaries (any probe batch).
+    Runs once per query — plain eager ops, no jit."""
+    payload = build.payload
+    cap = payload.capacity
+    out_names = {bo.name for bo in build_outputs}
+    cols = {}
+    for name in probe_schema.names:
+        if name in out_names:
+            continue
+        src = probe_schema[name]
+        cols[name] = Column(
+            jnp.zeros((cap,) + src.data.shape[1:], src.data.dtype),
+            jnp.zeros(cap, dtype=bool),
+            src.dtype,
+            src.dictionary,
+        )
+    for bo in build_outputs:
+        src = payload[bo.source]
+        cols[bo.name] = Column(src.data, src.valid, src.dtype, src.dictionary)
+    return Batch(cols, payload.live & ~flags)

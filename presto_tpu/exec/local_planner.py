@@ -278,11 +278,36 @@ class LocalExecutor:
         """Single-key passthrough or multi-key bit-packing using
         runtime maxima over both sides (keys must be non-negative).
         Multi-key joins pay one extra streaming pass over the probe
-        side to find the maxima (the stream replays for the probe)."""
+        side to find the maxima (the stream replays for the probe).
+
+        String (BYTES) keys become integer keys first: exact
+        order-preserving packs for width <= 7, 63-bit hashes beyond —
+        the returned ``verify`` pairs carry the original (probe, build)
+        exprs the unique probe must re-check against collisions.
+        Returns (lkey, rkey, verify)."""
         lkeys = [bind_scalars(k, scalars) for k in lkeys]
         rkeys = [bind_scalars(k, scalars) for k in rkeys]
+        verify: list[tuple[Expr, Expr]] = []
+
+        def wrap(lk, rk):
+            if lk.dtype.kind is not TypeKind.BYTES:
+                return lk, rk
+            if lk.dtype.width != rk.dtype.width:
+                # equal CHAR values of different declared widths would
+                # pack/hash differently (padding is part of the bytes)
+                raise NotImplementedError("string join keys of unequal width")
+            if lk.dtype.width <= 7:
+                fn = "bytes_pack"
+            else:
+                fn = "bytes_hash"
+                verify.append((lk, rk))
+            return Call(BIGINT, fn, (lk,)), Call(BIGINT, fn, (rk,))
+
+        pairs = [wrap(lk, rk) for lk, rk in zip(lkeys, rkeys)]
+        lkeys = [p[0] for p in pairs]
+        rkeys = [p[1] for p in pairs]
         if len(lkeys) == 1:
-            return lkeys[0], rkeys[0]
+            return lkeys[0], rkeys[0], verify
         widths = []
         for lk, rk in zip(lkeys, rkeys):
             mx = 0
@@ -308,7 +333,7 @@ class LocalExecutor:
                 e = Call(BIGINT, "add", (shifted, Call(BIGINT, "cast_bigint", (k,))))
             return e
 
-        return pack(lkeys), pack(rkeys)
+        return pack(lkeys), pack(rkeys), verify
 
     def _dense_domain(self, node_right, right_keys, right_batches):
         """(key_min, domain) when connector stats bound a single build
@@ -337,19 +362,32 @@ class LocalExecutor:
         from presto_tpu.runtime.memory import estimate_node_bytes
 
         est = estimate_node_bytes(node.right, self.catalog)
-        if est > self.join_build_budget:
-            lkey, rkey = self._join_key_exprs(
+        # full outer joins take the in-memory path regardless of the
+        # estimate: their build sides in this suite are pre-aggregated
+        # subqueries (q51/q97 shapes), and the grouped tier has no
+        # unmatched-build tail yet
+        if est > self.join_build_budget and node.kind != "full":
+            lkey, rkey, verify = self._join_key_exprs(
                 node.left_keys, node.right_keys, left, right_stream, scalars
             )
+            if verify:
+                raise NotImplementedError(
+                    "wide string keys in grouped (spilled) joins"
+                )
             return self._exec_grouped_join(
                 node, left, right_stream, lkey, rkey, est
             )
         # the build side is inherently materialized (the lookup source
         # concatenates it); the PROBE side streams batch-by-batch
         right = right_stream.materialize()
-        lkey, rkey = self._join_key_exprs(
+        lkey, rkey, verify = self._join_key_exprs(
             node.left_keys, node.right_keys, left, right, scalars
         )
+        if verify and not node.unique and node.kind != "inner":
+            raise NotImplementedError(
+                "wide string keys on non-unique OUTER joins (verification "
+                "cannot re-synthesize the null-extended row)"
+            )
         dense = (
             self._dense_domain(node.right, node.right_keys, right)
             if node.unique
@@ -358,19 +396,33 @@ class LocalExecutor:
         build = JoinBuildOperator(rkey, dense_domain=dense)
         Pipeline(BatchSource(right), [build]).run()
         outs = [BuildOutput(n, n) for n in node.output_right]
+        if node.kind == "full":
+            return self._exec_full_join(node, left, build, lkey, outs, right,
+                                        verify)
         if node.unique:
-            op = LookupJoinOperator(build, lkey, outs, node.kind, unique=True)
+            op = LookupJoinOperator(build, lkey, outs, node.kind, unique=True,
+                                    verify=verify)
             return left.map(lambda b: op.process(b)[0])
-        # expansion join with per-batch retry-doubling: probing is
-        # stateless per batch, so an overflow re-probes only the
-        # offending batch at a doubled capacity (and keeps the raised
-        # capacity for later batches). out_cap initializes lazily from
-        # the first probe batch actually processed — no peek pass over
-        # the upstream pipeline.
-        right_rows = sum(live_count(b) for b in right)
-        state = {"cap": None, "ops": {}}
+        probe = self._retrying_expand_probe(
+            build, lkey, outs, node.kind, right,
+            lambda op, b: op.process(b)[0], verify=verify,
+        )
+        return left.map(probe)
 
-        def probe(b):
+    def _retrying_expand_probe(self, build, lkey, outs, kind, right, call,
+                               verify=()):
+        """Expansion-probe closure with per-batch capacity
+        retry-doubling: probing is stateless per batch, so an overflow
+        re-probes only the offending batch at a doubled capacity (and
+        keeps the raised capacity for later batches). out_cap
+        initializes lazily from the first probe batch actually
+        processed — no peek pass over the upstream pipeline. ``call``
+        invokes the operator (plain or flags-threaded FULL probe —
+        extra args pass through)."""
+        right_rows = sum(live_count(b) for b in right)
+        state: dict[str, Any] = {"cap": None, "ops": {}}
+
+        def probe(b, *args):
             if state["cap"] is None:
                 state["cap"] = batch_capacity(
                     max(b.capacity, right_rows, 1024)
@@ -380,17 +432,69 @@ class LocalExecutor:
                 op = state["ops"].get(c)
                 if op is None:
                     op = LookupJoinOperator(
-                        build, lkey, outs, node.kind, unique=False,
-                        out_capacity=c,
+                        build, lkey, outs, kind, unique=False,
+                        out_capacity=c, verify=verify,
                     )
                     state["ops"][c] = op
                 try:
-                    return op.process(b)[0]
+                    return call(op, b, *args)
                 except CapacityOverflow:
                     state["cap"] = c * 2
             raise CapacityOverflow("Join", state["cap"])
 
-        return left.map(probe)
+        return probe
+
+    def _exec_full_join(self, node: N.Join, left, build, lkey, outs, right,
+                        verify=()):
+        """FULL OUTER: probe with LEFT semantics while accumulating
+        matched-build flags, then emit the never-matched build rows with
+        NULL probe columns as a tail batch. Flags live in the stream
+        closure so every replay restarts them (the probe re-runs), and a
+        capacity-overflow retry re-probes with the pre-attempt flags
+        (the scatter is idempotent, so discarding a partial update is
+        safe)."""
+        if node.unique:
+            uop = LookupJoinOperator(build, lkey, outs, "full", unique=True,
+                                     verify=verify)
+            probe_once = lambda b, flags: uop.process_full(b, flags)  # noqa: E731
+        else:
+            if verify:
+                raise NotImplementedError(
+                    "wide string join keys require a unique build side"
+                )
+            probe_once = self._retrying_expand_probe(
+                build, lkey, outs, "full", right,
+                lambda op, b, flags: op.process_full(b, flags),
+            )
+
+        def it():
+            from presto_tpu.exec.joins import full_init_flags, full_tail
+
+            flags = full_init_flags(build)
+            schema = None
+            for b in left:
+                out, flags = probe_once(b, flags)
+                schema = b
+                yield out
+            if schema is None:
+                schema = self._schema_batch(node.left)
+            yield full_tail(build, outs, flags, schema)
+
+        return BatchStream(it)
+
+    def _schema_batch(self, plan: N.PlanNode) -> Batch:
+        """A zero-row dtype-template batch from a plan node's fields —
+        the probe-schema fallback when a FULL OUTER probe stream yields
+        no batches (dictionaries unavailable; dict-decode of the tail's
+        all-NULL probe columns is then undefined, which is fine: every
+        value is invalid)."""
+        from presto_tpu.batch import Column
+
+        cols = {}
+        for f in plan.fields:
+            tail = (f.dtype.width,) if f.dtype.kind is TypeKind.BYTES else ()
+            cols[f.name] = _null_column(f.dtype, 1, tail)
+        return Batch(cols, jnp.zeros(1, dtype=bool))
 
     def _exec_grouped_join(self, node: N.Join, left, right_stream, lkey, rkey,
                            est_bytes: int):
@@ -488,14 +592,20 @@ class LocalExecutor:
             # entirely by its own hash bucket, so bucketing is exact
             # for both semi AND anti (an absent bucket means globally
             # absent for anti rows routed there)
-            lkey, rkey = self._join_key_exprs(
+            lkey, rkey, verify = self._join_key_exprs(
                 node.left_keys, node.right_keys, left, right_stream, scalars
             )
+            if verify:
+                raise NotImplementedError("wide string semi-join keys")
             return self._exec_grouped_semijoin(left, right_stream, lkey, rkey, est, jt)
         right = right_stream.materialize()
-        lkey, rkey = self._join_key_exprs(
+        lkey, rkey, verify = self._join_key_exprs(
             node.left_keys, node.right_keys, left, right, scalars
         )
+        if verify:
+            # existence probes have no build_row to verify against;
+            # hash collisions could flip semi/anti membership
+            raise NotImplementedError("wide string semi-join keys")
         dense = self._dense_domain(node.right, node.right_keys, right)
         build = JoinBuildOperator(rkey, dense_domain=dense)
         Pipeline(BatchSource(right), [build]).run()

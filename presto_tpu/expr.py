@@ -176,6 +176,13 @@ def _t_same(args):
 
 
 def _t_add(args):
+    a, b = args
+    # DATE +/- integer days -> DATE (TPC-DS `d_date + 5` interval
+    # arithmetic; dates are physically days-since-epoch)
+    if a.kind is TypeKind.DATE and b.kind in (TypeKind.INTEGER, TypeKind.BIGINT):
+        return a
+    if b.kind is TypeKind.DATE and a.kind in (TypeKind.INTEGER, TypeKind.BIGINT):
+        return b
     return _t_same(args)
 
 
@@ -254,6 +261,9 @@ def _to_physical(v: Val, target: DataType):
         return data.astype(target.jnp_dtype)
     if target.kind is TypeKind.BOOLEAN:
         return data.astype(jnp.bool_)
+    if (target.kind is TypeKind.BYTES and src.kind is TypeKind.BYTES
+            and src.width == target.width):
+        return data
     raise TypeError(f"cannot convert {src} -> {target}")
 
 
@@ -316,7 +326,68 @@ def _neg(args, out):
     return -args[0].data, None
 
 
+@register("upper", _t_first)
+def _upper(args, out):
+    d = args[0].data  # [rows, width] uint8 (BYTES)
+    return jnp.where((d >= 97) & (d <= 122), d - 32, d), None
+
+
+@register("lower", _t_first)
+def _lower(args, out):
+    d = args[0].data
+    return jnp.where((d >= 65) & (d <= 90), d + 32, d), None
+
+
+@register("concat", _t_first)
+def _concat(args, out):
+    """BYTES/string-literal concatenation (SQL ``||``): output width is
+    the sum of part widths (analyzer-computed); literals broadcast."""
+    cap = next(a.data.shape[0] for a in args if not isinstance(a.data, str))
+    parts = []
+    for a in args:
+        if isinstance(a.data, str):
+            arr = np.frombuffer(a.data.encode(), np.uint8)
+            parts.append(jnp.broadcast_to(jnp.asarray(arr), (cap, len(arr))))
+        else:
+            # CHAR semantics: each part occupies its full declared
+            # width space-padded (zero tails become spaces)
+            parts.append(_pad_space(a.data))
+    return jnp.concatenate(parts, axis=1), None
+
+
+@register("bytes_pack", lambda args: BIGINT)
+def _bytes_pack(args, out):
+    """BYTES(w<=7) -> exact big-endian int64 (order-preserving,
+    non-negative, < 2^56): narrow string join/group keys become plain
+    integer keys for the sorted kernels."""
+    d = args[0].data.astype(jnp.int64)
+    h = jnp.zeros(d.shape[0], jnp.int64)
+    for i in range(d.shape[1]):
+        h = h * 256 + d[:, i]
+    return h, None
+
+
+@register("bytes_hash", lambda args: BIGINT)
+def _bytes_hash(args, out):
+    """BYTES(w>7) -> 63-bit polynomial hash (FNV prime, int64 wrap).
+    NOT injective: callers must verify candidate matches on the
+    original bytes (LookupJoinOperator ``verify`` pairs)."""
+    d = args[0].data.astype(jnp.int64)
+    h = jnp.zeros(d.shape[0], jnp.int64)
+    for i in range(d.shape[1]):
+        h = h * jnp.int64(1099511628211) + d[:, i]
+    return h & jnp.int64((1 << 63) - 1), None
+
+
 # ---- comparisons ----------------------------------------------------------
+
+
+def _pad_space(d):
+    """SQL CHAR PAD SPACE comparison semantics: the zero padding behind
+    fixed-width values compares as spaces, so 'after' (zero-padded)
+    equals 'after      ' (space-then-zero-padded) and ordering matches
+    space-extended collation. Data never contains real NULs."""
+    return jnp.where(d == 0, jnp.uint8(32), d)
 
 
 def _bytes_sign(a: Val, b: Val):
@@ -327,12 +398,14 @@ def _bytes_sign(a: Val, b: Val):
     if a.dtype.kind is TypeKind.BYTES and isinstance(b.data, str):
         lit = ops_strings.pad_literal(b.data, a.data.shape[1])
         return ops_strings.bytes_compare(
-            a.data, jnp.broadcast_to(jnp.asarray(lit), a.data.shape)
+            _pad_space(a.data),
+            jnp.broadcast_to(_pad_space(jnp.asarray(lit)), a.data.shape),
         )
     if b.dtype.kind is TypeKind.BYTES and isinstance(a.data, str):
         lit = ops_strings.pad_literal(a.data, b.data.shape[1])
         return -ops_strings.bytes_compare(
-            b.data, jnp.broadcast_to(jnp.asarray(lit), b.data.shape)
+            _pad_space(b.data),
+            jnp.broadcast_to(_pad_space(jnp.asarray(lit)), b.data.shape),
         )
     if a.dtype.kind is TypeKind.BYTES and b.dtype.kind is TypeKind.BYTES:
         from presto_tpu.ops.strings import bytes_compare
@@ -345,7 +418,7 @@ def _bytes_sign(a: Val, b: Val):
             pad = jnp.zeros((d.shape[0], w - d.shape[1]), d.dtype)
             return jnp.concatenate([d, pad], axis=1)
 
-        return bytes_compare(widen(a.data), widen(b.data))
+        return bytes_compare(_pad_space(widen(a.data)), _pad_space(widen(b.data)))
     raise TypeError("not a BYTES comparison")
 
 
@@ -472,13 +545,28 @@ def _round(args, out):
     return jnp.sign(x) * jnp.floor(jnp.abs(x) + 0.5), None
 
 
+def _bytes_literal_matrix(s: str, width: int, cap: int):
+    """A VARCHAR literal as a broadcast [cap, width] BYTES matrix
+    (space-padded/truncated to the fixed width)."""
+    raw = s.encode()[:width].ljust(width, b" ")
+    return jnp.broadcast_to(jnp.asarray(np.frombuffer(raw, np.uint8)), (cap, width))
+
+
 @register("coalesce", _t_same)
 def _coalesce(args, out):
+    if out.kind is TypeKind.BYTES:
+        cap = next(a.data.shape[0] for a in args if not isinstance(a.data, str))
+        args = [
+            Val(_bytes_literal_matrix(a.data, out.width, cap),
+                jnp.ones(cap, dtype=jnp.bool_), out)
+            if isinstance(a.data, str) else a
+            for a in args
+        ]
     data = _to_physical(args[-1], out)
     valid = args[-1].valid
     for v in reversed(args[:-1]):
         d = _to_physical(v, out)
-        data = jnp.where(v.valid, d, data)
+        data = jnp.where(v.valid[:, None] if data.ndim > 1 else v.valid, d, data)
         valid = v.valid | valid
     return data, valid
 

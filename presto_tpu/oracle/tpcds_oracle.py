@@ -1675,12 +1675,826 @@ def q5(t):
         drop=True
     ).head(100)
 
+def q97(t):
+    d = t["date_dim"]
+    dd = d[(d.d_month_seq >= 1200) & (d.d_month_seq <= 1211)][["d_date_sk"]]
+    ss = t["store_sales"].merge(dd, left_on="ss_sold_date_sk", right_on="d_date_sk")
+    ssci = ss[["ss_customer_sk", "ss_item_sk"]].drop_duplicates()
+    cs = t["catalog_sales"].merge(dd, left_on="cs_sold_date_sk", right_on="d_date_sk")
+    csci = cs[["cs_bill_customer_sk", "cs_item_sk"]].drop_duplicates()
+    # NULL keys never match in SQL (pandas outer merge WOULD match
+    # NaN==NaN): count inner matches among fully-non-null pairs, then
+    # derive the full-outer buckets arithmetically (both sides are
+    # duplicate-free on the pair).
+    both = ssci.dropna().merge(
+        csci.dropna(),
+        left_on=["ss_customer_sk", "ss_item_sk"],
+        right_on=["cs_bill_customer_sk", "cs_item_sk"],
+    )
+    n = len(both)
+    return pd.DataFrame({
+        "store_only": [len(ssci) - n],
+        "catalog_only": [len(csci) - n],
+        "store_and_catalog": [n],
+    })
+
+
+def q51(t):
+    d = t["date_dim"]
+    dd = d[(d.d_month_seq >= 1200) & (d.d_month_seq <= 1211)][
+        ["d_date_sk", "d_date"]
+    ]
+
+    def v1(tbl, item, date_col, price):
+        j = t[tbl].merge(dd, left_on=date_col, right_on="d_date_sk")
+        j = j[j[item].notna()]
+        g = j.groupby([item, "d_date"], as_index=False).agg(s=(price, "sum"))
+        g = g.sort_values([item, "d_date"], kind="stable")
+        g["cume_sales"] = g.groupby(item)["s"].cumsum()
+        return g.rename(columns={item: "item_sk"})[
+            ["item_sk", "d_date", "cume_sales"]
+        ]
+
+    web = v1("web_sales", "ws_item_sk", "ws_sold_date_sk", "ws_sales_price")
+    store = v1("store_sales", "ss_item_sk", "ss_sold_date_sk", "ss_sales_price")
+    # keys are non-null (filtered above), so pandas outer == SQL full outer
+    m = web.merge(store, on=["item_sk", "d_date"], how="outer",
+                  suffixes=("_w", "_s"))
+    m = m.rename(columns={"cume_sales_w": "web_sales",
+                          "cume_sales_s": "store_sales"})
+    m = m.sort_values(["item_sk", "d_date"], kind="stable")
+    # SQL running MAX ignores NULLs: pandas cummax leaves NaN at NaN
+    # input positions, so forward-fill within the partition (an all-NaN
+    # prefix stays NaN, matching MAX over an empty value set)
+    for out, src in (("web_cumulative", "web_sales"),
+                     ("store_cumulative", "store_sales")):
+        m[out] = m.groupby("item_sk")[src].cummax()
+        m[out] = m.groupby("item_sk")[out].ffill()
+    r = m[m.web_cumulative > m.store_cumulative]
+    r = r.sort_values(["item_sk", "d_date"], kind="stable").head(100)
+    return r[["item_sk", "d_date", "web_sales", "store_sales",
+              "web_cumulative", "store_cumulative"]].reset_index(drop=True)
+
+
+def q27(t):
+    j = _ss_dd_it(t).merge(
+        t["customer_demographics"], left_on="ss_cdemo_sk",
+        right_on="cd_demo_sk",
+    ).merge(t["store"], left_on="ss_store_sk", right_on="s_store_sk")
+    j = j[(j.cd_gender == "M") & (j.cd_marital_status == "S")
+          & (j.cd_education_status == "College") & (j.d_year == 2000)
+          & (j.s_state.isin(["HI", "KY", "LA"]))]
+    vals = ["ss_quantity", "ss_list_price", "ss_coupon_amt", "ss_sales_price"]
+
+    def level(keys):
+        if keys:
+            g = j.groupby(keys, as_index=False, dropna=False)[vals].mean()
+        else:
+            g = j[vals].mean().to_frame().T
+        return g
+
+    detail = level(["i_item_id", "s_state"]); detail["g_state"] = 0
+    sub = level(["i_item_id"]); sub["g_state"] = 1; sub["s_state"] = None
+    grand = level([]); grand["g_state"] = 1
+    grand["i_item_id"] = None; grand["s_state"] = None
+    u = pd.concat([detail, sub, grand], ignore_index=True)
+    u = u.sort_values(["i_item_id", "s_state"], na_position="last",
+                      kind="stable").head(100)
+    u = u.rename(columns=dict(zip(vals, ["agg1", "agg2", "agg3", "agg4"])))
+    return u[["i_item_id", "s_state", "g_state",
+              "agg1", "agg2", "agg3", "agg4"]].reset_index(drop=True)
+
+
+def q70(t):
+    d = t["date_dim"]
+    dd = d[(d.d_month_seq >= 1200) & (d.d_month_seq <= 1211)][["d_date_sk"]]
+    j = t["store_sales"].merge(dd, left_on="ss_sold_date_sk",
+                               right_on="d_date_sk")
+    j = j.merge(t["store"], left_on="ss_store_sk", right_on="s_store_sk")
+    # the official subquery ranks PARTITION BY s_state over a GROUP BY
+    # s_state — one row per partition, so ranking is always 1 and the
+    # `ranking <= 5` filter keeps every state (the well-known q70
+    # quirk); mirror that exactly
+    by_state = j.groupby("s_state")["ss_net_profit"].sum()
+    j = j[j.s_state.isin(by_state.index)]
+    detail = j.groupby(["s_state", "s_county"], as_index=False,
+                       dropna=False).agg(total_sum=("ss_net_profit", "sum"))
+    detail["lochierarchy"] = 0
+    sub = j.groupby(["s_state"], as_index=False, dropna=False).agg(
+        total_sum=("ss_net_profit", "sum"))
+    sub["s_county"] = None; sub["lochierarchy"] = 1
+    grand = pd.DataFrame({"total_sum": [j.ss_net_profit.sum()],
+                          "s_state": [None], "s_county": [None],
+                          "lochierarchy": [2]})
+    u = pd.concat([detail, sub, grand], ignore_index=True)
+    part_state = u.s_state.where(u.lochierarchy == 0, None)
+    u["rank_within_parent"] = u.groupby(
+        [u.lochierarchy, part_state], dropna=False
+    )["total_sum"].rank(ascending=False, method="min").astype(int)
+    u = u.sort_values(["s_state", "s_county"], na_position="last",
+                      kind="stable")
+    u = u.sort_values("rank_within_parent", kind="stable")
+    u["ck"] = part_state
+    u = u.sort_values("ck", na_position="last", kind="stable")
+    u = u.sort_values("lochierarchy", ascending=False, kind="stable")
+    return u[["total_sum", "s_state", "s_county", "lochierarchy",
+              "rank_within_parent"]].head(100).reset_index(drop=True)
+
+
+def q67(t):
+    d = t["date_dim"]
+    dd = d[(d.d_month_seq >= 1200) & (d.d_month_seq <= 1211)][
+        ["d_date_sk", "d_year", "d_qoy", "d_moy"]]
+    j = t["store_sales"].merge(dd, left_on="ss_sold_date_sk",
+                               right_on="d_date_sk")
+    j = j.merge(t["store"], left_on="ss_store_sk", right_on="s_store_sk")
+    j = j.merge(t["item"], left_on="ss_item_sk", right_on="i_item_sk")
+    # exact integer cents: the engine ranks on exact scaled-int decimal
+    # sums, so a float oracle can flip near-tie rank boundaries
+    j["sales"] = (
+        (j.ss_sales_price * 100).round().fillna(0).astype(np.int64)
+        * j.ss_quantity.fillna(0).astype(np.int64)
+    )
+    cols = ["i_category", "i_class", "i_brand", "i_product_name",
+            "d_year", "d_qoy", "d_moy", "s_store_id"]
+    frames = []
+    for k in range(len(cols), -1, -1):
+        keys = cols[:k]
+        if keys:
+            g = j.groupby(keys, as_index=False, dropna=False).agg(
+                sumsales=("sales", "sum"))
+        else:
+            g = pd.DataFrame({"sumsales": [j.sales.sum()]})
+        for c in cols[k:]:
+            g[c] = None
+        frames.append(g)
+    u = pd.concat(frames, ignore_index=True)
+    u["rk"] = u.groupby("i_category", dropna=False)["sumsales"].rank(
+        ascending=False, method="min").astype(int)
+    u = u[u.rk <= 100]
+    u = u.sort_values(["rk"], kind="stable")
+    u = u.sort_values(["sumsales"], kind="stable")
+    for c in reversed(cols):
+        u = u.sort_values(c, na_position="last", kind="stable")
+    u["sumsales"] = u.sumsales / 100.0
+    return u[cols + ["sumsales", "rk"]].head(100).reset_index(drop=True)
+
+
+def _active_customers(t, extra_pred):
+    """Customers with store activity AND (web OR catalog) activity in
+    the window (q10/q35 EXISTS semantics)."""
+    d = t["date_dim"]
+    dd = d[extra_pred(d)][["d_date_sk"]]
+    c = t["customer"]
+    ss = t["store_sales"].merge(dd, left_on="ss_sold_date_sk",
+                                right_on="d_date_sk")
+    ws = t["web_sales"].merge(dd, left_on="ws_sold_date_sk",
+                              right_on="d_date_sk")
+    cs = t["catalog_sales"].merge(dd, left_on="cs_sold_date_sk",
+                                  right_on="d_date_sk")
+    has_ss = c.c_customer_sk.isin(ss.ss_customer_sk.dropna())
+    has_wc = (c.c_customer_sk.isin(ws.ws_bill_customer_sk.dropna())
+              | c.c_customer_sk.isin(cs.cs_ship_customer_sk.dropna()))
+    return c[has_ss & has_wc]
+
+
+def q10(t):
+    c = _active_customers(
+        t, lambda d: (d.d_year == 2000) & (d.d_moy >= 1) & (d.d_moy <= 4))
+    j = c.merge(t["customer_address"], left_on="c_current_addr_sk",
+                right_on="ca_address_sk")
+    j = j[j.ca_county.isin(["Williamson County", "Huron County",
+                            "Daviess County", "Maricopa County",
+                            "Ziebach County"])]
+    j = j.merge(t["customer_demographics"], left_on="c_current_cdemo_sk",
+                right_on="cd_demo_sk")
+    keys = ["cd_gender", "cd_marital_status", "cd_education_status",
+            "cd_purchase_estimate", "cd_credit_rating", "cd_dep_count",
+            "cd_dep_employed_count", "cd_dep_college_count"]
+    g = j.groupby(keys, as_index=False, dropna=False).agg(
+        cnt1=("cd_demo_sk", "size"))
+    for n in ("cnt2", "cnt3", "cnt4", "cnt5", "cnt6"):
+        g[n] = g.cnt1
+    g = g.sort_values(keys, kind="stable").head(100)
+    return g[["cd_gender", "cd_marital_status", "cd_education_status",
+              "cnt1", "cd_purchase_estimate", "cnt2", "cd_credit_rating",
+              "cnt3", "cd_dep_count", "cnt4", "cd_dep_employed_count",
+              "cnt5", "cd_dep_college_count", "cnt6"]].reset_index(drop=True)
+
+
+def q35(t):
+    c = _active_customers(t, lambda d: (d.d_year == 2000) & (d.d_qoy < 4))
+    j = c.merge(t["customer_address"], left_on="c_current_addr_sk",
+                right_on="ca_address_sk")
+    j = j.merge(t["customer_demographics"], left_on="c_current_cdemo_sk",
+                right_on="cd_demo_sk")
+    keys = ["ca_state", "cd_gender", "cd_marital_status", "cd_dep_count",
+            "cd_dep_employed_count", "cd_dep_college_count"]
+    g = j.groupby(keys, as_index=False, dropna=False).agg(
+        cnt1=("cd_demo_sk", "size"),
+        a1=("cd_dep_count", "mean"), m1=("cd_dep_count", "max"),
+        s1=("cd_dep_count", "sum"),
+        a2=("cd_dep_employed_count", "mean"),
+        m2=("cd_dep_employed_count", "max"),
+        s2=("cd_dep_employed_count", "sum"),
+        a3=("cd_dep_college_count", "mean"),
+        m3=("cd_dep_college_count", "max"),
+        s3=("cd_dep_college_count", "sum"),
+    )
+    g["cnt2"] = g.cnt1
+    g["cnt3"] = g.cnt1
+    g = g.sort_values(keys, na_position="last", kind="stable").head(100)
+    return g[["ca_state", "cd_gender", "cd_marital_status", "cd_dep_count",
+              "cnt1", "a1", "m1", "s1", "cd_dep_employed_count", "cnt2",
+              "a2", "m2", "s2", "cd_dep_college_count", "cnt3", "a3",
+              "m3", "s3"]].reset_index(drop=True)
+
+
+def q41(t):
+    it = t["item"]
+    c1 = (it.i_category == "Home") & it.i_size.isin(["medium", "economy"])
+    c2 = ((it.i_category == "Electronics")
+          & it.i_size.isin(["petite", "medium"]))
+    c3 = (it.i_category == "Men") & it.i_size.isin(["medium", "economy"])
+    c4 = ((it.i_category == "Jewelry")
+          & it.i_size.isin(["petite", "extra large"]))
+    good_manufacts = set(it[c1 | c2 | c3 | c4].i_manufact.dropna())
+    sel = it[(it.i_manufact_id >= 600) & (it.i_manufact_id <= 800)
+             & it.i_manufact.isin(good_manufacts)]
+    names = sorted(sel.i_product_name.dropna().unique())[:100]
+    return pd.DataFrame({"i_product_name": names})
+
+
+def q84(t):
+    cu = t["customer"]
+    j = cu.merge(t["customer_address"], left_on="c_current_addr_sk",
+                 right_on="ca_address_sk")
+    j = j[j.ca_city.str.strip() == "after"]
+    j = j.merge(t["customer_demographics"], left_on="c_current_cdemo_sk",
+                right_on="cd_demo_sk")
+    j = j.merge(t["household_demographics"], left_on="c_current_hdemo_sk",
+                right_on="hd_demo_sk")
+    ib = t["income_band"]
+    ib = ib[(ib.ib_lower_bound >= 30001) & (ib.ib_upper_bound <= 80000)]
+    j = j.merge(ib, left_on="hd_income_band_sk", right_on="ib_income_band_sk")
+    j = j.merge(t["store_returns"], left_on="c_customer_sk",
+                right_on="sr_customer_sk")
+    j = j.sort_values("c_customer_id", kind="stable").head(100)
+    # the engine's || emits the full fixed CHAR width of the left part
+    # (c_last_name is bytes(30)); trailing padding of the final part is
+    # stripped on decode
+    name = (j.c_last_name.fillna("").str.ljust(30) + ", "
+            + j.c_first_name.fillna("").str.ljust(20))
+    return pd.DataFrame({"customer_id": j.c_customer_id.to_numpy(),
+                         "customername": name.to_numpy()})
+
+
+def q8(t):
+    ca = t["customer_address"]
+    ziplist = ["50183", "00355", "50970", "22225", "00565", "50602",
+               "22614", "68502", "45287", "98313"]
+    a = set(ca.ca_zip.dropna().str[:5]) & set(ziplist)
+    pref = t["customer"][t["customer"].c_preferred_cust_flag == "Y"].merge(
+        ca, left_on="c_current_addr_sk", right_on="ca_address_sk")
+    vc = pref.ca_zip.dropna().str[:5].value_counts()
+    b = set(vc[vc > 1].index)
+    v1 = pd.DataFrame({"ca_zip2": [z[:2] for z in sorted(a & b)]})
+    d = t["date_dim"]
+    dd = d[(d.d_qoy == 2) & (d.d_year == 2000)][["d_date_sk"]]
+    st = t["store"].copy()
+    st["s_zip2"] = st.s_zip.str[:2]
+    j = t["store_sales"].merge(dd, left_on="ss_sold_date_sk",
+                               right_on="d_date_sk")
+    j = j.merge(st, left_on="ss_store_sk", right_on="s_store_sk")
+    j = j.merge(v1, left_on="s_zip2", right_on="ca_zip2")
+    g = j.groupby("s_store_name", as_index=False).agg(
+        profit=("ss_net_profit", "sum"))
+    return g.sort_values("s_store_name", kind="stable").head(
+        100).reset_index(drop=True)
+
+
+def _q83_channel(t, tbl, item_col, date_col, qty_col):
+    d = t["date_dim"]
+    weeks = set(d[d.d_date.isin([D("2000-04-22"), D("2000-07-01"),
+                                 D("2000-10-21")])].d_week_seq)
+    dates = d[d.d_week_seq.isin(weeks)][["d_date_sk"]]
+    j = t[tbl].merge(dates, left_on=date_col, right_on="d_date_sk")
+    j = j.merge(t["item"], left_on=item_col, right_on="i_item_sk")
+    return j.groupby("i_item_id", as_index=False).agg(q=(qty_col, "sum"))
+
+
+def q83(t):
+    sr = _q83_channel(t, "store_returns", "sr_item_sk",
+                      "sr_returned_date_sk", "sr_return_quantity")
+    cr = _q83_channel(t, "catalog_returns", "cr_item_sk",
+                      "cr_returned_date_sk", "cr_return_quantity")
+    wr = _q83_channel(t, "web_returns", "wr_item_sk",
+                      "wr_returned_date_sk", "wr_return_quantity")
+    j = sr.merge(cr, on="i_item_id", suffixes=("_sr", "_cr")).merge(
+        wr, on="i_item_id")
+    j = j.rename(columns={"q_sr": "sr_item_qty", "q_cr": "cr_item_qty",
+                          "q": "wr_item_qty"})
+    tot = j.sr_item_qty + j.cr_item_qty + j.wr_item_qty
+    j["sr_dev"] = j.sr_item_qty / tot / 3.0 * 100
+    j["cr_dev"] = j.cr_item_qty / tot / 3.0 * 100
+    j["wr_dev"] = j.wr_item_qty / tot / 3.0 * 100
+    j["average"] = tot / 3.0
+    j = j.sort_values(["i_item_id", "sr_item_qty"], kind="stable").head(100)
+    return j.rename(columns={"i_item_id": "item_id"})[
+        ["item_id", "sr_item_qty", "sr_dev", "cr_item_qty", "cr_dev",
+         "wr_item_qty", "wr_dev", "average"]].reset_index(drop=True)
+
+
+def _q58_channel(t, tbl, item_col, date_col, rev_col):
+    d = t["date_dim"]
+    wk = d[d.d_date == D("2000-10-07")].d_week_seq.iloc[0]
+    dates = d[d.d_week_seq == wk][["d_date_sk"]]
+    j = t[tbl].merge(dates, left_on=date_col, right_on="d_date_sk")
+    j = j.merge(t["item"], left_on=item_col, right_on="i_item_sk")
+    return j.groupby("i_item_id", as_index=False).agg(r=(rev_col, "sum"))
+
+
+def q58(t):
+    ss = _q58_channel(t, "store_sales", "ss_item_sk", "ss_sold_date_sk",
+                      "ss_ext_sales_price")
+    cs = _q58_channel(t, "catalog_sales", "cs_item_sk", "cs_sold_date_sk",
+                      "cs_ext_sales_price")
+    ws = _q58_channel(t, "web_sales", "ws_item_sk", "ws_sold_date_sk",
+                      "ws_ext_sales_price")
+    j = ss.merge(cs, on="i_item_id", suffixes=("_ss", "_cs")).merge(
+        ws, on="i_item_id")
+    j = j.rename(columns={"r_ss": "ss_item_rev", "r_cs": "cs_item_rev",
+                          "r": "ws_item_rev"})
+    m = ((j.ss_item_rev.between(0.1 * j.cs_item_rev, 10.0 * j.cs_item_rev))
+         & (j.ss_item_rev.between(0.1 * j.ws_item_rev, 10.0 * j.ws_item_rev))
+         & (j.cs_item_rev.between(0.1 * j.ss_item_rev, 10.0 * j.ss_item_rev))
+         & (j.cs_item_rev.between(0.1 * j.ws_item_rev, 10.0 * j.ws_item_rev))
+         & (j.ws_item_rev.between(0.1 * j.ss_item_rev, 10.0 * j.ss_item_rev))
+         & (j.ws_item_rev.between(0.1 * j.cs_item_rev, 10.0 * j.cs_item_rev)))
+    j = j[m]
+    avg = (j.ss_item_rev + j.cs_item_rev + j.ws_item_rev) / 3
+    j["ss_dev"] = j.ss_item_rev / avg * 100
+    j["cs_dev"] = j.cs_item_rev / avg * 100
+    j["ws_dev"] = j.ws_item_rev / avg * 100
+    j["average"] = avg
+    j = j.sort_values(["i_item_id", "ss_item_rev"], kind="stable").head(100)
+    return j.rename(columns={"i_item_id": "item_id"})[
+        ["item_id", "ss_item_rev", "ss_dev", "cs_item_rev", "cs_dev",
+         "ws_item_rev", "ws_dev", "average"]].reset_index(drop=True)
+
+
+_Q66_MONTHS = ["jan", "feb", "mar", "apr", "may", "jun", "jul", "aug",
+               "sep", "oct", "nov", "dec"]
+
+
+def _q66_channel(t, tbl, wh_col, date_col, time_col, mode_col,
+                 price_col, net_col, qty_col):
+    d = t["date_dim"]
+    td = t["time_dim"]
+    sm = t["ship_mode"]
+    j = t[tbl].merge(t["warehouse"], left_on=wh_col,
+                     right_on="w_warehouse_sk")
+    j = j.merge(d[d.d_year == 2001][["d_date_sk", "d_year", "d_moy"]],
+                left_on=date_col, right_on="d_date_sk")
+    j = j.merge(td[(td.t_time >= 30838) & (td.t_time <= 59638)][["t_time_sk"]],
+                left_on=time_col, right_on="t_time_sk")
+    j = j.merge(sm[sm.sm_carrier.isin(["DHL", "BARIAN"])][["sm_ship_mode_sk"]],
+                left_on=mode_col, right_on="sm_ship_mode_sk")
+    keys = ["w_warehouse_name", "w_warehouse_sq_ft", "w_city", "w_county",
+            "w_state", "w_country", "d_year"]
+    for i, mn in enumerate(_Q66_MONTHS):
+        moy = j.d_moy == i + 1
+        j[f"{mn}_sales"] = (j[price_col] * j[qty_col]).where(moy, 0.0)
+        j[f"{mn}_net"] = (j[net_col] * j[qty_col]).where(moy, 0.0)
+    cols = [f"{mn}_sales" for mn in _Q66_MONTHS] + [
+        f"{mn}_net" for mn in _Q66_MONTHS]
+    g = j.groupby(keys, as_index=False, dropna=False)[cols].sum()
+    g["ship_carriers"] = "DHL,BARIAN"
+    return g
+
+
+def q66(t):
+    web = _q66_channel(t, "web_sales", "ws_warehouse_sk", "ws_sold_date_sk",
+                       "ws_sold_time_sk", "ws_ship_mode_sk",
+                       "ws_ext_sales_price", "ws_net_paid", "ws_quantity")
+    cat = _q66_channel(t, "catalog_sales", "cs_warehouse_sk",
+                       "cs_sold_date_sk", "cs_sold_time_sk",
+                       "cs_ship_mode_sk", "cs_sales_price", "cs_net_paid",
+                       "cs_quantity")
+    u = pd.concat([web, cat], ignore_index=True)
+    keys = ["w_warehouse_name", "w_warehouse_sq_ft", "w_city", "w_county",
+            "w_state", "w_country", "ship_carriers", "d_year"]
+    u["jan_spsf"] = u.jan_sales / u.w_warehouse_sq_ft
+    u["dec_spsf"] = u.dec_sales / u.w_warehouse_sq_ft
+    cols = ([f"{mn}_sales" for mn in _Q66_MONTHS] + ["jan_spsf", "dec_spsf"]
+            + [f"{mn}_net" for mn in _Q66_MONTHS])
+    g = u.groupby(keys, as_index=False, dropna=False)[cols].sum()
+    g = g.sort_values("w_warehouse_name", kind="stable").head(100)
+    out_cols = (keys[:7] + ["d_year"]
+                + [f"{mn}_sales" for mn in _Q66_MONTHS]
+                + ["jan_spsf", "dec_spsf"]
+                + [f"{mn}_net" for mn in _Q66_MONTHS])
+    g = g[keys + cols]
+    return g.reset_index(drop=True)
+
+
+def _yt(t, tbl, cust_col, date_col, val_fn, extra_keys=()):
+    """Per-customer-per-year channel totals in exact integer cents."""
+    j = t["customer"].merge(t[tbl], left_on="c_customer_sk",
+                            right_on=cust_col)
+    j = j.merge(t["date_dim"][["d_date_sk", "d_year"]], left_on=date_col,
+                right_on="d_date_sk")
+    j = j[j.d_year.isin([1999, 2000])]
+    j = j.assign(v=val_fn(j))
+    keys = (["c_customer_id", "c_first_name", "c_last_name"]
+            + list(extra_keys) + ["d_year"])
+    return j.groupby(keys, as_index=False, dropna=False).agg(
+        total=("v", "sum"))
+
+
+def _cents(s):
+    return (s * 100).round().fillna(0)
+
+
+def _ratio32(sec, first):
+    """Replicate the engine's DOUBLE division: decimal -> float32."""
+    f32 = lambda s: (s.to_numpy() / 100.0).astype(np.float32)  # noqa: E731
+    return f32(sec) / f32(first)
+
+
+def q74(t):
+    s = _yt(t, "store_sales", "ss_customer_sk", "ss_sold_date_sk",
+            lambda j: _cents(j.ss_net_paid))
+    w = _yt(t, "web_sales", "ws_bill_customer_sk", "ws_sold_date_sk",
+            lambda j: _cents(j.ws_net_paid))
+    s1 = s[s.d_year == 1999]
+    s2 = s[s.d_year == 2000]
+    w1 = w[w.d_year == 1999]
+    w2 = w[w.d_year == 2000]
+    m = (s2.merge(s1[["c_customer_id", "total"]], on="c_customer_id",
+                  suffixes=("", "_s1"))
+         .merge(w1[["c_customer_id", "total"]].rename(
+             columns={"total": "total_w1"}), on="c_customer_id")
+         .merge(w2[["c_customer_id", "total"]].rename(
+             columns={"total": "total_w2"}), on="c_customer_id"))
+    m = m[(m.total_s1 > 0) & (m.total_w1 > 0)]
+    m = m[_ratio32(m.total_w2, m.total_w1) > _ratio32(m.total, m.total_s1)]
+    m = m.sort_values(["c_customer_id", "c_first_name", "c_last_name"],
+                      kind="stable").head(100)
+    return m.rename(columns={
+        "c_customer_id": "customer_id",
+        "c_first_name": "customer_first_name",
+        "c_last_name": "customer_last_name",
+    })[["customer_id", "customer_first_name",
+        "customer_last_name"]].reset_index(drop=True)
+
+
+def q11(t):
+    s = _yt(t, "store_sales", "ss_customer_sk", "ss_sold_date_sk",
+            lambda j: _cents(j.ss_ext_list_price - j.ss_ext_discount_amt),
+            extra_keys=("c_email_address",))
+    w = _yt(t, "web_sales", "ws_bill_customer_sk", "ws_sold_date_sk",
+            lambda j: _cents(j.ws_ext_list_price - j.ws_ext_discount_amt),
+            extra_keys=("c_email_address",))
+    s1 = s[s.d_year == 1999]
+    s2 = s[s.d_year == 2000]
+    w1 = w[w.d_year == 1999]
+    w2 = w[w.d_year == 2000]
+    m = (s2.merge(s1[["c_customer_id", "total"]], on="c_customer_id",
+                  suffixes=("", "_s1"))
+         .merge(w1[["c_customer_id", "total"]].rename(
+             columns={"total": "total_w1"}), on="c_customer_id")
+         .merge(w2[["c_customer_id", "total"]].rename(
+             columns={"total": "total_w2"}), on="c_customer_id"))
+    m = m[(m.total_s1 > 0) & (m.total_w1 > 0)]
+    m = m[_ratio32(m.total_w2, m.total_w1) > _ratio32(m.total, m.total_s1)]
+    m = m.sort_values(["c_customer_id", "c_first_name", "c_last_name",
+                       "c_email_address"], kind="stable").head(100)
+    return m.rename(columns={
+        "c_customer_id": "customer_id",
+        "c_first_name": "customer_first_name",
+        "c_last_name": "customer_last_name",
+        "c_email_address": "customer_email_address",
+    })[["customer_id", "customer_first_name", "customer_last_name",
+        "customer_email_address"]].reset_index(drop=True)
+
+
+def q4(t):
+    def half(j, p):
+        return _cents(((j[f"{p}_ext_list_price"]
+                        - j[f"{p}_ext_wholesale_cost"]
+                        - j[f"{p}_ext_discount_amt"])
+                       + j[f"{p}_ext_sales_price"]) / 2)
+
+    s = _yt(t, "store_sales", "ss_customer_sk", "ss_sold_date_sk",
+            lambda j: half(j, "ss"))
+    c = _yt(t, "catalog_sales", "cs_bill_customer_sk", "cs_sold_date_sk",
+            lambda j: half(j, "cs"))
+    w = _yt(t, "web_sales", "ws_bill_customer_sk", "ws_sold_date_sk",
+            lambda j: half(j, "ws"))
+    m = s[s.d_year == 2000].merge(
+        s[s.d_year == 1999][["c_customer_id", "total"]],
+        on="c_customer_id", suffixes=("", "_s1"))
+    for nm, fr in (("c1", c[c.d_year == 1999]), ("c2", c[c.d_year == 2000]),
+                   ("w1", w[w.d_year == 1999]), ("w2", w[w.d_year == 2000])):
+        m = m.merge(fr[["c_customer_id", "total"]].rename(
+            columns={"total": f"total_{nm}"}), on="c_customer_id")
+    m = m[(m.total_s1 > 0) & (m.total_c1 > 0) & (m.total_w1 > 0)]
+    rc = _ratio32(m.total_c2, m.total_c1)
+    m = m[(rc > _ratio32(m.total, m.total_s1))
+          & (rc > _ratio32(m.total_w2, m.total_w1))]
+    m = m.sort_values(["c_customer_id", "c_first_name", "c_last_name"],
+                      kind="stable").head(100)
+    return m.rename(columns={
+        "c_customer_id": "customer_id",
+        "c_first_name": "customer_first_name",
+        "c_last_name": "customer_last_name",
+    })[["customer_id", "customer_first_name",
+        "customer_last_name"]].reset_index(drop=True)
+
+
+def _date_window(t, lo="2000-08-03", days=30):
+    d = t["date_dim"]
+    return d[(d.d_date >= D(lo))
+             & (d.d_date <= D(lo) + np.timedelta64(days, "D"))][["d_date_sk"]]
+
+
+def q77(t):
+    dd = _date_window(t)
+    ss = (t["store_sales"].merge(dd, left_on="ss_sold_date_sk",
+                                 right_on="d_date_sk")
+          .merge(t["store"], left_on="ss_store_sk", right_on="s_store_sk")
+          .groupby("s_store_sk", as_index=False)
+          .agg(sales=("ss_ext_sales_price", "sum"),
+               profit=("ss_net_profit", "sum")))
+    sr = (t["store_returns"].merge(dd, left_on="sr_returned_date_sk",
+                                   right_on="d_date_sk")
+          .groupby("sr_store_sk", as_index=False)
+          .agg(returns_=("sr_return_amt", "sum"),
+               profit_loss=("sr_net_loss", "sum")))
+    store = ss.merge(sr.dropna(subset=["sr_store_sk"]),
+                     left_on="s_store_sk", right_on="sr_store_sk",
+                     how="left")
+    store = pd.DataFrame({
+        "channel": "store channel", "id": store.s_store_sk,
+        "sales": store.sales, "returns_": store.returns_.fillna(0),
+        "profit": store.profit - store.profit_loss.fillna(0)})
+    cs = (t["catalog_sales"].merge(dd, left_on="cs_sold_date_sk",
+                                   right_on="d_date_sk")
+          .groupby("cs_call_center_sk", as_index=False, dropna=False)
+          .agg(sales=("cs_ext_sales_price", "sum"),
+               profit=("cs_net_profit", "sum")))
+    crj = t["catalog_returns"].merge(dd, left_on="cr_returned_date_sk",
+                                     right_on="d_date_sk")
+    cat = pd.DataFrame({
+        "channel": "catalog channel", "id": cs.cs_call_center_sk,
+        "sales": cs.sales,
+        "returns_": float(crj.cr_return_amount.sum()),
+        "profit": cs.profit - float(crj.cr_net_loss.sum())})
+    wsj = t["web_sales"].merge(dd, left_on="ws_sold_date_sk",
+                               right_on="d_date_sk")
+    ws = (wsj[wsj.ws_web_page_sk.notna()]
+          .groupby("ws_web_page_sk", as_index=False)
+          .agg(sales=("ws_ext_sales_price", "sum"),
+               profit=("ws_net_profit", "sum")))
+    wrj = (t["web_returns"].merge(
+        t["web_sales"][["ws_order_number", "ws_item_sk", "ws_web_page_sk"]],
+        left_on=["wr_order_number", "wr_item_sk"],
+        right_on=["ws_order_number", "ws_item_sk"])
+        .merge(dd, left_on="wr_returned_date_sk", right_on="d_date_sk"))
+    wr = (wrj[wrj.ws_web_page_sk.notna()]
+          .groupby("ws_web_page_sk", as_index=False)
+          .agg(returns_=("wr_return_amt", "sum"),
+               profit_loss=("wr_net_loss", "sum")))
+    web = ws.merge(wr, on="ws_web_page_sk", how="left")
+    web = pd.DataFrame({
+        "channel": "web channel", "id": web.ws_web_page_sk,
+        "sales": web.sales, "returns_": web.returns_.fillna(0),
+        "profit": web.profit - web.profit_loss.fillna(0)})
+    x = pd.concat([store, cat, web], ignore_index=True)
+    detail = x.groupby(["channel", "id"], as_index=False, dropna=False)[
+        ["sales", "returns_", "profit"]].sum()
+    sub = x.groupby(["channel"], as_index=False)[
+        ["sales", "returns_", "profit"]].sum()
+    sub["id"] = None
+    grand = x[["sales", "returns_", "profit"]].sum().to_frame().T
+    grand["channel"] = None
+    grand["id"] = None
+    u = pd.concat([detail, sub, grand], ignore_index=True)
+    u = u.sort_values("sales", kind="stable")
+    u = u.sort_values("id", na_position="last", kind="stable")
+    u = u.sort_values("channel", na_position="last", kind="stable")
+    return u[["channel", "id", "sales", "returns_",
+              "profit"]].head(100).reset_index(drop=True)
+
+
+def _q80_channel(t, tbl, rtbl, sale_keys, ret_keys, ret_amt, ret_loss,
+                 date_col, loc_join, loc_id, promo_col, chan, sales_col,
+                 profit_col):
+    dd = _date_window(t)
+    j = t[tbl].merge(t[rtbl][ret_keys + [ret_amt, ret_loss]],
+                     left_on=sale_keys, right_on=ret_keys, how="left")
+    j = j.merge(dd, left_on=date_col, right_on="d_date_sk")
+    j = j.merge(t[loc_join[0]], left_on=loc_join[1], right_on=loc_join[2])
+    it = t["item"][t["item"].i_current_price > 50]
+    j = j.merge(it[["i_item_sk"]], left_on=sale_keys[0],
+                right_on="i_item_sk")
+    pr = t["promotion"][t["promotion"].p_channel_tv == "N"]
+    j = j.merge(pr[["p_promo_sk"]], left_on=promo_col,
+                right_on="p_promo_sk")
+    j = j.assign(ret_=j[ret_amt].fillna(0),
+                 prof_=j[profit_col] - j[ret_loss].fillna(0))
+    g = j.groupby(loc_id, as_index=False).agg(
+        sales=(sales_col, "sum"), returns_=("ret_", "sum"),
+        profit=("prof_", "sum"))
+    return pd.DataFrame({"channel": chan, "id": g[loc_id],
+                         "sales": g.sales, "returns_": g.returns_,
+                         "profit": g.profit})
+
+
+def q80(t):
+    store = _q80_channel(
+        t, "store_sales", "store_returns",
+        ["ss_item_sk", "ss_ticket_number"],
+        ["sr_item_sk", "sr_ticket_number"], "sr_return_amt", "sr_net_loss",
+        "ss_sold_date_sk", ("store", "ss_store_sk", "s_store_sk"),
+        "s_store_id", "ss_promo_sk", "store channel",
+        "ss_ext_sales_price", "ss_net_profit")
+    cat = _q80_channel(
+        t, "catalog_sales", "catalog_returns",
+        ["cs_item_sk", "cs_order_number"],
+        ["cr_item_sk", "cr_order_number"], "cr_return_amount",
+        "cr_net_loss", "cs_sold_date_sk",
+        ("call_center", "cs_call_center_sk", "cc_call_center_sk"),
+        "cc_call_center_id", "cs_promo_sk", "catalog channel",
+        "cs_ext_sales_price", "cs_net_profit")
+    web = _q80_channel(
+        t, "web_sales", "web_returns",
+        ["ws_item_sk", "ws_order_number"],
+        ["wr_item_sk", "wr_order_number"], "wr_return_amt", "wr_net_loss",
+        "ws_sold_date_sk", ("web_site", "ws_web_site_sk", "web_site_sk"),
+        "web_site_id", "ws_promo_sk", "web channel",
+        "ws_ext_sales_price", "ws_net_profit")
+    x = pd.concat([store, cat, web], ignore_index=True)
+    detail = x.groupby(["channel", "id"], as_index=False, dropna=False)[
+        ["sales", "returns_", "profit"]].sum()
+    sub = x.groupby(["channel"], as_index=False)[
+        ["sales", "returns_", "profit"]].sum()
+    sub["id"] = None
+    grand = x[["sales", "returns_", "profit"]].sum().to_frame().T
+    grand["channel"] = None
+    grand["id"] = None
+    u = pd.concat([detail, sub, grand], ignore_index=True)
+    u = u.sort_values("sales", kind="stable")
+    u = u.sort_values("id", na_position="last", kind="stable")
+    u = u.sort_values("channel", na_position="last", kind="stable")
+    return u[["channel", "id", "sales", "returns_",
+              "profit"]].head(100).reset_index(drop=True)
+
+
+def _q75_channel(t, tbl, item_col, date_col, ret_tbl, sale_ret_keys,
+                 qty_col, amt_col, rqty_col, ramt_col):
+    j = t[tbl].merge(t["item"], left_on=item_col, right_on="i_item_sk")
+    j = j[j.i_category == "Books"]
+    j = j.merge(t["date_dim"][["d_date_sk", "d_year"]], left_on=date_col,
+                right_on="d_date_sk")
+    j = j.merge(t[ret_tbl][list(sale_ret_keys[1]) + [rqty_col, ramt_col]],
+                left_on=list(sale_ret_keys[0]),
+                right_on=list(sale_ret_keys[1]), how="left")
+    out = pd.DataFrame({
+        "d_year": j.d_year, "i_brand_id": j.i_brand_id,
+        "i_class_id": j.i_class_id, "i_category_id": j.i_category_id,
+        "i_manufact_id": j.i_manufact_id,
+        "sales_cnt": j[qty_col] - j[rqty_col].fillna(0),
+        "sales_amt": j[amt_col] - j[ramt_col].fillna(0),
+    })
+    return out
+
+
+def q75(t):
+    cat = _q75_channel(t, "catalog_sales", "cs_item_sk", "cs_sold_date_sk",
+                       "catalog_returns",
+                       (("cs_order_number", "cs_item_sk"),
+                        ("cr_order_number", "cr_item_sk")),
+                       "cs_quantity", "cs_ext_sales_price",
+                       "cr_return_quantity", "cr_return_amount")
+    st = _q75_channel(t, "store_sales", "ss_item_sk", "ss_sold_date_sk",
+                      "store_returns",
+                      (("ss_ticket_number", "ss_item_sk"),
+                       ("sr_ticket_number", "sr_item_sk")),
+                      "ss_quantity", "ss_ext_sales_price",
+                      "sr_return_quantity", "sr_return_amt")
+    web = _q75_channel(t, "web_sales", "ws_item_sk", "ws_sold_date_sk",
+                       "web_returns",
+                       (("ws_order_number", "ws_item_sk"),
+                        ("wr_order_number", "wr_item_sk")),
+                       "ws_quantity", "ws_ext_sales_price",
+                       "wr_return_quantity", "wr_return_amt")
+    sd = pd.concat([cat, st, web], ignore_index=True)
+    sd["sales_amt"] = sd.sales_amt.round(2)
+    sd = sd.drop_duplicates()  # UNION dedups
+    g = sd.groupby(["d_year", "i_brand_id", "i_class_id", "i_category_id",
+                    "i_manufact_id"], as_index=False, dropna=False).agg(
+        sales_cnt=("sales_cnt", "sum"), sales_amt=("sales_amt", "sum"))
+    cur = g[g.d_year == 2000]
+    prev = g[g.d_year == 1999]
+    m = cur.merge(prev, on=["i_brand_id", "i_class_id", "i_category_id",
+                            "i_manufact_id"], suffixes=("", "_p"))
+    r = (m.sales_cnt.to_numpy().astype(np.float32)
+         / m.sales_cnt_p.to_numpy().astype(np.float32))
+    m = m[r < 0.9]
+    out = pd.DataFrame({
+        "prev_year": m.d_year_p, "year_": m.d_year,
+        "i_brand_id": m.i_brand_id, "i_class_id": m.i_class_id,
+        "i_category_id": m.i_category_id, "i_manufact_id": m.i_manufact_id,
+        "prev_yr_cnt": m.sales_cnt_p, "curr_yr_cnt": m.sales_cnt,
+        "sales_cnt_diff": m.sales_cnt - m.sales_cnt_p,
+        "sales_amt_diff": m.sales_amt - m.sales_amt_p,
+    })
+    out = out.sort_values(
+        ["sales_cnt_diff", "sales_amt_diff", "i_brand_id", "i_class_id",
+         "i_manufact_id"], kind="stable").head(100)
+    return out.reset_index(drop=True)
+
+
+def _q78_channel(t, tbl, ret_tbl, keys, date_col, year_out, item_out,
+                 cust_src, cust_out, qty, wc, sp, prefix):
+    j = t[tbl].merge(t[ret_tbl][list(keys[1])], left_on=list(keys[0]),
+                     right_on=list(keys[1]), how="left")
+    j = j[j[keys[1][0]].isna()]
+    j = j.merge(t["date_dim"][["d_date_sk", "d_year"]], left_on=date_col,
+                right_on="d_date_sk")
+    g = j.groupby(["d_year", keys[0][1], cust_src], as_index=False,
+                  dropna=False).agg(**{
+                      f"{prefix}_qty": (qty, "sum"),
+                      f"{prefix}_wc": (wc, "sum"),
+                      f"{prefix}_sp": (sp, "sum")})
+    return g.rename(columns={"d_year": year_out, keys[0][1]: item_out,
+                             cust_src: cust_out})
+
+
+def q78(t):
+    ws = _q78_channel(t, "web_sales", "web_returns",
+                      (("ws_order_number", "ws_item_sk"),
+                       ("wr_order_number", "wr_item_sk")),
+                      "ws_sold_date_sk", "ws_sold_year", "ws_item_sk",
+                      "ws_bill_customer_sk", "ws_customer_sk",
+                      "ws_quantity", "ws_wholesale_cost", "ws_sales_price",
+                      "ws")
+    cs = _q78_channel(t, "catalog_sales", "catalog_returns",
+                      (("cs_order_number", "cs_item_sk"),
+                       ("cr_order_number", "cr_item_sk")),
+                      "cs_sold_date_sk", "cs_sold_year", "cs_item_sk",
+                      "cs_bill_customer_sk", "cs_customer_sk",
+                      "cs_quantity", "cs_wholesale_cost", "cs_sales_price",
+                      "cs")
+    ss = _q78_channel(t, "store_sales", "store_returns",
+                      (("ss_ticket_number", "ss_item_sk"),
+                       ("sr_ticket_number", "sr_item_sk")),
+                      "ss_sold_date_sk", "ss_sold_year", "ss_item_sk",
+                      "ss_customer_sk", "ss_customer_sk2",
+                      "ss_quantity", "ss_wholesale_cost", "ss_sales_price",
+                      "ss")
+    ss = ss.rename(columns={"ss_customer_sk2": "ss_customer_sk"})
+    m = ss.merge(
+        ws.dropna(subset=["ws_item_sk", "ws_customer_sk"]),
+        left_on=["ss_sold_year", "ss_item_sk", "ss_customer_sk"],
+        right_on=["ws_sold_year", "ws_item_sk", "ws_customer_sk"],
+        how="left")
+    m = m.merge(
+        cs.dropna(subset=["cs_item_sk", "cs_customer_sk"]),
+        left_on=["ss_sold_year", "ss_item_sk", "ss_customer_sk"],
+        right_on=["cs_sold_year", "cs_item_sk", "cs_customer_sk"],
+        how="left")
+    m = m[(m.ws_qty.fillna(0) > 0) | (m.cs_qty.fillna(0) > 0)]
+    m = m[m.ss_sold_year == 2000]
+    other_qty = m.ws_qty.fillna(0) + m.cs_qty.fillna(0)
+    out = pd.DataFrame({
+        "ss_customer_sk": m.ss_customer_sk,
+        "ratio": (m.ss_qty / other_qty).round(2),
+        "store_qty": m.ss_qty,
+        "store_wholesale_cost": m.ss_wc,
+        "store_sales_price": m.ss_sp,
+        "other_chan_qty": other_qty,
+        "other_chan_wholesale_cost": m.ws_wc.fillna(0) + m.cs_wc.fillna(0),
+        "other_chan_sales_price": m.ws_sp.fillna(0) + m.cs_sp.fillna(0),
+    })
+    out = out.sort_values(
+        ["other_chan_qty", "other_chan_wholesale_cost",
+         "other_chan_sales_price", "ratio"], kind="stable")
+    out = out.sort_values(["store_qty", "store_wholesale_cost",
+                           "store_sales_price"], ascending=False,
+                          kind="stable")
+    out = out.sort_values("ss_customer_sk", kind="stable")
+    return out.head(100).reset_index(drop=True)
+
+
 ORACLES = {
     name: globals()[name]
-    for name in ["q1", "q2", "q3", "q5", "q6", "q7", "q9", "q12", "q13", "q15", "q16", "q17", "q18", "q19",
-                 "q20", "q21", "q22", "q25", "q26", "q28", "q29", "q30", "q31", "q32", "q33",
-                 "q34", "q36", "q37", "q38", "q39", "q40", "q42", "q43", "q44", "q45", "q46", "q47", "q48", "q50",
-                 "q52", "q53", "q55", "q56", "q57", "q59", "q60", "q61", "q62", "q63", "q65", "q68", "q69",
-                 "q71", "q73", "q76", "q79", "q81", "q82", "q85", "q86", "q87", "q88", "q89",
-                 "q90", "q91", "q92", "q93", "q94", "q96", "q98", "q99"]
+    for name in ["q1", "q2", "q3", "q4", "q5", "q6", "q7", "q8", "q9", "q10", "q11", "q12", "q13", "q15", "q16", "q17", "q18", "q19",
+                 "q20", "q21", "q22", "q25", "q26", "q27", "q28", "q29", "q30", "q31", "q32", "q33",
+                 "q34", "q35", "q36", "q37", "q38", "q39", "q40", "q41", "q42", "q43", "q44", "q45", "q46", "q47", "q48", "q50", "q51",
+                 "q52", "q53", "q55", "q56", "q57", "q58", "q59", "q60", "q61", "q62", "q63", "q65", "q66", "q67", "q68", "q69", "q70",
+                 "q71", "q73", "q74", "q75", "q76", "q77", "q78", "q79", "q80", "q81", "q82", "q83", "q84", "q85", "q86", "q87", "q88", "q89",
+                 "q90", "q91", "q92", "q93", "q94", "q96", "q97", "q98", "q99"]
 }

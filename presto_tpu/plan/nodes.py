@@ -91,6 +91,10 @@ class Aggregate(PlanNode):
     #: grouped on (their value is any row's value — legal because a
     #: unique key of their table is among ``keys``)
     passengers: tuple[tuple[str, Expr], ...] = ()
+    #: alternative output-name sets each unique per output row (always
+    #: includes the key names; hidden-PK grouping adds the named-key
+    #: bijection set) — consumed by join unique-build detection
+    unique_sets: tuple[tuple[str, ...], ...] = ()
 
     @property
     def children(self):
@@ -136,7 +140,7 @@ class Join(PlanNode):
 
     left: PlanNode
     right: PlanNode
-    kind: str  # inner | left
+    kind: str  # inner | left | full (right normalizes to left in the analyzer)
     left_keys: tuple[Expr, ...]
     right_keys: tuple[Expr, ...]
     unique: bool

@@ -65,7 +65,7 @@ def prune(node: N.PlanNode, needed: set[str] | None = None) -> N.PlanNode:
         want = _refs([e for _, e in keys] + [e for _, e in pax]
                      + [a.input for a in aggs])
         child = prune(node.child, want)
-        return N.Aggregate(child, keys, aggs, pax)
+        return N.Aggregate(child, keys, aggs, pax, node.unique_sets)
     if isinstance(node, N.Join):
         want = set(needed) if needed is not None else set(node.field_names())
         left_fields = {f.name for f in node.left.fields}

@@ -99,7 +99,7 @@ class Rel:
     plan: N.PlanNode
     scope: Scope
     meta: Optional[TableMeta]  # None for derived tables
-    group_keys: tuple[str, ...] = ()  # internal field names, if grouped subquery
+    group_keys: tuple[tuple[str, ...], ...] = ()  # alternative unique internal-name sets (grouped subquery)
     est_rows: float = 0.0
     filters: list[Expr] = field(default_factory=list)
 
@@ -159,6 +159,22 @@ def collect_aggs(n, out: list[A.FunctionCall]):
 
 
 WINDOW_ONLY_FUNCS = {"rank", "dense_rank", "row_number"}
+
+
+def _collect_grouping_calls(n, out: list):
+    """``grouping(col)`` calls (fold to 0/1 per grouping-set branch)."""
+    if isinstance(n, A.FunctionCall) and n.name == "grouping":
+        if n not in out:
+            out.append(n)
+        return
+    if isinstance(n, (A.Exists, A.InSubquery, A.ScalarSubquery)):
+        return
+    if isinstance(n, A.Node):
+        for v in _ast_fields(n):
+            _collect_grouping_calls(v, out)
+    elif isinstance(n, tuple):
+        for v in n:
+            _collect_grouping_calls(v, out)
 
 
 def collect_windows(n, out: list[A.FunctionCall]):
@@ -453,6 +469,16 @@ class Analyzer:
         for k in all_keys:
             e = self._expr(k, probe_scope, outer, ctes2, [])
             key_null[k] = A.Resolved(Literal(e.dtype, None))
+        wins: list[A.FunctionCall] = []
+        for it in q.select:
+            collect_windows(it.expr, wins)
+        if wins:
+            # window functions rank/aggregate across ALL grouping sets
+            # (q67: rank over the whole rollup), so they cannot run per
+            # branch — hoist them above the union
+            return self._expand_gs_with_windows(
+                q, gs, prefix, all_keys, key_null
+            )
         branches = []
         for s in gs.sets:
             grouped = set(prefix) | set(s)
@@ -490,11 +516,107 @@ class Analyzer:
             ctes=q.ctes,
         )
 
+    def _expand_gs_with_windows(self, q: A.Query, gs, prefix, all_keys,
+                                key_null) -> A.Query:
+        """Grouping sets + window functions: per-branch grouped inner
+        queries (no windows) UNION ALL'd, with the windows applied in an
+        outer query over the union — window partitions/orders see every
+        grouping set at once, matching the reference's GroupIdNode →
+        WindowNode plan order [SURVEY §2.1 planner row].
+
+        Inner branches emit: each grouping key under its terminal
+        column name, every distinct plain-aggregate subtree as
+        ``__agg{i}``, and every ``grouping(...)`` call folded to its
+        per-branch constant as ``__grp{i}``. The outer query is the
+        original select/order/limit with those subtrees replaced by
+        references."""
+        key_items: list[A.Node] = []
+        for k in tuple(prefix) + tuple(all_keys):
+            if k not in key_items:
+                key_items.append(k)
+        for k in key_items:
+            if not isinstance(k, A.Identifier):
+                raise AnalysisError(
+                    "window functions over grouping sets require "
+                    "identifier grouping keys"
+                )
+        key_map = {k: A.Identifier((k.parts[-1],)) for k in key_items}
+
+        aggs: list[A.FunctionCall] = []
+        grps: list[A.FunctionCall] = []
+        for it in q.select:
+            collect_aggs(it.expr, aggs)
+            _collect_grouping_calls(it.expr, grps)
+        for oi in q.order_by:
+            collect_aggs(oi.expr, aggs)
+            _collect_grouping_calls(oi.expr, grps)
+        uniq_aggs: list[A.FunctionCall] = []
+        for a in aggs:
+            if a not in uniq_aggs:
+                uniq_aggs.append(a)
+        uniq_grps: list[A.FunctionCall] = []
+        for g in grps:
+            if g not in uniq_grps:
+                uniq_grps.append(g)
+        agg_map = {a: A.Identifier((f"__agg{i}",))
+                   for i, a in enumerate(uniq_aggs)}
+        grp_map = {g: A.Identifier((f"__grp{i}",))
+                   for i, g in enumerate(uniq_grps)}
+
+        branches = []
+        for s in gs.sets:
+            grouped = set(prefix) | set(s)
+            inner_items = []
+            for k in key_items:
+                e = k if k in grouped else key_null[k]
+                inner_items.append(A.SelectItem(e, k.parts[-1]))
+            for a, ref in agg_map.items():
+                inner_items.append(A.SelectItem(a, ref.parts[0]))
+            for g, ref in grp_map.items():
+                folded = A.NumberLit("0" if g.args[0] in grouped else "1")
+                inner_items.append(A.SelectItem(folded, ref.parts[0]))
+            g_fold = {g: A.NumberLit("0" if g.args[0] in grouped else "1")
+                      for g in uniq_grps}
+            having = q.having
+            if having is not None:
+                having = _substitute_outside_aggs(
+                    substitute_nodes(having, g_fold),
+                    {k: key_null[k] for k in all_keys if k not in grouped},
+                )
+            branches.append(replace(
+                q, select=tuple(inner_items),
+                group_by=tuple(prefix) + tuple(s),
+                having=having, order_by=(), limit=None, ctes=(),
+            ))
+
+        def rewrite(n):
+            return substitute_nodes(
+                substitute_nodes(substitute_nodes(n, agg_map), grp_map),
+                key_map,
+            )
+
+        outer_select = tuple(
+            A.SelectItem(rewrite(it.expr), it.alias) for it in q.select
+        )
+        outer_order = tuple(
+            replace(oi, expr=rewrite(oi.expr)) for oi in q.order_by
+        )
+        inner = A.SetQuery(
+            terms=tuple(branches), ops=("union_all",) * (len(branches) - 1)
+        )
+        return A.Query(
+            select=outer_select,
+            from_=A.SubqueryRelation(inner, self.fresh("gsw")),
+            order_by=outer_order, limit=q.limit, ctes=q.ctes,
+        )
+
     # ------------------------------------------------------------------
     def _analyze_query(
         self, q: A.Query, outer: Scope | None, ctes: dict[str, A.Query]
     ) -> tuple[N.PlanNode, Scope]:
         expanded = self._expand_grouping_sets(q, outer, ctes)
+        if isinstance(expanded, A.Query):
+            return self._analyze_query(expanded, outer, ctes)
         if expanded is not None:
             return self._analyze_setquery(expanded, outer, ctes)
         ctes = dict(ctes)
@@ -681,6 +803,7 @@ class Analyzer:
             self._add_derived(rels, binding, plan, sub_scope)
             return
         if isinstance(rel, A.Join):
+            l0 = len(rels)
             self._flatten_from(rel.left, rels, edges, ctes, outer)
             nleft = len(rels)
             self._flatten_from(rel.right, rels, edges, ctes, outer)
@@ -697,16 +820,71 @@ class Analyzer:
                     bkeys.append(pair[1])
                 else:
                     res.append(c)
+            kind = rel.kind
+            # relations on the NULL-extended side(s) of an outer join:
+            # WHERE conjuncts over them must stay post-join filters —
+            # pushing them into the scan would change outer-join
+            # semantics (q78's `where wr_order_number is null`)
+            nullable: set[int] = set()
+            if kind in ("left", "full"):
+                nullable |= set(range(nleft, len(rels)))
+            if kind in ("right", "full"):
+                nullable |= set(range(l0, nleft))
+            if kind == "right":
+                # A RIGHT JOIN B == B LEFT JOIN A: swap the key
+                # orientation (akeys are spine-side) and record a left
+                # join — the join-tree builder then forces the spine to
+                # the preserved (original right) side.
+                akeys, bkeys = bkeys, akeys
+                kind = "left"
             edges.append(
-                dict(kind=rel.kind, left=nleft, akeys=akeys, bkeys=bkeys, residual=res)
+                dict(kind=kind, left=nleft, akeys=akeys, bkeys=bkeys,
+                     residual=res, nullable=nullable)
             )
             return
         raise AnalysisError(f"unsupported relation {type(rel).__name__}")
 
+    def _agg_key_outputs(self, node) -> tuple[tuple[str, ...], ...]:
+        """Alternative output-name sets (at ``node``'s level) each
+        unique per row of an Aggregate below — possibly through Project
+        renames / Filters. () when not provably grouped-unique."""
+        mappings: list[dict[str, str]] = []  # out name -> in name
+        while True:
+            if isinstance(node, N.Filter):
+                node = node.child
+                continue
+            if isinstance(node, N.Project):
+                mappings.append({
+                    n2: e.name for n2, e in node.exprs
+                    if isinstance(e, InputRef)
+                })
+                node = node.child
+                continue
+            break
+        if not isinstance(node, N.Aggregate):
+            return ()
+        sets = list(node.unique_sets) or [tuple(n for n, _ in node.keys)]
+        out: list[tuple[str, ...]] = []
+        for names in sets:
+            names = list(names)
+            ok = True
+            for m in reversed(mappings):
+                inv: dict[str, str] = {}
+                for out_n, in_n in m.items():
+                    inv.setdefault(in_n, out_n)
+                mapped = [inv.get(n) for n in names]
+                if any(n is None for n in mapped):
+                    ok = False  # a member is not exposed upward
+                    break
+                names = mapped
+            if ok:
+                out.append(tuple(names))
+        return tuple(out)
+
     def _add_derived(self, rels, binding, plan, sub_scope):
-        group_keys = ()
-        if isinstance(plan, N.Output) and isinstance(plan.child, N.Aggregate):
-            group_keys = tuple(n for n, _ in plan.child.keys)
+        group_keys = self._agg_key_outputs(
+            plan.child if isinstance(plan, N.Output) else plan
+        )
         # strip Output: keep the projected child, re-projected to FRESH
         # internal names — two derived tables exposing the same client
         # column name (q65's sb/sc both expose ss_store_sk) must not
@@ -724,7 +902,9 @@ class Analyzer:
                 iname_of.setdefault(s, iname)
             inner = N.Project(inner, tuple(exprs))
             if group_keys:
-                group_keys = tuple(iname_of.get(k, k) for k in group_keys)
+                group_keys = tuple(
+                    tuple(iname_of.get(k, k) for k in s) for s in group_keys
+                )
         else:
             fields = [
                 FieldRef(f.name, f.dtype, binding, f.name, None)
@@ -773,6 +953,14 @@ class Analyzer:
             return
         owner = self._rel_of(refs, rels)
         if owner is not None:
+            nullable = set()
+            for e2 in edges:
+                nullable |= e2.get("nullable", set())
+            if owner in nullable:
+                # nullable-side predicate: SQL applies it AFTER the
+                # outer join (it sees the null-extended rows)
+                residual.append(c)
+                return
             e = self._expr(c, rels[owner].scope, outer, ctes, [])
             rels[owner].filters.append(e)
             rels[owner].est_rows *= _estimate_selectivity(e)
@@ -877,8 +1065,10 @@ class Analyzer:
                                  residual=e["residual"]))
         edges = norm
 
-        # pick the spine: left side of a LEFT join wins, else largest
-        forced = [e["pair"][0] for e in edges if e["kind"] == "left"]
+        # pick the spine: preserved side of a LEFT/FULL join wins, else
+        # largest (for FULL the probe side is the spine; the build side's
+        # unmatched rows are emitted by the kernel's tail pass)
+        forced = [e["pair"][0] for e in edges if e["kind"] in ("left", "full")]
         if forced:
             spine = forced[0]
         else:
@@ -931,8 +1121,8 @@ class Analyzer:
                 p2 = e2["pair"]
                 if set(p2) <= joined | {bidx} and bidx in p2:
                     used.append(e2)
-                    if e2["kind"] == "left":
-                        kind = "left"
+                    if e2["kind"] in ("left", "full"):
+                        kind = e2["kind"]
                     on_residual.extend(e2.get("residual", ()))
                     for ak, bk in zip(e2["akeys"], e2["bkeys"]):
                         # orient: probe key in joined set, build key in bidx
@@ -964,7 +1154,7 @@ class Analyzer:
                         "not supported"
                     )
             build_rel = rels[bidx]
-            unique = self._is_unique_key(build_rel, [k.column for k in bkeys])
+            unique = self._is_unique_key(build_rel, bkeys)
             plan = N.Join(
                 plan,
                 plans[bidx],
@@ -983,13 +1173,24 @@ class Analyzer:
                 plan = N.Filter(plan, self._expr(c, Scope(cur_fields), None, {}, []))
         return plan
 
-    def _is_unique_key(self, rel: Rel, cols: list[str]) -> bool:
-        colset = set(cols)
+    def _is_unique_key(self, rel: Rel, keys: list[FieldRef]) -> bool:
+        # meta unique_keys name SOURCE columns (FieldRef.column);
+        # derived-rel group_keys holds ALTERNATIVE unique sets of
+        # INTERNAL field names (FieldRef.name) from _agg_key_outputs
+        colset = {k.column for k in keys} | {k.name for k in keys}
+        # a pushdown equality-literal filter pins a column to one value,
+        # so it counts toward uniqueness (q74: each year_total instance
+        # is filtered to one sale_type and one year)
+        for e in rel.filters:
+            if isinstance(e, Call) and e.fn == "eq":
+                a, b = e.args
+                if isinstance(a, InputRef) and isinstance(b, Literal):
+                    colset.add(a.name)
+                elif isinstance(b, InputRef) and isinstance(a, Literal):
+                    colset.add(b.name)
         if rel.meta is not None:
             return any(set(uk) <= colset for uk in rel.meta.unique_keys)
-        if rel.group_keys:
-            return set(rel.group_keys) <= colset
-        return False
+        return any(set(s) <= colset for s in rel.group_keys)
 
     # ------------------------------------------------------------------
     # subquery predicates
@@ -1048,7 +1249,99 @@ class Analyzer:
                     node.op, other, sub.query, negated, flip, plan, scope, outer,
                     ctes, scalar_binds,
                 )
+        if isinstance(node, A.BinaryOp) and node.op in ("or", "and") and not negated:
+            # boolean combination containing EXISTS leaves (TPC-DS
+            # q10/q35 `exists(web) or exists(catalog)`): mark-join
+            # rewrite — each EXISTS becomes a boolean mark column via a
+            # dedup'd LEFT join (reference: MarkDistinct/mark joins in
+            # the subquery planner [SURVEY §2.1 operator row])
+            return self._apply_mark_bool(node, plan, scope, outer, ctes,
+                                         scalar_binds)
         raise AnalysisError(f"unsupported subquery predicate: {type(node).__name__}")
+
+    def _apply_mark_bool(self, c, plan, scope, outer, ctes, scalar_binds):
+        """Rewrite a boolean expression whose subquery leaves are all
+        positive equality-correlated EXISTS: each leaf adds a mark
+        column to ``plan``; the expression is then a plain filter."""
+        added: list[FieldRef] = []
+
+        def walk(n):
+            nonlocal plan
+            if isinstance(n, A.Exists):
+                if n.negated:
+                    raise AnalysisError(
+                        "NOT EXISTS inside OR predicates is not supported"
+                    )
+                plan, mark = self._plan_exists_mark(
+                    self._as_plain_query(n.query), plan, scope, ctes
+                )
+                added.append(mark)
+                return A.Identifier((mark.column,))
+            if isinstance(n, (A.InSubquery, A.ScalarSubquery)):
+                raise AnalysisError(
+                    "only EXISTS is supported inside OR predicates"
+                )
+            if isinstance(n, A.BinaryOp):
+                return A.BinaryOp(n.op, walk(n.left), walk(n.right))
+            if isinstance(n, A.UnaryOp):
+                return A.UnaryOp(n.op, walk(n.operand))
+            return n
+
+        new_ast = walk(c)
+        ext = Scope(list(scope.fields) + added)
+        pred = self._expr(new_ast, ext, outer, ctes, scalar_binds)
+        return N.Filter(plan, pred)
+
+    def _plan_exists_mark(self, sub_q: A.Query, plan, scope, ctes):
+        """Plan one EXISTS as a mark: dedup the inner correlation keys
+        (GROUP BY -> unique build), LEFT-join them onto ``plan``, and
+        project a BOOLEAN mark = key-matched. Returns (plan, mark_field)."""
+        probe = self._inner_scope_probe(sub_q, ctes)
+        new_where, corr, neq = self._split_correlation(sub_q, probe, scope, ctes)
+        if not corr or neq:
+            raise AnalysisError(
+                "EXISTS inside OR must be equality-correlated"
+            )
+        inner_cols = tuple(A.Identifier(ip) for _, ip in corr)
+        rewritten = A.Query(
+            select=tuple(A.SelectItem(ic, None) for ic in inner_cols),
+            from_=sub_q.from_, where=new_where, group_by=inner_cols,
+        )
+        sub_plan, _ = self._analyze_query(rewritten, None, ctes)
+        inner = sub_plan.child if isinstance(sub_plan, N.Output) else sub_plan
+        sources = (sub_plan.sources if isinstance(sub_plan, N.Output)
+                   else inner.field_names())
+        imap = {f.name: f for f in inner.fields}
+        carried = self.fresh("mark")
+        ren = N.Project(
+            inner,
+            tuple(
+                (carried if f.name == sources[0] else f.name,
+                 InputRef(f.dtype, f.name))
+                for f in inner.fields
+            ),
+        )
+        right_keys = tuple(
+            InputRef(imap[s].dtype, carried if i == 0 else s)
+            for i, s in enumerate(sources)
+        )
+        left_keys = tuple(
+            InputRef(scope.resolve(op_).dtype, scope.resolve(op_).name)
+            for op_, _ in corr
+        )
+        joined = N.Join(plan, ren, "left", left_keys, right_keys, True,
+                        (carried,))
+        mark_name = self.fresh("markb")
+        kd = imap[sources[0]].dtype
+        exprs = tuple(
+            (f.name, InputRef(f.dtype, f.name))
+            for f in joined.fields if f.name != carried
+        ) + ((mark_name, Call(BOOLEAN, "is_not_null",
+                              (InputRef(kd, carried),))),)
+        return (
+            N.Project(joined, exprs),
+            FieldRef(mark_name, BOOLEAN, "", mark_name, None),
+        )
 
     def _split_correlation(self, q: A.Query, inner_scope_probe, outer_scope: Scope,
                            ctes):
@@ -1304,8 +1597,23 @@ class Analyzer:
 
         # functional dependencies: keys covered by a unique key of the
         # same relation instance become passengers (Q10/Q18 shape)
-        grouping, passengers = self._split_passengers(keys, scope)
-        agg = N.Aggregate(plan, tuple(grouping), tuple(specs), tuple(passengers))
+        grouping, passengers, bij_subst = self._split_passengers(keys, scope)
+        key_names = tuple(n for n, _ in grouping)
+        unique_sets = [key_names]
+        if bij_subst:
+            # substitute each hidden-PK group by its bijective named keys
+            alt: list[str] = []
+            consumed: set[str] = set()
+            for hn, named in bij_subst.items():
+                consumed |= set(hn)
+            for n in key_names:
+                if n not in consumed:
+                    alt.append(n)
+            for hn, named in bij_subst.items():
+                alt.extend(named)
+            unique_sets.append(tuple(alt))
+        agg = N.Aggregate(plan, tuple(grouping), tuple(specs),
+                          tuple(passengers), tuple(unique_sets))
         new_scope = Scope(
             [FieldRef(n, e.dtype, self._binding_of(scope, n), self._column_of(scope, n),
                       self._table_of(scope, n))
@@ -1324,6 +1632,7 @@ class Analyzer:
             by_binding.setdefault(b, []).append((n, e))
         grouping: list[tuple[str, Expr]] = []
         passengers: list[tuple[str, Expr]] = []
+        bij_subst: dict[tuple[str, ...], tuple[str, ...]] = {}
 
         def narrow(t: DataType) -> bool:
             return not (t.kind is TypeKind.BYTES and t.width > 7)
@@ -1413,12 +1722,24 @@ class Analyzer:
                 for f in hidden:
                     grouping.append((f.name, InputRef(f.dtype, f.name)))
                 passengers.extend(ks)
+                # bijection: named-key groups == hidden-PK groups, so
+                # the named keys covering a unique key of the relation
+                # (the smallest covered one — tighter unique sets make
+                # more joins provably unique) substitute for the hidden
+                # PK in the alternative unique set
+                cover = min(
+                    (set(uk) for uk in uks if set(uk) <= cols),
+                    key=len,
+                )
+                bij_subst[tuple(f.name for f in hidden)] = tuple(
+                    n for n, _ in ks if fmap[n].column in cover
+                )
                 continue
             grouping.extend(ks)
         # wide BYTES group keys are supported directly (chunked int64
         # surrogates); the unique-key/FD demotions above remain as
         # optimizations, not requirements
-        return grouping, passengers
+        return grouping, passengers, bij_subst
 
     def _binding_of(self, scope, name):
         for f in scope.fields:
@@ -1616,6 +1937,12 @@ class Analyzer:
             f = out_scope.try_resolve(e.parts)
             if f is not None:
                 return InputRef(f.dtype, f.name)
+            if src_map:
+                # ORDER BY a source column that the select ALIASES
+                # (ORDER BY c_customer_id with `c_customer_id as id`)
+                f = pre_scope.try_resolve(e.parts)
+                if f is not None and f.name in src_map:
+                    return InputRef(f.dtype, src_map[f.name])
         if isinstance(e, A.Identifier) and len(e.parts) > 1 and src_map:
             # qualified ref (ORDER BY t.col): resolve in the FROM scope,
             # then map back to the output column that projects it — the
@@ -1670,6 +1997,27 @@ class Analyzer:
                 l = self._expr(n.left, scope, outer, ctes, scalar_binds, agg_map, key_map)
                 r = self._expr(n.right, scope, outer, ctes, scalar_binds, agg_map, key_map)
                 return Call(BOOLEAN, _CMP_OPS[n.op], (l, r))
+            if n.op == "||":
+                l = self._expr(n.left, scope, outer, ctes, scalar_binds, agg_map, key_map)
+                r = self._expr(n.right, scope, outer, ctes, scalar_binds, agg_map, key_map)
+                width = 0
+                args: tuple = ()
+                for side in (l, r):
+                    if side.dtype.kind is TypeKind.BYTES:
+                        width += side.dtype.width
+                    elif (isinstance(side, Literal)
+                          and side.dtype.kind is TypeKind.VARCHAR):
+                        width += len(side.value)
+                    else:
+                        raise AnalysisError("|| requires string operands")
+                    # flatten chained concats into one Call
+                    if isinstance(side, Call) and side.fn == "concat":
+                        args += side.args
+                    else:
+                        args += (side,)
+                from presto_tpu.types import fixed_bytes
+
+                return Call(fixed_bytes(width), "concat", args)
             if n.op in _ARITH_OPS:
                 # date +/- interval folding
                 folded = self._fold_date_arith(n, scope, outer, ctes, scalar_binds,
@@ -1744,6 +2092,11 @@ class Analyzer:
             if n.name == "abs":
                 v = self._expr(n.args[0], scope, outer, ctes, scalar_binds, agg_map, key_map)
                 return Call(v.dtype, "abs", (v,))
+            if n.name in ("upper", "lower"):
+                v = self._expr(n.args[0], scope, outer, ctes, scalar_binds, agg_map, key_map)
+                if v.dtype.kind is not TypeKind.BYTES:
+                    raise AnalysisError(f"{n.name}() requires a BYTES string")
+                return Call(v.dtype, n.name, (v,))
             if n.name in ("sqrt", "floor", "ceil", "ceiling"):
                 v = self._expr(n.args[0], scope, outer, ctes, scalar_binds, agg_map, key_map)
                 fn = "ceil" if n.name == "ceiling" else n.name

@@ -182,4 +182,10 @@ def common_super_type(a: DataType, b: DataType) -> DataType:
         return hi
     if a.kind is TypeKind.DATE and b.kind is TypeKind.DATE:
         return a
+    # a string literal (VARCHAR) coerces to the peer fixed-width BYTES
+    # type (coalesce(bytes_col, '') — the literal is space-padded)
+    if a.kind is TypeKind.BYTES and b.kind is TypeKind.VARCHAR:
+        return a
+    if b.kind is TypeKind.BYTES and a.kind is TypeKind.VARCHAR:
+        return b
     raise TypeError(f"no common super type for {a} and {b}")

@@ -219,3 +219,118 @@ def test_sql_join_uses_dense_when_stats_bound_the_key():
     finally:
         LP.LocalExecutor._dense_domain = orig_dd
     pd.testing.assert_frame_equal(got, want)
+
+
+# ---------------------------------------------------------------------------
+# FULL OUTER JOIN (reference: LookupJoin unmatched-build emission half
+# [SURVEY §2.1 operator row])
+# ---------------------------------------------------------------------------
+
+
+def _run_full(unique: bool, probe_batches=None):
+    from presto_tpu.exec.joins import full_init_flags, full_tail
+
+    b = JoinBuildOperator(col("bk", BIGINT))
+    Pipeline(BatchSource([build_batch()]), [b]).run()
+    outs = [BuildOutput("bval", "bval"), BuildOutput("bk", "bk")]
+    j = LookupJoinOperator(
+        b, col("pk", BIGINT), outs, "full", unique=unique,
+        out_capacity=None if unique else 32,
+    )
+    flags = full_init_flags(b)
+    rows = []
+    schema = None
+    for pb in (probe_batches or [probe_batch()]):
+        out, flags = j.process_full(pb, flags)
+        schema = pb
+        rows.append(out)
+    rows.append(full_tail(b, outs, flags, schema))
+    recs = []
+    for out in rows:
+        live = np.asarray(out.live)
+        cols = {n: (np.asarray(out[n].data), np.asarray(out[n].valid))
+                for n in out.names}
+        for i in np.nonzero(live)[0]:
+            recs.append({
+                n: (None if not v[i] else int(d[i]))
+                for n, (d, v) in cols.items()
+            })
+    return recs
+
+
+@pytest.mark.parametrize("unique", [True, False])
+def test_full_outer_join(unique):
+    recs = _run_full(unique)
+    # probe keys [5,2,3,7,9,1]; build keys [1,3,5,7]: all four build
+    # rows match -> probe-aligned rows plus NO tail rows
+    got = sorted((r["pk"], r["bk"], r["bval"]) for r in recs)
+    assert got == [
+        (1, 1, 10), (2, None, None), (3, 3, 30),
+        (5, 5, 50), (7, 7, 70), (9, None, None),
+    ]
+
+
+@pytest.mark.parametrize("unique", [True, False])
+def test_full_outer_join_unmatched_build(unique):
+    # probe only keys {3, 8}: build rows 1,5,7 are unmatched -> emitted
+    # by the tail with NULL probe columns
+    pb = _batch(
+        {"pk": np.array([3, 8], dtype=np.int64),
+         "pval": np.array([300, 800], dtype=np.int64)},
+        {"pk": BIGINT, "pval": BIGINT}, cap=4,
+    )
+    recs = _run_full(unique, [pb])
+    got = sorted(
+        ((r["pk"] or -1), (r["bk"] or -1), (r["bval"] or -1)) for r in recs
+    )
+    assert got == [
+        (-1, 1, 10), (-1, 5, 50), (-1, 7, 70), (3, 3, 30), (8, -1, -1),
+    ]
+
+
+def test_full_outer_multi_probe_batches_accumulate_flags():
+    pb1 = _batch({"pk": np.array([1, 3], np.int64),
+                  "pval": np.array([1, 3], np.int64)},
+                 {"pk": BIGINT, "pval": BIGINT}, cap=4)
+    pb2 = _batch({"pk": np.array([5, 4], np.int64),
+                  "pval": np.array([5, 4], np.int64)},
+                 {"pk": BIGINT, "pval": BIGINT}, cap=4)
+    recs = _run_full(True, [pb1, pb2])
+    # build key 7 is the only never-matched build row
+    tails = [r for r in recs if r["pk"] is None]
+    assert [(r["bk"], r["bval"]) for r in tails] == [(7, 70)]
+
+
+def test_right_join_sql_matches_left_swapped():
+    from presto_tpu.connectors.tpch import TpchConnector
+    from presto_tpu.runtime.session import Session
+
+    s = Session({"tpch": TpchConnector(sf=0.01)})
+    got = s.sql("select n_name, r_name from region right join nation "
+                "on r_regionkey = n_nationkey order by n_name")
+    want = s.sql("select n_name, r_name from nation left join region "
+                 "on r_regionkey = n_nationkey order by n_name")
+    pd.testing.assert_frame_equal(got, want)
+
+
+def test_full_outer_sql_vs_pandas_oracle():
+    from presto_tpu.connectors.tpch import TpchConnector
+    from presto_tpu.runtime.session import Session
+
+    conn = TpchConnector(sf=0.01)
+    s = Session({"tpch": conn})
+    got = s.sql(
+        "select r_regionkey, n_nationkey from region full outer join nation "
+        "on r_regionkey = n_nationkey order by n_nationkey"
+    )
+    r = conn.table_pandas("region")[["r_regionkey"]]
+    n = conn.table_pandas("nation")[["n_nationkey"]]
+    want = r.merge(n, left_on="r_regionkey", right_on="n_nationkey",
+                   how="outer").sort_values("n_nationkey")
+    assert len(got) == len(want)
+    np.testing.assert_array_equal(
+        got["n_nationkey"].to_numpy(), want["n_nationkey"].to_numpy()
+    )
+    np.testing.assert_array_equal(
+        got["r_regionkey"].isna().to_numpy(), want["r_regionkey"].isna().to_numpy()
+    )
