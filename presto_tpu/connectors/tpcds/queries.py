@@ -795,7 +795,7 @@ where d_date between date '2000-03-01' and date '2000-06-30'
   and ws1.ws_ship_date_sk = d_date_sk
   and ws1.ws_ship_addr_sk = ca_address_sk
   and ws1.ws_web_site_sk = web_site_sk
-  and web_company_name = 'pri'
+  and web_company_name = 'able'
   and exists (select * from web_sales ws2
               where ws1.ws_warehouse_sk = ws2.ws_warehouse_sk
                 and ws1.ws_order_number <> ws2.ws_order_number)
@@ -2591,6 +2591,143 @@ where (coalesce(ws_qty, 0) > 0 or coalesce(cs_qty, 0) > 0)
 order by ss_customer_sk, ss_qty desc, ss_wc desc, ss_sp desc,
          other_chan_qty, other_chan_wholesale_cost, other_chan_sales_price,
          ratio
+limit 100
+""",
+})
+
+QUERIES.update({
+    # q49: worst return ratios per channel, rank-filtered, UNION dedup
+    # (adaptations: plain division instead of DECIMAL(15,4) casts;
+    # return-amount floor lowered for toy SF)
+    "q49": """
+select channel, item, return_ratio, return_rank, currency_rank
+from ((select 'web' as channel, web.item, web.return_ratio,
+              web.return_rank, web.currency_rank
+       from (select item, return_ratio, currency_ratio,
+                    rank() over (order by return_ratio) as return_rank,
+                    rank() over (order by currency_ratio) as currency_rank
+             from (select ws_item_sk as item,
+                          sum(coalesce(wr_return_quantity, 0))
+                            / sum(coalesce(ws_quantity, 0)) as return_ratio,
+                          sum(coalesce(wr_return_amt, 0))
+                            / sum(coalesce(ws_net_paid, 0)) as currency_ratio
+                   from web_sales left outer join web_returns
+                        on ws_order_number = wr_order_number
+                           and ws_item_sk = wr_item_sk, date_dim
+                   where wr_return_amt > 100
+                     and ws_net_profit > 1
+                     and ws_net_paid > 0
+                     and ws_quantity > 0
+                     and ws_sold_date_sk = d_date_sk
+                     and d_year = 2001 and d_moy = 12
+                   group by ws_item_sk) in_web) web
+       where web.return_rank <= 10 or web.currency_rank <= 10)
+      union
+      (select 'catalog' as channel, cat.item, cat.return_ratio,
+              cat.return_rank, cat.currency_rank
+       from (select item, return_ratio, currency_ratio,
+                    rank() over (order by return_ratio) as return_rank,
+                    rank() over (order by currency_ratio) as currency_rank
+             from (select cs_item_sk as item,
+                          sum(coalesce(cr_return_quantity, 0))
+                            / sum(coalesce(cs_quantity, 0)) as return_ratio,
+                          sum(coalesce(cr_return_amount, 0))
+                            / sum(coalesce(cs_net_paid, 0)) as currency_ratio
+                   from catalog_sales left outer join catalog_returns
+                        on cs_order_number = cr_order_number
+                           and cs_item_sk = cr_item_sk, date_dim
+                   where cr_return_amount > 100
+                     and cs_net_profit > 1
+                     and cs_net_paid > 0
+                     and cs_quantity > 0
+                     and cs_sold_date_sk = d_date_sk
+                     and d_year = 2001 and d_moy = 12
+                   group by cs_item_sk) in_cat) cat
+       where cat.return_rank <= 10 or cat.currency_rank <= 10)
+      union
+      (select 'store' as channel, sts.item, sts.return_ratio,
+              sts.return_rank, sts.currency_rank
+       from (select item, return_ratio, currency_ratio,
+                    rank() over (order by return_ratio) as return_rank,
+                    rank() over (order by currency_ratio) as currency_rank
+             from (select ss_item_sk as item,
+                          sum(coalesce(sr_return_quantity, 0))
+                            / sum(coalesce(ss_quantity, 0)) as return_ratio,
+                          sum(coalesce(sr_return_amt, 0))
+                            / sum(coalesce(ss_net_paid, 0)) as currency_ratio
+                   from store_sales left outer join store_returns
+                        on ss_ticket_number = sr_ticket_number
+                           and ss_item_sk = sr_item_sk, date_dim
+                   where sr_return_amt > 100
+                     and ss_net_profit > 1
+                     and ss_net_paid > 0
+                     and ss_quantity > 0
+                     and ss_sold_date_sk = d_date_sk
+                     and d_year = 2001 and d_moy = 12
+                   group by ss_item_sk) in_store) sts
+       where sts.return_rank <= 10 or sts.currency_rank <= 10)) x
+order by 1, 4, 5, 2
+limit 100
+""",
+    # q95: returned orders of multi-warehouse customers for one
+    # state/site over 60 days (adaptations: this generator emits
+    # single-line web orders, so the official per-order warehouse
+    # diversity self-join keys on the billing customer instead; ship
+    # cost column is ws_ext_sales_price — no ws_ext_ship_cost;
+    # state/company constants from the generator)
+    "q95": """
+with ws_wh as (
+  select ws1.ws_order_number, ws1.ws_warehouse_sk as wh1,
+         ws2.ws_warehouse_sk as wh2
+  from web_sales ws1, web_sales ws2
+  where ws1.ws_bill_customer_sk = ws2.ws_bill_customer_sk
+    and ws1.ws_warehouse_sk <> ws2.ws_warehouse_sk)
+select count(distinct ws1.ws_order_number) as order_count,
+       sum(ws_ext_sales_price) as total_shipping_cost,
+       sum(ws_net_profit) as total_net_profit
+from web_sales ws1, date_dim, customer_address, web_site
+where d_date between date '2000-02-01' and date '2000-02-01' + 60
+  and ws1.ws_ship_date_sk = d_date_sk
+  and ws1.ws_ship_addr_sk = ca_address_sk
+  and ca_state = 'AR'
+  and ws1.ws_web_site_sk = web_site_sk
+  and web_company_name = 'able'
+  and ws1.ws_order_number in (select ws_order_number from ws_wh)
+  and ws1.ws_order_number in (select wr_order_number
+                              from web_returns, ws_wh
+                              where wr_order_number = ws_wh.ws_order_number)
+limit 100
+""",
+    # q72: catalog orders promised from low stock: inventory of the
+    # sale week below the ordered quantity, shipped 5+ days late
+    # (adaptation: household demographics reach the sale via the
+    # billing customer — no cs_bill_hdemo_sk in this generator)
+    "q72": """
+select i_item_desc, w_warehouse_name, d1.d_week_seq,
+       sum(case when p_promo_sk is null then 1 else 0 end) as no_promo,
+       sum(case when p_promo_sk is not null then 1 else 0 end) as promo,
+       count(*) as total_cnt
+from catalog_sales
+     join inventory on cs_item_sk = inv_item_sk
+     join warehouse on w_warehouse_sk = inv_warehouse_sk
+     join item on i_item_sk = cs_item_sk
+     join customer_demographics on cs_bill_cdemo_sk = cd_demo_sk
+     join customer on cs_bill_customer_sk = c_customer_sk
+     join household_demographics on c_current_hdemo_sk = hd_demo_sk
+     join date_dim d1 on cs_sold_date_sk = d1.d_date_sk
+     join date_dim d2 on inv_date_sk = d2.d_date_sk
+     join date_dim d3 on cs_ship_date_sk = d3.d_date_sk
+     left outer join promotion on cs_promo_sk = p_promo_sk
+     left outer join catalog_returns on cr_item_sk = cs_item_sk
+                                        and cr_order_number = cs_order_number
+where d1.d_week_seq = d2.d_week_seq
+  and inv_quantity_on_hand < cs_quantity
+  and d3.d_date > d1.d_date + 5
+  and hd_buy_potential = '>10000'
+  and d1.d_year = 2000
+  and cd_marital_status = 'D'
+group by i_item_desc, w_warehouse_name, d1.d_week_seq
+order by total_cnt desc, i_item_desc, w_warehouse_name, d_week_seq
 limit 100
 """,
 })

@@ -2489,12 +2489,133 @@ def q78(t):
     return out.head(100).reset_index(drop=True)
 
 
+def _q49_channel(t, tbl, rtbl, skeys, rkeys, qty, rqty, paid, ramt,
+                 profit, chan):
+    d = t["date_dim"]
+    dd = d[(d.d_year == 2001) & (d.d_moy == 12)][["d_date_sk"]]
+    j = t[tbl].merge(t[rtbl][rkeys + [rqty, ramt]], left_on=skeys,
+                     right_on=rkeys, how="left")
+    j = j.merge(dd, left_on=f"{skeys[1].split('_')[0]}_sold_date_sk",
+                right_on="d_date_sk")
+    j = j[(j[ramt] > 100) & (j[profit] > 1) & (j[paid] > 0)
+          & (j[qty] > 0)]
+    g = j.groupby(skeys[1], as_index=False).agg(
+        rq=(rqty, lambda s: s.fillna(0).sum()), q=(qty, "sum"),
+        ra=(ramt, lambda s: s.fillna(0).sum()), p=(paid, "sum"))
+    f32 = lambda s: s.to_numpy().astype(np.float32)  # noqa: E731
+    g["return_ratio"] = f32(g.rq) / f32(g.q)
+    g["currency_ratio"] = f32(g.ra) / f32(g.p)
+    g["return_rank"] = g.return_ratio.rank(method="min").astype(int)
+    g["currency_rank"] = g.currency_ratio.rank(method="min").astype(int)
+    g = g[(g.return_rank <= 10) | (g.currency_rank <= 10)]
+    return pd.DataFrame({"channel": chan, "item": g[skeys[1]],
+                         "return_ratio": g.return_ratio,
+                         "currency_rank": g.currency_rank,
+                         "return_rank": g.return_rank})
+
+
+def q49(t):
+    web = _q49_channel(t, "web_sales", "web_returns",
+                       ["ws_order_number", "ws_item_sk"],
+                       ["wr_order_number", "wr_item_sk"],
+                       "ws_quantity", "wr_return_quantity", "ws_net_paid",
+                       "wr_return_amt", "ws_net_profit", "web")
+    cat = _q49_channel(t, "catalog_sales", "catalog_returns",
+                       ["cs_order_number", "cs_item_sk"],
+                       ["cr_order_number", "cr_item_sk"],
+                       "cs_quantity", "cr_return_quantity", "cs_net_paid",
+                       "cr_return_amount", "cs_net_profit", "catalog")
+    st = _q49_channel(t, "store_sales", "store_returns",
+                      ["ss_ticket_number", "ss_item_sk"],
+                      ["sr_ticket_number", "sr_item_sk"],
+                      "ss_quantity", "sr_return_quantity", "ss_net_paid",
+                      "sr_return_amt", "ss_net_profit", "store")
+    u = pd.concat([web, cat, st], ignore_index=True)
+    u["return_ratio"] = u.return_ratio.round(6)
+    u = u.drop_duplicates()
+    u = u.sort_values(["channel", "return_rank", "currency_rank", "item"],
+                      kind="stable").head(100)
+    return u[["channel", "item", "return_ratio", "return_rank",
+              "currency_rank"]].reset_index(drop=True)
+
+
+def q95(t):
+    ws = t["web_sales"]
+    pairs = ws[["ws_order_number", "ws_bill_customer_sk",
+                "ws_warehouse_sk"]].merge(
+        ws[["ws_bill_customer_sk", "ws_warehouse_sk"]],
+        on="ws_bill_customer_sk", suffixes=("1", "2"))
+    multi_wh = set(pairs[pairs.ws_warehouse_sk1
+                         != pairs.ws_warehouse_sk2].ws_order_number)
+    d = t["date_dim"]
+    dd = d[(d.d_date >= D("2000-02-01"))
+           & (d.d_date <= D("2000-02-01") + np.timedelta64(60, "D"))][
+        ["d_date_sk"]]
+    j = ws.merge(dd, left_on="ws_ship_date_sk", right_on="d_date_sk")
+    ca = t["customer_address"]
+    j = j.merge(ca[ca.ca_state.str.strip() == "AR"][["ca_address_sk"]],
+                left_on="ws_ship_addr_sk", right_on="ca_address_sk")
+    wsit = t["web_site"]
+    j = j.merge(wsit[wsit.web_company_name.str.strip() == "able"][
+        ["web_site_sk"]], left_on="ws_web_site_sk", right_on="web_site_sk")
+    j = j[j.ws_order_number.isin(multi_wh)]
+    returned = set(t["web_returns"].wr_order_number.dropna()) & multi_wh
+    j = j[j.ws_order_number.isin(returned)]
+    return pd.DataFrame({
+        "order_count": [j.ws_order_number.nunique()],
+        "total_shipping_cost": [j.ws_ext_sales_price.sum()],
+        "total_net_profit": [j.ws_net_profit.sum()],
+    })
+
+
+def q72(t):
+    d = t["date_dim"][["d_date_sk", "d_week_seq", "d_year", "d_date"]]
+    j = t["catalog_sales"].merge(
+        d.rename(columns={c: c + "1" for c in d.columns}),
+        left_on="cs_sold_date_sk", right_on="d_date_sk1")
+    j = j[j.d_year1 == 2000]
+    cd = t["customer_demographics"]
+    j = j.merge(cd[cd.cd_marital_status == "D"][["cd_demo_sk"]],
+                left_on="cs_bill_cdemo_sk", right_on="cd_demo_sk")
+    cu = t["customer"][["c_customer_sk", "c_current_hdemo_sk"]]
+    j = j.merge(cu, left_on="cs_bill_customer_sk", right_on="c_customer_sk")
+    hd = t["household_demographics"]
+    j = j.merge(hd[hd.hd_buy_potential == ">10000"][["hd_demo_sk"]],
+                left_on="c_current_hdemo_sk", right_on="hd_demo_sk")
+    j = j.merge(d.rename(columns={c: c + "3" for c in d.columns}),
+                left_on="cs_ship_date_sk", right_on="d_date_sk3")
+    j = j[j.d_date3 > j.d_date1 + np.timedelta64(5, "D")]
+    inv = t["inventory"].merge(
+        d.rename(columns={c: c + "2" for c in d.columns}),
+        left_on="inv_date_sk", right_on="d_date_sk2")
+    j = j.merge(inv, left_on="cs_item_sk", right_on="inv_item_sk")
+    j = j[(j.d_week_seq1 == j.d_week_seq2)
+          & (j.inv_quantity_on_hand < j.cs_quantity)]
+    j = j.merge(t["warehouse"][["w_warehouse_sk", "w_warehouse_name"]],
+                left_on="inv_warehouse_sk", right_on="w_warehouse_sk")
+    j = j.merge(t["item"][["i_item_sk", "i_item_desc"]],
+                left_on="cs_item_sk", right_on="i_item_sk")
+    promo = set(t["promotion"].p_promo_sk)
+    j["has_promo"] = j.cs_promo_sk.isin(promo)
+    g = j.groupby(["i_item_desc", "w_warehouse_name", "d_week_seq1"],
+                  as_index=False, dropna=False).agg(
+        no_promo=("has_promo", lambda s: int((~s).sum())),
+        promo=("has_promo", lambda s: int(s.sum())),
+        total_cnt=("has_promo", "size"))
+    g = g.sort_values(["i_item_desc", "w_warehouse_name", "d_week_seq1"],
+                      kind="stable")
+    g = g.sort_values("total_cnt", ascending=False, kind="stable")
+    return g.rename(columns={"d_week_seq1": "d_week_seq"})[
+        ["i_item_desc", "w_warehouse_name", "d_week_seq", "no_promo",
+         "promo", "total_cnt"]].head(100).reset_index(drop=True)
+
+
 ORACLES = {
     name: globals()[name]
     for name in ["q1", "q2", "q3", "q4", "q5", "q6", "q7", "q8", "q9", "q10", "q11", "q12", "q13", "q15", "q16", "q17", "q18", "q19",
                  "q20", "q21", "q22", "q25", "q26", "q27", "q28", "q29", "q30", "q31", "q32", "q33",
-                 "q34", "q35", "q36", "q37", "q38", "q39", "q40", "q41", "q42", "q43", "q44", "q45", "q46", "q47", "q48", "q50", "q51",
+                 "q34", "q35", "q36", "q37", "q38", "q39", "q40", "q41", "q42", "q43", "q44", "q45", "q46", "q47", "q48", "q49", "q50", "q51",
                  "q52", "q53", "q55", "q56", "q57", "q58", "q59", "q60", "q61", "q62", "q63", "q65", "q66", "q67", "q68", "q69", "q70",
-                 "q71", "q73", "q74", "q75", "q76", "q77", "q78", "q79", "q80", "q81", "q82", "q83", "q84", "q85", "q86", "q87", "q88", "q89",
-                 "q90", "q91", "q92", "q93", "q94", "q96", "q97", "q98", "q99"]
+                 "q71", "q72", "q73", "q74", "q75", "q76", "q77", "q78", "q79", "q80", "q81", "q82", "q83", "q84", "q85", "q86", "q87", "q88", "q89",
+                 "q90", "q91", "q92", "q93", "q94", "q95", "q96", "q97", "q98", "q99"]
 }

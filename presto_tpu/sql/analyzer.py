@@ -1579,21 +1579,39 @@ class Analyzer:
             agg_map[a] = mapped
 
         if distinct_key_exprs:
-            if len(distinct_key_exprs) > 1 or any(
-                s.kind != "count_distinct" for s in specs
-            ):
+            if len(distinct_key_exprs) > 1:
                 raise AnalysisError(
-                    "only a single DISTINCT aggregate (alone) is supported"
+                    "multiple distinct DISTINCT-aggregate arguments are "
+                    "not supported"
                 )
-            # pre-aggregate on keys + the distinct column, then count it
-            pre_keys = keys + distinct_key_exprs
-            plan = N.Aggregate(plan, tuple(pre_keys), ())
-            keys = [(n, InputRef(e.dtype, n)) for n, e in keys]
+            # pre-aggregate on keys + the distinct column; the DISTINCT
+            # count becomes a count of the pre-groups, and plain
+            # aggregates decompose through partials (sum of sums, sum of
+            # counts, min of mins, ...) — q95 mixes count(distinct)
+            # with sums
             dn, de = distinct_key_exprs[0]
+            cds = [s for s in specs if s.kind == "count_distinct"]
+            plain = [s for s in specs if s.kind != "count_distinct"]
+            partial: list[AggSpec] = []
+            final: list[AggSpec] = []
+            for s in plain:
+                if s.kind not in ("sum", "count", "min", "max"):
+                    raise AnalysisError(
+                        f"{s.kind} cannot combine with DISTINCT aggregates"
+                    )
+                pn = self.fresh("pdist")
+                partial.append(AggSpec(s.kind, s.input, pn, s.dtype))
+                outer_kind = "sum" if s.kind in ("sum", "count") else s.kind
+                final.append(
+                    AggSpec(outer_kind, InputRef(s.dtype, pn), s.name, s.dtype)
+                )
+            pre_keys = keys + distinct_key_exprs
+            plan = N.Aggregate(plan, tuple(pre_keys), tuple(partial))
+            keys = [(n, InputRef(e.dtype, n)) for n, e in keys]
             specs = [
                 AggSpec("count", InputRef(de.dtype, dn), s.name, s.dtype)
-                for s in specs
-            ]
+                for s in cds
+            ] + final
 
         # functional dependencies: keys covered by a unique key of the
         # same relation instance become passengers (Q10/Q18 shape)
