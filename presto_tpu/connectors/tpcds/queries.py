@@ -2731,3 +2731,284 @@ order by total_cnt desc, i_item_desc, w_warehouse_name, d_week_seq
 limit 100
 """,
 })
+
+QUERIES.update({
+    # q54: revenue segments of customers who bought one class's items
+    # (adaptations: class 'women-infants' — no 'maternity' class here;
+    # the buyer window widens to the year and the store correlation is
+    # county-only — month+county+state matches are empty at toy SF)
+    "q54": """
+with my_customers as (
+  select distinct c_customer_sk, c_current_addr_sk
+  from (select cs_sold_date_sk as sold_date_sk,
+               cs_bill_customer_sk as customer_sk,
+               cs_item_sk as item_sk
+        from catalog_sales
+        union all
+        select ws_sold_date_sk as sold_date_sk,
+               ws_bill_customer_sk as customer_sk,
+               ws_item_sk as item_sk
+        from web_sales) cs_or_ws_sales, item, date_dim, customer
+  where sold_date_sk = d_date_sk
+    and item_sk = i_item_sk
+    and i_category = 'Women'
+    and i_class = 'women-infants'
+    and c_customer_sk = cs_or_ws_sales.customer_sk
+    and d_year = 1999),
+my_revenue as (
+  select c_customer_sk, sum(ss_ext_sales_price) as revenue
+  from my_customers, store_sales, customer_address, store, date_dim
+  where c_current_addr_sk = ca_address_sk
+    and ca_county = s_county
+    and ss_customer_sk = c_customer_sk
+    and ss_sold_date_sk = d_date_sk
+    and d_month_seq between (select distinct d_month_seq + 1
+                             from date_dim
+                             where d_year = 1999 and d_moy = 12)
+                        and (select distinct d_month_seq + 3
+                             from date_dim
+                             where d_year = 1999 and d_moy = 12)
+  group by c_customer_sk),
+segments as (
+  select cast(revenue / 50 as int) as segment from my_revenue)
+select segment, count(*) as num_customers, segment * 50 as segment_base
+from segments
+group by segment
+order by segment, num_customers
+limit 100
+""",
+    # q24: store customers who bought one color in their own zip
+    # (adaptations: the c_birth_country = upper(ca_country) conjunct is
+    # dropped — this generator's customer has no birth country; the zip
+    # correlation relaxes to a shared first digit and the color comes
+    # from the palette — exact zip equality is empty at toy SF)
+    "q24": """
+with ssales as (
+  select c_last_name, c_first_name, s_store_name, ca_state, s_state,
+         i_color, i_current_price, i_manufact_id, i_units, i_size,
+         sum(ss_net_paid) as netpaid
+  from store_sales, store_returns, store, item, customer, customer_address
+  where ss_ticket_number = sr_ticket_number
+    and ss_item_sk = sr_item_sk
+    and ss_customer_sk = c_customer_sk
+    and ss_item_sk = i_item_sk
+    and ss_store_sk = s_store_sk
+    and c_current_addr_sk = ca_address_sk
+    and substring(s_zip, 1, 1) = substring(ca_zip, 1, 1)
+    and s_market_id = 8
+  group by c_last_name, c_first_name, s_store_name, ca_state, s_state,
+           i_color, i_current_price, i_manufact_id, i_units, i_size)
+select c_last_name, c_first_name, s_store_name, sum(netpaid) as paid
+from ssales
+where i_color = 'burlywood'
+group by c_last_name, c_first_name, s_store_name
+having sum(netpaid) > (select 0.05 * avg(netpaid) from ssales)
+order by c_last_name, c_first_name, s_store_name
+limit 100
+""",
+    # q23: off-channel spend of the best store customers on frequently
+    # sold items (adaptations: the having thresholds fit toy SF; the
+    # max_store_sales scalar names its column)
+    "q23": """
+with frequent_ss_items as (
+  select substring(i_item_desc, 1, 30) as itemdesc, i_item_sk as item_sk,
+         d_date as solddate, count(*) as cnt
+  from store_sales, date_dim, item
+  where ss_sold_date_sk = d_date_sk
+    and ss_item_sk = i_item_sk
+    and d_year in (1999, 2000, 2001, 2002)
+  group by substring(i_item_desc, 1, 30), i_item_sk, d_date
+  having count(*) > 1),
+max_store_sales as (
+  select max(csales) as tpcds_cmax
+  from (select c_customer_sk, sum(ss_quantity * ss_sales_price) as csales
+        from store_sales, customer, date_dim
+        where ss_customer_sk = c_customer_sk
+          and ss_sold_date_sk = d_date_sk
+          and d_year in (1999, 2000, 2001, 2002)
+        group by c_customer_sk) x),
+best_ss_customer as (
+  select c_customer_sk, sum(ss_quantity * ss_sales_price) as ssales
+  from store_sales, customer
+  where ss_customer_sk = c_customer_sk
+  group by c_customer_sk
+  having sum(ss_quantity * ss_sales_price)
+         > 0.5 * (select tpcds_cmax from max_store_sales))
+select sum(sales) as total_sales
+from (select cs_quantity * cs_list_price as sales
+      from catalog_sales, date_dim
+      where d_year = 2000
+        and d_moy = 2
+        and cs_sold_date_sk = d_date_sk
+        and cs_item_sk in (select item_sk from frequent_ss_items)
+        and cs_bill_customer_sk in (select c_customer_sk
+                                    from best_ss_customer)
+      union all
+      select ws_quantity * ws_list_price as sales
+      from web_sales, date_dim
+      where d_year = 2000
+        and d_moy = 2
+        and ws_sold_date_sk = d_date_sk
+        and ws_item_sk in (select item_sk from frequent_ss_items)
+        and ws_bill_customer_sk in (select c_customer_sk
+                                    from best_ss_customer)) y
+limit 100
+""",
+})
+
+QUERIES.update({
+    # q14: cross-channel brand/class/category overlap (3-way INTERSECT)
+    # with an average-sales HAVING gate and ROLLUP report
+    "q14": """
+with cross_items as (
+  select i_item_sk as ss_item_sk
+  from item,
+       (select iss.i_brand_id as brand_id, iss.i_class_id as class_id,
+               iss.i_category_id as category_id
+        from store_sales, item iss, date_dim d1
+        where ss_item_sk = iss.i_item_sk
+          and ss_sold_date_sk = d1.d_date_sk
+          and d1.d_year between 1999 and 2001
+        intersect
+        select ics.i_brand_id as brand_id, ics.i_class_id as class_id,
+               ics.i_category_id as category_id
+        from catalog_sales, item ics, date_dim d2
+        where cs_item_sk = ics.i_item_sk
+          and cs_sold_date_sk = d2.d_date_sk
+          and d2.d_year between 1999 and 2001
+        intersect
+        select iws.i_brand_id as brand_id, iws.i_class_id as class_id,
+               iws.i_category_id as category_id
+        from web_sales, item iws, date_dim d3
+        where ws_item_sk = iws.i_item_sk
+          and ws_sold_date_sk = d3.d_date_sk
+          and d3.d_year between 1999 and 2001) x
+  where i_brand_id = brand_id
+    and i_class_id = class_id
+    and i_category_id = category_id),
+avg_sales as (
+  select avg(quantity * list_price) as average_sales
+  from (select ss_quantity as quantity, ss_list_price as list_price
+        from store_sales, date_dim
+        where ss_sold_date_sk = d_date_sk and d_year between 1999 and 2001
+        union all
+        select cs_quantity as quantity, cs_list_price as list_price
+        from catalog_sales, date_dim
+        where cs_sold_date_sk = d_date_sk and d_year between 1999 and 2001
+        union all
+        select ws_quantity as quantity, ws_list_price as list_price
+        from web_sales, date_dim
+        where ws_sold_date_sk = d_date_sk
+          and d_year between 1999 and 2001) x)
+select channel, i_brand_id, i_class_id, i_category_id, sum(sales) as sales,
+       sum(number_sales) as number_sales
+from (select 'store' as channel, i_brand_id, i_class_id, i_category_id,
+             sum(ss_quantity * ss_list_price) as sales,
+             count(*) as number_sales
+      from store_sales, item, date_dim
+      where ss_item_sk in (select ss_item_sk from cross_items)
+        and ss_item_sk = i_item_sk
+        and ss_sold_date_sk = d_date_sk
+        and d_year = 2001 and d_moy = 11
+      group by i_brand_id, i_class_id, i_category_id
+      having sum(ss_quantity * ss_list_price)
+             > (select average_sales from avg_sales)
+      union all
+      select 'catalog' as channel, i_brand_id, i_class_id, i_category_id,
+             sum(cs_quantity * cs_list_price) as sales,
+             count(*) as number_sales
+      from catalog_sales, item, date_dim
+      where cs_item_sk in (select ss_item_sk from cross_items)
+        and cs_item_sk = i_item_sk
+        and cs_sold_date_sk = d_date_sk
+        and d_year = 2001 and d_moy = 11
+      group by i_brand_id, i_class_id, i_category_id
+      having sum(cs_quantity * cs_list_price)
+             > (select average_sales from avg_sales)
+      union all
+      select 'web' as channel, i_brand_id, i_class_id, i_category_id,
+             sum(ws_quantity * ws_list_price) as sales,
+             count(*) as number_sales
+      from web_sales, item, date_dim
+      where ws_item_sk in (select ss_item_sk from cross_items)
+        and ws_item_sk = i_item_sk
+        and ws_sold_date_sk = d_date_sk
+        and d_year = 2001 and d_moy = 11
+      group by i_brand_id, i_class_id, i_category_id
+      having sum(ws_quantity * ws_list_price)
+             > (select average_sales from avg_sales)) y
+group by rollup(channel, i_brand_id, i_class_id, i_category_id)
+order by channel nulls last, i_brand_id nulls last, i_class_id nulls last,
+         i_category_id nulls last
+limit 100
+""",
+    # q64: profitable-return items sold in consecutive years
+    # (adaptations: refund = refunded cash + store credit — no
+    # cr_reversed_charge here; the first-sale/first-ship date dims and
+    # birth-country are dropped with their columns — the generator's
+    # customer has neither; street numbers substitute the address id —
+    # no ca_street_number; prices from the generator)
+    "q64": """
+with cs_ui as (
+  select cs_item_sk,
+         sum(cs_ext_list_price) as sale,
+         sum(cr_refunded_cash + cr_store_credit) as refund
+  from catalog_sales, catalog_returns
+  where cs_item_sk = cr_item_sk
+    and cs_order_number = cr_order_number
+  group by cs_item_sk
+  having sum(cs_ext_list_price)
+         > 2 * sum(cr_refunded_cash + cr_store_credit)),
+cross_sales as (
+  select i_product_name as product_name, i_item_sk as item_sk,
+         s_store_name as store_name, s_zip as store_zip,
+         ad1.ca_address_id as b_street_number,
+         ad1.ca_city as b_city, ad1.ca_zip as b_zip,
+         ad2.ca_address_id as c_street_number,
+         ad2.ca_city as c_city, ad2.ca_zip as c_zip,
+         d1.d_year as syear, count(*) as cnt,
+         sum(ss_wholesale_cost) as s1, sum(ss_list_price) as s2,
+         sum(ss_coupon_amt) as s3
+  from store_sales, store_returns, cs_ui, date_dim d1, store, customer,
+       customer_demographics cd1, customer_demographics cd2, promotion,
+       household_demographics hd1, household_demographics hd2,
+       customer_address ad1, customer_address ad2, income_band ib1,
+       income_band ib2, item
+  where ss_store_sk = s_store_sk
+    and ss_sold_date_sk = d1.d_date_sk
+    and ss_item_sk = i_item_sk
+    and ss_customer_sk = c_customer_sk
+    and ss_cdemo_sk = cd1.cd_demo_sk
+    and ss_hdemo_sk = hd1.hd_demo_sk
+    and ss_addr_sk = ad1.ca_address_sk
+    and ss_item_sk = sr_item_sk
+    and ss_ticket_number = sr_ticket_number
+    and ss_item_sk = cs_ui.cs_item_sk
+    and c_current_cdemo_sk = cd2.cd_demo_sk
+    and c_current_hdemo_sk = hd2.hd_demo_sk
+    and c_current_addr_sk = ad2.ca_address_sk
+    and ss_promo_sk = p_promo_sk
+    and hd1.hd_income_band_sk = ib1.ib_income_band_sk
+    and hd2.hd_income_band_sk = ib2.ib_income_band_sk
+    and cd1.cd_marital_status <> cd2.cd_marital_status
+    and i_current_price between 10 and 70
+  group by i_product_name, i_item_sk, s_store_name, s_zip,
+           ad1.ca_address_id, ad1.ca_city, ad1.ca_zip,
+           ad2.ca_address_id, ad2.ca_city, ad2.ca_zip, d1.d_year)
+select cs1.product_name, cs1.store_name, cs1.store_zip,
+       cs1.b_street_number, cs1.b_city, cs1.b_zip, cs1.c_street_number,
+       cs1.c_city, cs1.c_zip, cs1.syear, cs1.cnt, cs1.s1, cs1.s2, cs1.s3,
+       cs2.s1 as s1_2, cs2.s2 as s2_2, cs2.s3 as s3_2, cs2.syear as syear2,
+       cs2.cnt as cnt2
+from cross_sales cs1, cross_sales cs2
+where cs1.item_sk = cs2.item_sk
+  and cs1.syear = 1999
+  and cs2.syear = 2000
+  and cs2.cnt <= cs1.cnt
+  and cs1.store_name = cs2.store_name
+  and cs1.store_zip = cs2.store_zip
+order by cs1.product_name, cs1.store_name, cs2.cnt, cs1.b_zip, cs1.c_zip,
+         cs2.s1
+limit 100
+""",
+})

@@ -2610,12 +2610,282 @@ def q72(t):
          "promo", "total_cnt"]].head(100).reset_index(drop=True)
 
 
+def q54(t):
+    u = pd.concat([
+        t["catalog_sales"][["cs_sold_date_sk", "cs_bill_customer_sk",
+                            "cs_item_sk"]].rename(columns={
+            "cs_sold_date_sk": "sold_date_sk",
+            "cs_bill_customer_sk": "customer_sk",
+            "cs_item_sk": "item_sk"}),
+        t["web_sales"][["ws_sold_date_sk", "ws_bill_customer_sk",
+                        "ws_item_sk"]].rename(columns={
+            "ws_sold_date_sk": "sold_date_sk",
+            "ws_bill_customer_sk": "customer_sk",
+            "ws_item_sk": "item_sk"}),
+    ], ignore_index=True)
+    it = t["item"]
+    sel = it[(it.i_category == "Women") & (it.i_class == "women-infants")]
+    d = t["date_dim"]
+    dd = d[d.d_year == 1999]
+    j = u.merge(sel[["i_item_sk"]], left_on="item_sk", right_on="i_item_sk")
+    j = j.merge(dd[["d_date_sk"]], left_on="sold_date_sk",
+                right_on="d_date_sk")
+    cu = t["customer"]
+    mc = cu[cu.c_customer_sk.isin(j.customer_sk.dropna())][
+        ["c_customer_sk", "c_current_addr_sk"]].drop_duplicates()
+    base_seq = int(d[(d.d_moy == 12) & (d.d_year == 1999)].d_month_seq.iloc[0])
+    win = d[(d.d_month_seq >= base_seq + 1) & (d.d_month_seq <= base_seq + 3)]
+    ss = t["store_sales"].merge(win[["d_date_sk"]],
+                                left_on="ss_sold_date_sk",
+                                right_on="d_date_sk")
+    j2 = mc.merge(t["customer_address"], left_on="c_current_addr_sk",
+                  right_on="ca_address_sk")
+    j2 = j2.merge(t["store"], left_on="ca_county", right_on="s_county")
+    j2 = j2.merge(ss, left_on="c_customer_sk", right_on="ss_customer_sk")
+    rev = j2.groupby("c_customer_sk", as_index=False).agg(
+        revenue=("ss_ext_sales_price", "sum"))
+    # engine cast truncates the float32 division toward zero
+    seg = np.trunc(rev.revenue.to_numpy().astype(np.float32)
+                   / np.float32(50)).astype(np.int64)
+    g = pd.Series(seg).value_counts().sort_index()
+    return pd.DataFrame({"segment": g.index.to_numpy(),
+                         "num_customers": g.to_numpy(),
+                         "segment_base": g.index.to_numpy() * 50}
+                        ).head(100).reset_index(drop=True)
+
+
+def q24(t):
+    j = t["store_sales"].merge(
+        t["store_returns"][["sr_ticket_number", "sr_item_sk"]],
+        left_on=["ss_ticket_number", "ss_item_sk"],
+        right_on=["sr_ticket_number", "sr_item_sk"])
+    j = j.merge(t["customer"], left_on="ss_customer_sk",
+                right_on="c_customer_sk")
+    j = j.merge(t["item"], left_on="ss_item_sk", right_on="i_item_sk")
+    st = t["store"]
+    j = j.merge(st[st.s_market_id == 8], left_on="ss_store_sk",
+                right_on="s_store_sk")
+    j = j.merge(t["customer_address"], left_on="c_current_addr_sk",
+                right_on="ca_address_sk")
+    j = j[j.s_zip.str[:1] == j.ca_zip.str[:1]]
+    keys = ["c_last_name", "c_first_name", "s_store_name", "ca_state",
+            "s_state", "i_color", "i_current_price", "i_manufact_id",
+            "i_units", "i_size"]
+    ssales = j.groupby(keys, as_index=False, dropna=False).agg(
+        netpaid=("ss_net_paid", "sum"))
+    thr = 0.05 * ssales.netpaid.mean()
+    red = ssales[ssales.i_color == "burlywood"]
+    g = red.groupby(["c_last_name", "c_first_name", "s_store_name"],
+                    as_index=False, dropna=False).agg(
+        paid=("netpaid", "sum"))
+    g = g[g.paid > thr]
+    g = g.sort_values(["c_last_name", "c_first_name", "s_store_name"],
+                      na_position="last", kind="stable").head(100)
+    return g.reset_index(drop=True)
+
+
+def q23(t):
+    d = t["date_dim"]
+    dd = d[d.d_year.isin([1999, 2000, 2001, 2002])]
+    ssj = t["store_sales"].merge(dd[["d_date_sk", "d_date"]],
+                                 left_on="ss_sold_date_sk",
+                                 right_on="d_date_sk")
+    ssj = ssj.merge(t["item"][["i_item_sk", "i_item_desc"]],
+                    left_on="ss_item_sk", right_on="i_item_sk")
+    ssj = ssj.assign(itemdesc=ssj.i_item_desc.str[:30])
+    f = ssj.groupby(["itemdesc", "i_item_sk", "d_date"]).size()
+    frequent = set(f[f > 1].reset_index().i_item_sk)
+    cs2 = t["store_sales"].merge(t["customer"][["c_customer_sk"]],
+                                 left_on="ss_customer_sk",
+                                 right_on="c_customer_sk")
+    spend = (cs2.merge(dd[["d_date_sk"]], left_on="ss_sold_date_sk",
+                       right_on="d_date_sk")
+             .assign(v=lambda x: (x.ss_quantity * x.ss_sales_price))
+             .groupby("c_customer_sk").v.sum())
+    cmax = spend.max()
+    all_spend = (cs2.assign(v=lambda x: x.ss_quantity * x.ss_sales_price)
+                 .groupby("c_customer_sk").v.sum())
+    best = set(all_spend[all_spend > 0.5 * cmax].index)
+    d2 = d[(d.d_year == 2000) & (d.d_moy == 2)][["d_date_sk"]]
+    cs = t["catalog_sales"].merge(d2, left_on="cs_sold_date_sk",
+                                  right_on="d_date_sk")
+    cs = cs[cs.cs_item_sk.isin(frequent)
+            & cs.cs_bill_customer_sk.isin(best)]
+    ws = t["web_sales"].merge(d2, left_on="ws_sold_date_sk",
+                              right_on="d_date_sk")
+    ws = ws[ws.ws_item_sk.isin(frequent)
+            & ws.ws_bill_customer_sk.isin(best)]
+    total = float((cs.cs_quantity * cs.cs_list_price).sum()
+                  + (ws.ws_quantity * ws.ws_list_price).sum())
+    return pd.DataFrame({"total_sales": [total]})
+
+
+def q14(t):
+    d = t["date_dim"]
+    dd3 = d[(d.d_year >= 1999) & (d.d_year <= 2001)][["d_date_sk"]]
+    it = t["item"]
+
+    def ids(tbl, icol, dcol):
+        j = t[tbl].merge(dd3, left_on=dcol, right_on="d_date_sk")
+        j = j.merge(it, left_on=icol, right_on="i_item_sk")
+        j = j.dropna(subset=["i_brand_id", "i_class_id", "i_category_id"])
+        return set(map(tuple, j[["i_brand_id", "i_class_id",
+                                 "i_category_id"]].to_numpy().tolist()))
+
+    common = (ids("store_sales", "ss_item_sk", "ss_sold_date_sk")
+              & ids("catalog_sales", "cs_item_sk", "cs_sold_date_sk")
+              & ids("web_sales", "ws_item_sk", "ws_sold_date_sk"))
+    key = it[["i_brand_id", "i_class_id", "i_category_id"]].apply(
+        tuple, axis=1)
+    cross_items = set(it[key.isin(common)].i_item_sk)
+
+    def month_qlp(tbl, icol, dcol, qty, lp):
+        j = t[tbl].merge(dd3, left_on=dcol, right_on="d_date_sk")
+        return (j[qty] * j[lp])
+
+    avg_sales = np.float32(pd.concat([
+        month_qlp("store_sales", "ss_item_sk", "ss_sold_date_sk",
+                  "ss_quantity", "ss_list_price"),
+        month_qlp("catalog_sales", "cs_item_sk", "cs_sold_date_sk",
+                  "cs_quantity", "cs_list_price"),
+        month_qlp("web_sales", "ws_item_sk", "ws_sold_date_sk",
+                  "ws_quantity", "ws_list_price"),
+    ], ignore_index=True).mean())
+
+    dm = d[(d.d_year == 2001) & (d.d_moy == 11)][["d_date_sk"]]
+
+    def channel(tbl, icol, dcol, qty, lp, chan):
+        j = t[tbl].merge(dm, left_on=dcol, right_on="d_date_sk")
+        j = j[j[icol].isin(cross_items)]
+        j = j.merge(it[["i_item_sk", "i_brand_id", "i_class_id",
+                        "i_category_id"]], left_on=icol,
+                    right_on="i_item_sk")
+        j = j.assign(v=j[qty] * j[lp])
+        g = j.groupby(["i_brand_id", "i_class_id", "i_category_id"],
+                      as_index=False, dropna=False).agg(
+            sales=("v", "sum"), number_sales=("v", "size"))
+        g = g[g.sales.to_numpy().astype(np.float32) > avg_sales]
+        g["channel"] = chan
+        return g
+
+    y = pd.concat([
+        channel("store_sales", "ss_item_sk", "ss_sold_date_sk",
+                "ss_quantity", "ss_list_price", "store"),
+        channel("catalog_sales", "cs_item_sk", "cs_sold_date_sk",
+                "cs_quantity", "cs_list_price", "catalog"),
+        channel("web_sales", "ws_item_sk", "ws_sold_date_sk",
+                "ws_quantity", "ws_list_price", "web"),
+    ], ignore_index=True)
+    cols = ["channel", "i_brand_id", "i_class_id", "i_category_id"]
+    frames = []
+    for k in range(len(cols), -1, -1):
+        keys = cols[:k]
+        if keys:
+            g = y.groupby(keys, as_index=False, dropna=False).agg(
+                sales=("sales", "sum"), number_sales=("number_sales", "sum"))
+        else:
+            g = pd.DataFrame({"sales": [y.sales.sum()],
+                              "number_sales": [y.number_sales.sum()]})
+        for c in cols[k:]:
+            g[c] = None
+        frames.append(g)
+    u = pd.concat(frames, ignore_index=True)
+    for c in reversed(cols):
+        u = u.sort_values(c, na_position="last", kind="stable")
+    u = u[cols + ["sales", "number_sales"]].head(100).reset_index(drop=True)
+    for c in ("i_brand_id", "i_class_id", "i_category_id"):
+        if u[c].notna().all():
+            u[c] = u[c].astype(np.int64)  # match the engine's int column
+    return u
+
+
+def q64(t):
+    cs = t["catalog_sales"].merge(
+        t["catalog_returns"], left_on=["cs_item_sk", "cs_order_number"],
+        right_on=["cr_item_sk", "cr_order_number"])
+    cs = cs.assign(refund=cs.cr_refunded_cash + cs.cr_store_credit)
+    g = cs.groupby("cs_item_sk", as_index=False).agg(
+        sale=("cs_ext_list_price", "sum"), refund=("refund", "sum"))
+    cs_ui = set(g[g.sale > 2 * g.refund].cs_item_sk)
+
+    j = t["store_sales"].merge(
+        t["store_returns"][["sr_item_sk", "sr_ticket_number"]],
+        left_on=["ss_item_sk", "ss_ticket_number"],
+        right_on=["sr_item_sk", "sr_ticket_number"])
+    j = j[j.ss_item_sk.isin(cs_ui)]
+    it = t["item"]
+    j = j.merge(it[(it.i_current_price >= 10)
+                   & (it.i_current_price <= 70)][
+        ["i_item_sk", "i_product_name"]], left_on="ss_item_sk",
+        right_on="i_item_sk")
+    j = j.merge(t["date_dim"][["d_date_sk", "d_year"]],
+                left_on="ss_sold_date_sk", right_on="d_date_sk")
+    j = j.merge(t["store"][["s_store_sk", "s_store_name", "s_zip"]],
+                left_on="ss_store_sk", right_on="s_store_sk")
+    j = j.merge(t["customer"], left_on="ss_customer_sk",
+                right_on="c_customer_sk")
+    cd = t["customer_demographics"][["cd_demo_sk", "cd_marital_status"]]
+    j = j.merge(cd.rename(columns={"cd_demo_sk": "cd1_sk",
+                                   "cd_marital_status": "ms1"}),
+                left_on="ss_cdemo_sk", right_on="cd1_sk")
+    j = j.merge(cd.rename(columns={"cd_demo_sk": "cd2_sk",
+                                   "cd_marital_status": "ms2"}),
+                left_on="c_current_cdemo_sk", right_on="cd2_sk")
+    j = j[j.ms1 != j.ms2]
+    hd = t["household_demographics"][["hd_demo_sk", "hd_income_band_sk"]]
+    j = j.merge(hd.rename(columns={"hd_demo_sk": "hd1_sk",
+                                   "hd_income_band_sk": "ib1_sk"}),
+                left_on="ss_hdemo_sk", right_on="hd1_sk")
+    j = j.merge(hd.rename(columns={"hd_demo_sk": "hd2_sk",
+                                   "hd_income_band_sk": "ib2_sk"}),
+                left_on="c_current_hdemo_sk", right_on="hd2_sk")
+    ib = t["income_band"][["ib_income_band_sk"]]
+    j = j.merge(ib.rename(columns={"ib_income_band_sk": "ib1"}),
+                left_on="ib1_sk", right_on="ib1")
+    j = j.merge(ib.rename(columns={"ib_income_band_sk": "ib2"}),
+                left_on="ib2_sk", right_on="ib2")
+    j = j.merge(t["promotion"][["p_promo_sk"]], left_on="ss_promo_sk",
+                right_on="p_promo_sk")
+    ca = t["customer_address"][["ca_address_sk", "ca_address_id",
+                                "ca_city", "ca_zip"]]
+    j = j.merge(ca.rename(columns={
+        "ca_address_sk": "ad1_sk", "ca_address_id": "b_street_number",
+        "ca_city": "b_city", "ca_zip": "b_zip"}),
+        left_on="ss_addr_sk", right_on="ad1_sk")
+    j = j.merge(ca.rename(columns={
+        "ca_address_sk": "ad2_sk", "ca_address_id": "c_street_number",
+        "ca_city": "c_city", "ca_zip": "c_zip"}),
+        left_on="c_current_addr_sk", right_on="ad2_sk")
+    keys = ["i_product_name", "i_item_sk", "s_store_name", "s_zip",
+            "b_street_number", "b_city", "b_zip", "c_street_number",
+            "c_city", "c_zip", "d_year"]
+    g = j.groupby(keys, as_index=False, dropna=False).agg(
+        cnt=("ss_item_sk", "size"), s1=("ss_wholesale_cost", "sum"),
+        s2=("ss_list_price", "sum"), s3=("ss_coupon_amt", "sum"))
+    cs1 = g[g.d_year == 1999]
+    cs2 = g[g.d_year == 2000]
+    m = cs1.merge(cs2, on=["i_item_sk", "s_store_name", "s_zip"],
+                  suffixes=("", "_2"))
+    m = m[m.cnt_2 <= m.cnt]
+    m = m.sort_values(["i_product_name", "s_store_name", "cnt_2",
+                       "b_zip", "c_zip", "s1_2"], kind="stable").head(100)
+    return pd.DataFrame({
+        "product_name": m.i_product_name, "store_name": m.s_store_name,
+        "store_zip": m.s_zip, "b_street_number": m.b_street_number,
+        "b_city": m.b_city, "b_zip": m.b_zip,
+        "c_street_number": m.c_street_number, "c_city": m.c_city,
+        "c_zip": m.c_zip, "syear": m.d_year, "cnt": m.cnt, "s1": m.s1,
+        "s2": m.s2, "s3": m.s3, "s1_2": m.s1_2, "s2_2": m.s2_2,
+        "s3_2": m.s3_2, "syear2": m.d_year_2, "cnt2": m.cnt_2,
+    }).reset_index(drop=True)
+
+
 ORACLES = {
     name: globals()[name]
-    for name in ["q1", "q2", "q3", "q4", "q5", "q6", "q7", "q8", "q9", "q10", "q11", "q12", "q13", "q15", "q16", "q17", "q18", "q19",
-                 "q20", "q21", "q22", "q25", "q26", "q27", "q28", "q29", "q30", "q31", "q32", "q33",
+    for name in ["q1", "q2", "q3", "q4", "q5", "q6", "q7", "q8", "q9", "q10", "q11", "q12", "q13", "q14", "q15", "q16", "q17", "q18", "q19",
+                 "q20", "q21", "q22", "q23", "q24", "q25", "q26", "q27", "q28", "q29", "q30", "q31", "q32", "q33",
                  "q34", "q35", "q36", "q37", "q38", "q39", "q40", "q41", "q42", "q43", "q44", "q45", "q46", "q47", "q48", "q49", "q50", "q51",
-                 "q52", "q53", "q55", "q56", "q57", "q58", "q59", "q60", "q61", "q62", "q63", "q65", "q66", "q67", "q68", "q69", "q70",
+                 "q52", "q53", "q54", "q55", "q56", "q57", "q58", "q59", "q60", "q61", "q62", "q63", "q64", "q65", "q66", "q67", "q68", "q69", "q70",
                  "q71", "q72", "q73", "q74", "q75", "q76", "q77", "q78", "q79", "q80", "q81", "q82", "q83", "q84", "q85", "q86", "q87", "q88", "q89",
                  "q90", "q91", "q92", "q93", "q94", "q95", "q96", "q97", "q98", "q99"]
 }

@@ -1249,6 +1249,20 @@ class Analyzer:
                     node.op, other, sub.query, negated, flip, plan, scope, outer,
                     ctes, scalar_binds,
                 )
+        if isinstance(node, A.Between) and not negated and not node.negated:
+            # BETWEEN with scalar-subquery bounds (q54's month window):
+            # split into two range conjuncts and plan each
+            for op_, bound in ((">=", node.low), ("<=", node.high)):
+                c2 = A.BinaryOp(op_, node.value, bound)
+                if self._contains_subquery(c2):
+                    plan = self._apply_subquery_pred(
+                        c2, plan, scope, outer, ctes, scalar_binds
+                    )
+                else:
+                    plan = N.Filter(
+                        plan, self._expr(c2, scope, outer, ctes, scalar_binds)
+                    )
+            return plan
         if isinstance(node, A.BinaryOp) and node.op in ("or", "and") and not negated:
             # boolean combination containing EXISTS leaves (TPC-DS
             # q10/q35 `exists(web) or exists(catalog)`): mark-join
@@ -2213,7 +2227,7 @@ class Analyzer:
 
         if type_name == "double":
             return Call(DOUBLE, "cast_double", (v,))
-        if type_name == "bigint":
+        if type_name in ("bigint", "int", "integer"):
             return Call(BIGINT, "cast_bigint", (v,))
         if type_name.startswith("decimal"):
             import re as _re
