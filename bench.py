@@ -110,6 +110,12 @@ def _phase(name: str) -> None:
     print(f"[bench +{time.monotonic() - T0:6.1f}s] {name}", file=sys.stderr)
 
 
+def _margin() -> float:
+    """Watchdog safety margin (shared with the acquisition deadline so
+    the two can't drift); clamped so tiny smoke budgets still run."""
+    return min(12.0, BUDGET * 0.15)
+
+
 def _watchdog() -> None:
     """Emit whatever has been measured before the driver's timeout hits.
 
@@ -119,8 +125,7 @@ def _watchdog() -> None:
     wall-clock budget expires it prints the (partial) RESULT line and
     force-exits, so the driver always gets a parseable record.
     """
-    # clamp the safety margin so tiny smoke budgets still get to run
-    margin = min(12.0, BUDGET * 0.15)
+    margin = _margin()
     delay = BUDGET - margin - (time.monotonic() - T0)
     if delay > 0:
         time.sleep(delay)
@@ -139,20 +144,59 @@ def _watchdog() -> None:
             os._exit(3)
 
 
+def _ever_captured() -> bool:
+    """Has ANY prior driver round recorded a non-zero metric value?
+
+    Scans the repo's ``BENCH_r*.json`` scoreboard records. While the
+    scoreboard is empty (four rounds running as of r04), spending the
+    entire budget on backend acquisition strictly dominates giving up
+    early to "save" time for a bench that cannot run anyway."""
+    import glob
+
+    for p in glob.glob(os.path.join(os.path.dirname(__file__), "BENCH_r*.json")):
+        try:
+            with open(p) as f:
+                d = json.load(f)
+            parsed = d.get("parsed") or {}
+            if parsed.get("value") or d.get("value"):
+                return True
+        except (OSError, ValueError):
+            continue
+    return False
+
+
 def _acquire_backend() -> None:
-    """Poll the TPU backend in subprocesses until it answers or ~1/3 of
-    the budget is gone (VERDICT r03 item 1: BENCH_r03 died because
-    ``jax.devices()`` was called exactly once while the tunnel was down).
+    """Poll the TPU backend in subprocesses until it answers or the
+    acquisition deadline passes (VERDICT r03 item 1: BENCH_r03 died
+    because ``jax.devices()`` was called exactly once while the tunnel
+    was down).
 
     Probing in a *subprocess* is load-bearing twice over: a hung tunnel
     blocks inside C (in-process timeouts can't fire), and a failed jax
     backend init is sticky for the process lifetime (no in-process
     retry). Each probe pays one backend init (~5-15 s healthy), bounded
     by its own timeout when not.
+
+    Deadline policy (round-4 VERDICT weak #3): while NO round has ever
+    captured a metric, probe until just before the watchdog margin —
+    a late-acquired backend still yields the validated Q1 primary
+    (worth everything when the scoreboard is empty). Once a number is
+    on the board, cap acquisition at ~1/3 budget so a flaky tunnel
+    can't eat the whole extras window.
     """
     if os.environ.get("PRESTO_TPU_BENCH_CPU"):
         return  # CPU smoke mode: nothing to probe
-    deadline = T0 + BUDGET / 3.0
+    if _ever_captured():
+        deadline = T0 + BUDGET / 3.0
+    else:
+        # reserve enough tail for the primary Q1 to actually land after
+        # a late acquisition (generate + transfer + compile + time at a
+        # small fallback SF fits ~60 s) — otherwise a backend acquired
+        # just before the watchdog margin yields value 0 anyway
+        q1_reserve = min(60.0, BUDGET * 0.4)
+        deadline = max(T0 + BUDGET / 3.0,
+                       T0 + BUDGET - _margin() - q1_reserve)
+        _phase("no metric ever captured: probing with the full budget")
     attempt = 0
     last_err = "no probe ran"
     while True:
@@ -654,6 +698,17 @@ def _run(sf: float, stream_mode: bool) -> None:
     # honest timings, device-resident buffers.
     _ = int(jax.device_put(jax.numpy.arange(4), dev).sum())
     _phase("backend attached; sync mode forced")
+
+    if not stream_mode and sf > 0.1 and _remaining() < 90:
+        # late acquisition (empty-scoreboard full-budget probing): a
+        # small-SF validated Q1 beats another value-0 record; the
+        # metric name carries the actual SF so the scoreboard is honest
+        sf = 0.1
+        RESULT["metric"] = f"tpch_q1_rows_per_sec_per_chip_sf{sf:g}"
+        RESULT.setdefault("extra", {})["note"] = (
+            "sf reduced to 0.1: backend acquired late in the budget"
+        )
+        _phase("late acquisition: dropping to sf0.1")
 
     if stream_mode:
         # config-2 capability mode: unbounded-SF streaming Q1 (one chip,

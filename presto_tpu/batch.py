@@ -44,7 +44,7 @@ class Dictionary:
     table).
     """
 
-    __slots__ = ("values", "_index", "_values_str")
+    __slots__ = ("values", "_index", "_values_str", "_bytes_mats")
 
     def __init__(self, values: Sequence[str]):
         vals = sorted(set(values))
@@ -69,6 +69,38 @@ class Dictionary:
 
     def decode(self, codes: np.ndarray) -> np.ndarray:
         return self.values[np.asarray(codes)]
+
+    @property
+    def max_bytes(self) -> int:
+        """Longest value's encoded byte length (cached: planners ask
+        per join key pair)."""
+        try:
+            mats = self._bytes_mats
+        except AttributeError:
+            mats = self._bytes_mats = {}
+        m = mats.get("max_bytes")
+        if m is None:
+            m = max((len(v.encode()) for v in self.values.tolist()), default=0)
+            mats["max_bytes"] = m
+        return m
+
+    def bytes_matrix(self, width: int) -> np.ndarray:
+        """``[len, width]`` uint8 matrix of the values (zero-padded) —
+        the decode table behind ``dict_bytes`` (cross-dictionary join
+        keys materialize codes into comparable fixed-width bytes).
+        Cached per width (dictionaries are shared, long-lived objects)."""
+        try:
+            mats = self._bytes_mats
+        except AttributeError:
+            mats = self._bytes_mats = {}
+        m = mats.get(width)
+        if m is None:
+            m = np.zeros((len(self.values), width), np.uint8)
+            for i, v in enumerate(self.values.tolist()):
+                raw = v.encode()[:width]
+                m[i, : len(raw)] = np.frombuffer(raw, np.uint8)
+            mats[width] = m
+        return m
 
     def __repr__(self) -> str:
         return f"Dictionary({len(self)} values)"

@@ -42,6 +42,25 @@ def _infer_column(values) -> tuple[DataType, np.ndarray, np.ndarray | None, Dict
     s = pd.Series(values)
     valid = s.notna().to_numpy()
     has_null = not valid.all()
+    if s.dtype == object:
+        # nullable numeric columns arrive as object series (the engine's
+        # to_pandas uses None for NULL); falling through to the string
+        # branch would silently store ints as dictionary-encoded VARCHAR
+        # and later joins would compare dictionary codes against ints
+        nz = s.dropna()
+        if len(nz) and all(
+            isinstance(v, (int, np.integer)) and not isinstance(v, bool)
+            for v in nz
+        ):
+            return BIGINT, s.fillna(0).astype(np.int64).to_numpy(), (
+                valid if has_null else None), None
+        if len(nz) and all(
+            isinstance(v, (int, float, np.integer, np.floating))
+            and not isinstance(v, bool)
+            for v in nz
+        ):
+            return DOUBLE, s.fillna(0.0).astype(np.float64).to_numpy(), (
+                valid if has_null else None), None
     if pd.api.types.is_bool_dtype(s):
         return BOOLEAN, s.fillna(False).to_numpy(np.bool_), (
             valid if has_null else None), None

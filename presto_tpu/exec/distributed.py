@@ -530,54 +530,79 @@ class DistributedExecutor:
         return jax.jit(step)
 
     # ---- joins -----------------------------------------------------------
-    def _join_key_exprs(self, lkeys, rkeys, lb: Batch, rb: Batch, scalars):
-        """Single key passthrough / multi-key bit-pack (runtime maxima
-        over the distributed batches — jnp.max rides the sharding)."""
-        from presto_tpu.expr import Call, InputRef, Literal
+    def _join_key_exprs(self, node, left: DistBatch, right: DistBatch, scalars):
+        """Shared key normalization (``exec/joinkeys.py``): BYTES
+        pack/hash+verify, cross-dictionary VARCHAR handling, multi-key
+        bit-packing. Widths come from connector-stats intervals when
+        covered; the runtime fallback (jnp.min/max riding the sharding,
+        then a host readback) is paid only for stats-less multi-key
+        pairs (round-3 ask #5). Returns (lkey, rkey, verify)."""
+        from presto_tpu.exec.joinkeys import join_key_exprs
 
-        lkeys = [bind_scalars(k, scalars) for k in lkeys]
-        rkeys = [bind_scalars(k, scalars) for k in rkeys]
-        if len(lkeys) == 1:
-            return lkeys[0], rkeys[0]
-        widths = []
-        for lk, rk in zip(lkeys, rkeys):
-            mx = 0
-            for batch, key in ((lb, lk), (rb, rk)):
-                v = evaluate(key, batch)
-                data = v.data.astype(jnp.int64)
-                m = int(jnp.max(jnp.where(batch.live & v.valid, data, 0)))
-                mn = int(jnp.min(jnp.where(batch.live & v.valid, data, 0)))
-                if mn < 0:
-                    raise NotImplementedError("negative join keys")
-                mx = max(mx, m)
-            widths.append(max(1, int(mx).bit_length()))
-        if sum(widths) > 63:
-            raise NotImplementedError("packed join key exceeds 63 bits")
+        def runtime_minmax(side: int, key):
+            b = (left if side == 0 else right).batch
+            v = evaluate(key, b)
+            data = v.data.astype(jnp.int64)
+            live = b.live & v.valid
+            return (
+                int(jnp.min(jnp.where(live, data, 0))),
+                int(jnp.max(jnp.where(live, data, 0))),
+            )
 
-        def pack(keys):
-            e = Call(BIGINT, "cast_bigint", (keys[0],))
-            for k, w in zip(keys[1:], widths[1:]):
-                shifted = Call(BIGINT, "mul", (e, Literal(BIGINT, 1 << w)))
-                e = Call(BIGINT, "add", (shifted, Call(BIGINT, "cast_bigint", (k,))))
-            return e
-        return pack(lkeys), pack(rkeys)
+        def runtime_dict(side: int, key):
+            b = (left if side == 0 else right).batch
+            return b[key.name].dictionary if key.name in b else None
+
+        return join_key_exprs(
+            node.left_keys, node.right_keys, scalars,
+            catalog=self.catalog, lnode=node.left, rnode=node.right,
+            runtime_minmax=runtime_minmax, runtime_dict=runtime_dict,
+        )
 
     def _exec_join(self, node: N.Join, scalars) -> DistBatch:
         left = self._exec(node.left, scalars)
         right = self._exec(node.right, scalars)
-        lkey, rkey = self._join_key_exprs(
-            node.left_keys, node.right_keys, left.batch, right.batch, scalars
-        )
+        lkey, rkey, verify = self._join_key_exprs(node, left, right, scalars)
+        if verify and not node.unique and node.kind != "inner":
+            raise NotImplementedError(
+                "wide string keys on non-unique OUTER joins (verification "
+                "cannot re-synthesize the null-extended row)"
+            )
         build_rows = live_count(right.batch)
         if (
             build_rows <= self.broadcast_limit
             or not right.sharded
             or not left.sharded
         ):
-            return self._broadcast_join(node, left, right, lkey, rkey)
-        return self._repartition_join(node, left, right, lkey, rkey)
+            return self._broadcast_join(node, left, right, lkey, rkey, verify)
+        return self._repartition_join(node, left, right, lkey, rkey, verify)
 
-    def _broadcast_join(self, node, left: DistBatch, right: DistBatch, lkey, rkey):
+    def _concat_sharded(self, d: DistBatch, extra: Batch) -> DistBatch:
+        """Append an (unsharded) batch to a DistBatch: shard the extra
+        rows over the mesh, then per-device concatenation (the same
+        no-collective bag union as UNION ALL)."""
+        from presto_tpu.exec.operators import concat_batches
+
+        names = list(d.batch.names)
+        extra = extra.select(names)
+        if not d.sharded:
+            return DistBatch(concat_batches([d.batch, extra]), sharded=False)
+        Pn = self.nworkers
+        extra = _pad_rows(extra, -(-extra.capacity // Pn) * Pn)
+        extra = self._shard(extra)
+
+        @partial(
+            shard_map, mesh=self.mesh,
+            in_specs=(P(self.axes), P(self.axes)), out_specs=P(self.axes),
+            check_vma=False,
+        )
+        def step(a: Batch, b: Batch):
+            return concat_batches([a.select(names), b])
+
+        return DistBatch(jax.jit(step)(d.batch, extra), sharded=True)
+
+    def _broadcast_join(self, node, left: DistBatch, right: DistBatch,
+                        lkey, rkey, verify=()):
         """REPLICATED distribution: all_gather the build side, probe
         stays sharded (probe's binary-search gathers hit the local
         replica — no collective in the probe step)."""
@@ -590,8 +615,12 @@ class DistributedExecutor:
         build.process(rb)
         build.finish()
         outs = [BuildOutput(n, n) for n in node.output_right]
+        if node.kind == "full":
+            return self._broadcast_full_join(node, left, build, lkey, outs,
+                                             verify)
         if node.unique:
-            op = LookupJoinOperator(build, lkey, outs, node.kind, unique=True)
+            op = LookupJoinOperator(build, lkey, outs, node.kind, unique=True,
+                                    verify=verify)
             return DistBatch(op.process(left.batch)[0], left.sharded)
         out_cap = batch_capacity(
             max(left.batch.capacity, live_count(rb), 1024)
@@ -599,16 +628,76 @@ class DistributedExecutor:
         for _ in range(MAX_RETRIES):
             try:
                 op = LookupJoinOperator(
-                    build, lkey, outs, node.kind, unique=False, out_capacity=out_cap
+                    build, lkey, outs, node.kind, unique=False,
+                    out_capacity=out_cap, verify=verify,
                 )
                 return DistBatch(op.process(left.batch)[0], left.sharded)
             except CapacityOverflow:
                 out_cap *= 2
         raise CapacityOverflow("BroadcastJoin", out_cap)
 
-    def _repartition_join(self, node, left: DistBatch, right: DistBatch, lkey, rkey):
+    def _broadcast_full_join(self, node, left: DistBatch, build, lkey, outs,
+                             verify=()):
+        """FULL OUTER over a replicated build: probe with LEFT
+        semantics while accumulating matched-build flags, then emit the
+        never-matched build rows ONCE as an appended tail. The flag
+        scatter runs under jit over the sharded probe — XLA's sharding
+        propagation inserts the cross-device combine, so the host reads
+        globally-correct flags (each build row is replicated on every
+        device; the tail must not be emitted per replica)."""
+        from presto_tpu.exec.joins import full_init_flags, full_tail
+
+        flags = full_init_flags(build)
+        if node.unique:
+            op = LookupJoinOperator(build, lkey, outs, "full", unique=True,
+                                    verify=verify)
+            out, flags = op.process_full(left.batch, flags)
+        else:
+            out_cap = batch_capacity(
+                max(left.batch.capacity, live_count(build.payload), 1024)
+            )
+            for _ in range(MAX_RETRIES):
+                try:
+                    op = LookupJoinOperator(
+                        build, lkey, outs, "full", unique=False,
+                        out_capacity=out_cap,
+                    )
+                    out, flags = op.process_full(left.batch, flags)
+                    break
+                except CapacityOverflow:
+                    out_cap *= 2
+            else:
+                raise CapacityOverflow("BroadcastFullJoin", out_cap)
+        tail = full_tail(build, outs, flags, left.batch)
+        return self._concat_sharded(DistBatch(out, left.sharded), tail)
+
+    def _repartition_join(self, node, left: DistBatch, right: DistBatch,
+                          lkey, rkey, verify=()):
         """FIXED_HASH distribution: all_to_all both sides on the join
-        key so matching rows colocate, then join device-locally."""
+        key so matching rows colocate, then join device-locally. After
+        the exchange every build row lives on exactly ONE device, so
+        FULL OUTER's unmatched-build tail is computed and appended
+        device-locally inside the same compiled step."""
+        from presto_tpu.expr import InputRef
+
+        # runtime backstop mirroring LookupJoinOperator._check_probe_dict:
+        # dictionary codes from two different dictionaries must never be
+        # hashed/partitioned/joined as if comparable (the planner's
+        # runtime_dict hook should have re-encoded them; this refuses if
+        # anything slipped through)
+        if (
+            isinstance(lkey, InputRef)
+            and lkey.dtype.kind is TypeKind.VARCHAR
+            and isinstance(rkey, InputRef)
+        ):
+            lb, rb = left.batch, right.batch
+            dl = lb[lkey.name].dictionary if lkey.name in lb else None
+            dr = rb[rkey.name].dictionary if rkey.name in rb else None
+            if dl is not None and dr is not None and dl is not dr:
+                raise NotImplementedError(
+                    "join keys are encoded against different dictionaries; "
+                    "codes are not comparable across dictionaries"
+                )
         Pn = self.nworkers
         lcap = left.batch.capacity // Pn
         rcap = right.batch.capacity // Pn
@@ -625,9 +714,15 @@ class DistributedExecutor:
         # retries double the receive/build/output capacities only
         for _ in range(MAX_RETRIES):
             step = self._make_repartition_join_step(
-                node, lkey, rkey, lquota, rquota, lrecv, rrecv, out_cap
+                node, lkey, rkey, lquota, rquota, lrecv, rrecv, out_cap,
+                verify,
             )
-            out, overflow = step(left.batch, right.batch)
+            out, overflow, long_runs = step(left.batch, right.batch)
+            if bool(long_runs):
+                raise NotImplementedError(
+                    "hash-key collision run exceeds the verified probe's "
+                    "candidate window"
+                )
             if not bool(overflow):
                 return DistBatch(out, sharded=True)
             lrecv *= 2
@@ -637,19 +732,53 @@ class DistributedExecutor:
         raise CapacityOverflow("RepartitionJoin", max(lrecv, rrecv))
 
     def _make_repartition_join_step(
-        self, node, lkey, rkey, lquota, rquota, lrecv, rrecv, out_cap
+        self, node, lkey, rkey, lquota, rquota, lrecv, rrecv, out_cap,
+        verify=(),
     ):
+        from presto_tpu.exec.joins import (
+            long_dup_runs_flag,
+            verified_unique_probe,
+            verify_mask,
+        )
+
         Pn = self.nworkers
         outs = [BuildOutput(n, n) for n in node.output_right]
         kind = node.kind
         unique = node.unique
 
+        def null_probe_cols(le: Batch, cap: int) -> dict:
+            """All-NULL probe columns for the FULL OUTER build tail."""
+            cols = {}
+            for name in le.names:
+                src = le[name]
+                cols[name] = Column(
+                    jnp.zeros((cap,) + tuple(src.data.shape[1:]),
+                              src.data.dtype),
+                    jnp.zeros(cap, jnp.bool_),
+                    src.dtype, src.dictionary,
+                )
+            return cols
+
+        def full_tail_local(le: Batch, re: Batch, flags) -> Batch:
+            """Unmatched build rows (device-local after the exchange)
+            with NULL probe columns."""
+            cap = re.capacity
+            cols = null_probe_cols(le, cap)
+            for bo in outs:
+                src = re[bo.source]
+                cols[bo.name] = Column(src.data, src.valid, src.dtype,
+                                       src.dictionary)
+            return Batch(cols, re.live & ~flags)
+
         @partial(
             shard_map, mesh=self.mesh,
-            in_specs=(P(self.axes), P(self.axes)), out_specs=(P(self.axes), P()),
+            in_specs=(P(self.axes), P(self.axes)),
+            out_specs=(P(self.axes), P(), P()),
             check_vma=False,
         )
         def step(lb: Batch, rb: Batch):
+            from presto_tpu.exec.operators import concat_batches
+
             lv = evaluate(lkey, lb)
             rv = evaluate(rkey, rb)
             lpids = partition_ids([lv.data.astype(jnp.int64)], Pn)
@@ -664,12 +793,26 @@ class DistributedExecutor:
             pv = evaluate(lkey, le)
             pvalid = le.live & pv.valid
             ovf = ovf1 | ovf2 | side.overflow
+            if unique and verify:
+                # the verified unique probe scans a fixed candidate
+                # window; a longer hash-collision run must surface as a
+                # host-visible refusal, never a silent mis-probe (the
+                # build happens inside this compiled step, so the
+                # operator-level long_dup_runs check can't run here)
+                longrun = long_dup_runs_flag(side.sorted_keys)
+            else:
+                longrun = jnp.zeros((), jnp.bool_)
+            longrun = any_flag(longrun, self.axes)
             if kind in ("semi", "anti"):
                 exists = probe_exists(side, pv.data, pvalid)
                 keep = exists if kind == "semi" else le.live & ~exists
-                return le.with_live(le.live & keep), any_flag(ovf, self.axes)
+                return (le.with_live(le.live & keep), any_flag(ovf, self.axes),
+                        longrun)
             if unique:
-                res = probe_unique(side, pv.data, pvalid)
+                if verify:
+                    res = verified_unique_probe(side, lkey, verify, re, le)
+                else:
+                    res = probe_unique(side, pv.data, pvalid)
                 cols = dict(le.columns)
                 for bo in outs:
                     src = re[bo.source]
@@ -679,8 +822,27 @@ class DistributedExecutor:
                         src.dtype, src.dictionary,
                     )
                 live = le.live & res.matched if kind == "inner" else le.live
-                return Batch(cols, live), any_flag(ovf, self.axes)
-            res = probe_expand(side, pv.data, pvalid, out_cap, left=(kind == "left"))
+                pout = Batch(cols, live)
+                if kind != "full":
+                    return pout, any_flag(ovf, self.axes), longrun
+                flags = (
+                    jnp.zeros(re.capacity, jnp.bool_)
+                    .at[jnp.where(res.matched, res.build_row, re.capacity)]
+                    .set(True, mode="drop")
+                )
+                tail = full_tail_local(le, re, flags)
+                return (
+                    concat_batches([pout, tail]),
+                    any_flag(ovf, self.axes),
+                    longrun,
+                )
+            res = probe_expand(
+                side, pv.data, pvalid, out_cap,
+                left=(kind in ("left", "full")), emit_live=le.live,
+            )
+            # verify pairs are inner-only here (guarded in _exec_join)
+            live = verify_mask(verify, le, re, res.build_row,
+                               probe_row=res.probe_row, init=res.live)
             cols = {}
             for name in le.names:
                 src = le[name]
@@ -696,16 +858,31 @@ class DistributedExecutor:
                     gather_padded(src.valid, res.build_row, False),
                     src.dtype, src.dictionary,
                 )
-            return Batch(cols, res.live), any_flag(ovf | res.overflow, self.axes)
+            pout = Batch(cols, live)
+            if kind != "full":
+                return pout, any_flag(ovf | res.overflow, self.axes), longrun
+            flags = (
+                jnp.zeros(re.capacity, jnp.bool_)
+                .at[res.build_row]
+                .set(True, mode="drop")
+            )
+            tail = full_tail_local(le, re, flags)
+            return (
+                concat_batches([pout, tail]),
+                any_flag(ovf | res.overflow, self.axes),
+                longrun,
+            )
 
         return jax.jit(step)
 
     def _exec_semijoin(self, node: N.SemiJoin, scalars) -> DistBatch:
         left = self._exec(node.left, scalars)
         right = self._exec(node.right, scalars)
-        lkey, rkey = self._join_key_exprs(
-            node.left_keys, node.right_keys, left.batch, right.batch, scalars
-        )
+        lkey, rkey, verify = self._join_key_exprs(node, left, right, scalars)
+        if verify:
+            # existence probes have no build_row to verify against;
+            # hash collisions could flip semi/anti membership
+            raise NotImplementedError("wide string semi-join keys")
         build_rows = live_count(right.batch)
         if (
             build_rows <= self.broadcast_limit

@@ -1,0 +1,145 @@
+"""Join-key normalization shared by the local and distributed tiers.
+
+Reference parity: the key-normalization half of ``HashBuilderOperator``/
+``LookupJoinOperator`` planning — multi-channel join keys hash into one
+lookup position, string channels compare by value [SURVEY §2.1 operator
+row, §3.4; reference tree unavailable, paths reconstructed].
+
+TPU-first: every join key becomes ONE int64 column so the sorted-probe
+kernels stay single-key:
+
+- narrow BYTES (width <= 7) pack exactly (order-preserving, PAD SPACE);
+- wide BYTES hash to 63 bits with collision ``verify`` pairs re-checked
+  on the original bytes by the probe;
+- dictionary-encoded VARCHAR keys join on codes ONLY when both sides
+  provably share one dictionary object; otherwise codes are meaningless
+  across dictionaries and the keys are materialized to comparable
+  fixed-width BYTES via ``dict_bytes`` (silent code-space joins were a
+  wrong-results class, round-5);
+- multi-key pairs bit-pack into one int64. Bit widths come from
+  connector stats intervals (``plan/bounds.py``) when they cover the
+  key — the generators' stats are exact domains — with a runtime
+  min/max probe as the fallback (the probe costs device readbacks and,
+  on the distributed tier, full-batch reductions before the step
+  compiles, so stats are strongly preferred; round-3 ask #5).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+from presto_tpu.expr import BIGINT, Call, Expr, InputRef, Literal, bind_scalars
+from presto_tpu.plan.bounds import expr_interval, key_dictionary, node_intervals
+from presto_tpu.types import TypeKind, fixed_bytes
+
+
+def join_key_exprs(
+    lkeys: Sequence[Expr],
+    rkeys: Sequence[Expr],
+    scalars: dict,
+    *,
+    catalog,
+    lnode,
+    rnode,
+    runtime_minmax: Callable[[int, Expr], tuple[int, int]],
+    runtime_dict: Callable[[int, Expr], object] | None = None,
+):
+    """Normalize (left, right) key expr lists to ONE packed int64 pair.
+
+    ``runtime_minmax(side, expr)`` -> (min, max) over live, valid rows
+    of that side (side 0 = left/probe, 1 = right/build); only invoked
+    for multi-key pairs whose stats intervals are unknown.
+
+    ``runtime_dict(side, expr)`` -> the Dictionary object the key
+    column actually carries (or None) — the metadata-only fallback when
+    plan-time provenance tracing can't find a dictionary (e.g. the key
+    flows through a UNION or CTAS); with it, cross-dictionary keys are
+    still value-compared instead of falling back to the operators'
+    refuse-at-runtime guard.
+
+    Returns ``(lkey, rkey, verify)`` where ``verify`` is the list of
+    (probe_expr, build_expr) pairs the probe must re-check by value
+    (hash keys only).
+    """
+    lkeys = [bind_scalars(k, scalars) for k in lkeys]
+    rkeys = [bind_scalars(k, scalars) for k in rkeys]
+    verify: list[tuple[Expr, Expr]] = []
+
+    def dict_of(node, side: int, e: Expr):
+        if not (isinstance(e, InputRef) and e.dtype.kind is TypeKind.VARCHAR):
+            return None
+        d = key_dictionary(node, e.name, catalog)
+        if d is None and runtime_dict is not None:
+            d = runtime_dict(side, e)
+        return d
+
+    def as_bytes_pair(lk: Expr, rk: Expr):
+        """BYTES normalization: pack (<=7) or hash + verify."""
+        if lk.dtype.width != rk.dtype.width:
+            # equal CHAR values of different declared widths would
+            # pack/hash differently (padding is part of the bytes)
+            raise NotImplementedError("string join keys of unequal width")
+        if lk.dtype.width <= 7:
+            fn = "bytes_pack"
+        else:
+            fn = "bytes_hash"
+            verify.append((lk, rk))
+        return Call(BIGINT, fn, (lk,)), Call(BIGINT, fn, (rk,))
+
+    def wrap(lk: Expr, rk: Expr):
+        if lk.dtype.kind is TypeKind.VARCHAR or rk.dtype.kind is TypeKind.VARCHAR:
+            if lk.dtype.kind is not rk.dtype.kind:
+                raise NotImplementedError(
+                    "join key type mismatch (VARCHAR vs non-VARCHAR); "
+                    "cast one side explicitly"
+                )
+            dl = dict_of(lnode, 0, lk)
+            dr = dict_of(rnode, 1, rk)
+            if dl is not None and dl is dr:
+                return lk, rk  # one shared dictionary: codes are exact
+            if dl is not None and dr is not None:
+                # different dictionaries: compare by VALUE, not code
+                w = max(dl.max_bytes, dr.max_bytes, 1)
+                t = fixed_bytes(w)
+                return as_bytes_pair(
+                    Call(t, "dict_bytes", (lk,)), Call(t, "dict_bytes", (rk,))
+                )
+            # unprovable at plan time: pass codes through — the join
+            # operators hold a runtime same-dictionary guard that
+            # raises instead of joining incomparable code spaces
+            return lk, rk
+        if lk.dtype.kind is TypeKind.BYTES:
+            return as_bytes_pair(lk, rk)
+        return lk, rk
+
+    pairs = [wrap(lk, rk) for lk, rk in zip(lkeys, rkeys)]
+    lkeys = [p[0] for p in pairs]
+    rkeys = [p[1] for p in pairs]
+    if len(lkeys) == 1:
+        return lkeys[0], rkeys[0], verify
+
+    lenv = node_intervals(lnode, catalog)
+    renv = node_intervals(rnode, catalog)
+    widths = []
+    for lk, rk in zip(lkeys, rkeys):
+        mx = 0
+        for side, env, key in ((0, lenv, lk), (1, renv, rk)):
+            iv = expr_interval(key, env)
+            if iv is None:
+                iv = runtime_minmax(side, key)
+            mn, m = int(iv[0]), int(iv[1])
+            if mn < 0:
+                raise NotImplementedError("negative join keys")
+            mx = max(mx, m)
+        widths.append(max(1, int(mx).bit_length()))
+    if sum(widths) > 63:
+        raise NotImplementedError("packed join key exceeds 63 bits")
+
+    def pack(keys):
+        e = Call(BIGINT, "cast_bigint", (keys[0],))
+        for k, w in zip(keys[1:], widths[1:]):
+            shifted = Call(BIGINT, "mul", (e, Literal(BIGINT, 1 << w)))
+            e = Call(BIGINT, "add", (shifted, Call(BIGINT, "cast_bigint", (k,))))
+        return e
+
+    return pack(lkeys), pack(rkeys), verify

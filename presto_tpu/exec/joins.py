@@ -33,11 +33,12 @@ from presto_tpu.exec.operators import (
     Operator,
     concat_batches,
 )
-from presto_tpu.expr import Expr, evaluate
+from presto_tpu.expr import Expr, InputRef, evaluate
 from presto_tpu.ops.groupby import gather_padded
 from presto_tpu.ops.join import (
     BuildSide,
     DenseSide,
+    UniqueProbe,
     build_dense,
     build_lookup,
     probe_exists,
@@ -47,6 +48,58 @@ from presto_tpu.ops.join import (
     probe_unique_dense,
 )
 from presto_tpu.spi import batch_capacity
+
+import numpy as _np
+
+_I64_SENTINEL = _np.int64(_np.iinfo(_np.int64).max)
+
+#: candidate window scanned per probe row on hash-key (verify) unique
+#: probes: covers collision runs of up to this many equal hashed keys
+VERIFY_CANDIDATES = 4
+
+
+def _pad_sp(d):
+    """PAD SPACE normalization for BYTES equality in verify compares
+    (mirrors expr._pad_space: zero padding compares as spaces, so a
+    space-padded computed string matches zero-padded storage)."""
+    if d.ndim > 1:
+        return jnp.where(d == 0, jnp.uint8(32), d)
+    return d
+
+
+def long_dup_runs_flag(sorted_keys):
+    """Traced bool: some non-sentinel key run exceeds VERIFY_CANDIDATES.
+
+    The single definition both refusal sites use (operator build and
+    the distributed repartition step) — the verified probe's candidate
+    window and this detector must stay in lockstep."""
+    sk = sorted_keys
+    K = VERIFY_CANDIDATES
+    return jnp.any((sk[K:] == sk[:-K]) & (sk[K:] != _I64_SENTINEL))
+
+
+def verify_mask(verify, probe_batch: Batch, payload: Batch,
+                build_row, probe_row=None, init=None):
+    """AND together the by-value equality checks for hash-key verify
+    pairs — the one implementation of the PAD-SPACE-normalized compare
+    (probe value vs build payload value gathered through ``build_row``;
+    with ``probe_row`` the probe side is gathered too, using asymmetric
+    0/1 fills so out-of-range sentinel rows can never compare equal)."""
+    mask = init
+    for pe, be in verify:
+        pv = evaluate(pe, probe_batch)
+        bv = evaluate(be, payload)
+        pd_ = _pad_sp(pv.data)
+        if probe_row is not None:
+            pd_ = gather_rows(pd_, probe_row, 0)
+            bd = gather_rows(_pad_sp(bv.data), build_row, 1)
+        else:
+            bd = gather_rows(_pad_sp(bv.data), build_row, 1)
+        eq = pd_ == bd
+        if eq.ndim > 1:
+            eq = eq.all(axis=1)
+        mask = eq if mask is None else (mask & eq)
+    return mask
 
 
 def gather_rows(data, idx, fill):
@@ -83,6 +136,9 @@ class JoinBuildOperator(CollectingOperator):
         self.build_side: BuildSide | None = None
         self.dense_side: DenseSide | None = None
         self.payload: Batch | None = None
+        #: True when some sorted-key run exceeds VERIFY_CANDIDATES —
+        #: hash-key verified probes must refuse (see finish())
+        self.long_dup_runs: bool = False
 
     def finish(self) -> list[Batch]:
         if not self.batches:
@@ -98,12 +154,25 @@ class JoinBuildOperator(CollectingOperator):
             live = b.live & v.valid
             side = build_lookup(v.data, live, cap)
             dense = build_dense(v.data, live, dd[0], dd[1]) if dd else None
-            return side, dense
+            # key-run length > VERIFY_CANDIDATES detector: hash-key
+            # probes scan a fixed candidate window per probe row, so a
+            # longer collision run (>= 5 distinct strings sharing one
+            # 63-bit hash — astronomically unlikely) must be refused,
+            # not silently mis-probed
+            return side, dense, long_dup_runs_flag(side.sorted_keys)
 
-        side, dense = build(batch)
+        side, dense, long_runs = build(batch)
         if bool(side.overflow):
             raise CapacityOverflow("JoinBuild", cap, int(side.n_rows))
         self.build_side = side
+        self.long_dup_runs = bool(long_runs)
+        # dictionary provenance for the probe-side runtime guard:
+        # dictionary codes are only comparable within ONE dictionary
+        self.key_dict = (
+            batch[self.key.name].dictionary
+            if isinstance(self.key, InputRef) and self.key.name in batch
+            else None
+        )
         if dense is not None and not bool(dense.overflow):
             self.dense_side = dense
         self.payload = batch
@@ -152,20 +221,21 @@ class LookupJoinOperator(Operator):
         self.out_capacity = out_capacity
         self.verify = list(verify)
         self._step = None
+        self._full_step = None
 
-    def _verified(self, res, payload: Batch, batch: Batch):
-        """AND the probe result's matched mask with original-value
-        equality for each verify pair (hash-collision rejection)."""
-        matched = res.matched
-        for pe, be in self.verify:
-            pv = evaluate(pe, batch)
-            bv = evaluate(be, payload)
-            bd = gather_rows(bv.data, res.build_row, 0)
-            eq = pv.data == bd
-            if eq.ndim > 1:
-                eq = eq.all(axis=1)
-            matched = matched & eq
-        return matched
+    def _unique_probe(self, side, payload: Batch, batch: Batch, use_dense):
+        """Probe-aligned unique lookup: (build_row, matched).
+
+        Without verify pairs this is the plain 1-candidate probe. With
+        verify pairs (hash keys) it is the collision-run scanning
+        ``verified_unique_probe`` below."""
+        key = self.probe_key
+        if not self.verify:
+            v = evaluate(key, batch)
+            probe = probe_unique_dense if use_dense else probe_unique
+            return probe(side, v.data, batch.live & v.valid)
+        assert not use_dense, "dense sides never carry hash verify keys"
+        return verified_unique_probe(side, key, self.verify, payload, batch)
 
     def _ensure_step(self):
         if self._step is not None:
@@ -196,13 +266,16 @@ class LookupJoinOperator(Operator):
             return
 
         if unique:
+            if self.verify and self.build.long_dup_runs:
+                raise NotImplementedError(
+                    "hash-key collision run exceeds the verified probe's "
+                    f"candidate window ({VERIFY_CANDIDATES})"
+                )
 
             @jax.jit
             def step(side, payload: Batch, batch: Batch) -> Batch:
-                v = evaluate(key, batch)
-                probe = probe_unique_dense if use_dense else probe_unique
-                res = probe(side, v.data, batch.live & v.valid)
-                matched = self._verified(res, payload, batch)
+                res = self._unique_probe(side, payload, batch, use_dense)
+                matched = res.matched
                 cols = dict(batch.columns)
                 for bo in outs:
                     src = payload[bo.source]
@@ -230,17 +303,10 @@ class LookupJoinOperator(Operator):
 
         def step(side: BuildSide, payload: Batch, batch: Batch):
             v = evaluate(key, batch)
-            res = probe_expand(side, v.data, batch.live & v.valid, out_cap, left=left)
-            live = res.live
-            for pe, be in verify:
-                pv = evaluate(pe, batch)
-                bv = evaluate(be, payload)
-                pd_ = gather_rows(pv.data, res.probe_row, 0)
-                bd = gather_rows(bv.data, res.build_row, 1)
-                eq = pd_ == bd
-                if eq.ndim > 1:
-                    eq = eq.all(axis=1)
-                live = live & eq
+            res = probe_expand(side, v.data, batch.live & v.valid, out_cap,
+                               left=left, emit_live=batch.live)
+            live = verify_mask(verify, batch, payload, res.build_row,
+                               probe_row=res.probe_row, init=res.live)
             cols = {}
             for name in batch.names:
                 src = batch[name]
@@ -262,8 +328,25 @@ class LookupJoinOperator(Operator):
 
         self._step = jax.jit(step)
 
+    def _check_probe_dict(self, batch: Batch):
+        """Runtime backstop for dictionary-encoded keys the planner
+        could not trace to a source dictionary: joining code spaces of
+        two DIFFERENT dictionaries would be silently wrong, so refuse."""
+        k = self.probe_key
+        if not (isinstance(k, InputRef) and k.name in batch):
+            return
+        pdict = batch[k.name].dictionary
+        bdict = getattr(self.build, "key_dict", None)
+        if pdict is not None and bdict is not None and pdict is not bdict:
+            raise NotImplementedError(
+                "join keys are encoded against different dictionaries "
+                "and their provenance was not visible to the planner; "
+                "codes are not comparable across dictionaries"
+            )
+
     def process(self, batch: Batch) -> list[Batch]:
         assert self.build.build_side is not None, "build side not finished"
+        self._check_probe_dict(batch)
         self._ensure_step()
         if self.unique or self.join_type in ("semi", "anti"):
             side = (
@@ -288,20 +371,23 @@ class LookupJoinOperator(Operator):
     # partial update (the scatter is idempotent).
 
     def _ensure_full_step(self):
-        if self._step is not None:
+        if self._full_step is not None:
             return
         outs = self.build_outputs
         key = self.probe_key
         use_dense = self.build.dense_side is not None
 
         if self.unique:
+            if self.verify and self.build.long_dup_runs:
+                raise NotImplementedError(
+                    "hash-key collision run exceeds the verified probe's "
+                    f"candidate window ({VERIFY_CANDIDATES})"
+                )
 
             @jax.jit
             def step(side, payload: Batch, flags, batch: Batch):
-                v = evaluate(key, batch)
-                probe = probe_unique_dense if use_dense else probe_unique
-                res = probe(side, v.data, batch.live & v.valid)
-                matched = self._verified(res, payload, batch)
+                res = self._unique_probe(side, payload, batch, use_dense)
+                matched = res.matched
                 cols = dict(batch.columns)
                 for bo in outs:
                     src = payload[bo.source]
@@ -317,16 +403,22 @@ class LookupJoinOperator(Operator):
                 flags = flags.at[rows].set(True, mode="drop")
                 return Batch(cols, batch.live), flags
 
-            self._step = step
+            self._full_step = step
             return
 
         out_cap = self.out_capacity
         assert out_cap is not None, "expansion join requires out_capacity"
+        assert not self.verify, (
+            "hash-key verification on expansion FULL OUTER is unsupported "
+            "(an all-collision probe row cannot re-synthesize its "
+            "null-extended output row)"
+        )
 
         @jax.jit
         def step(side: BuildSide, payload: Batch, flags, batch: Batch):
             v = evaluate(key, batch)
-            res = probe_expand(side, v.data, batch.live & v.valid, out_cap, left=True)
+            res = probe_expand(side, v.data, batch.live & v.valid, out_cap,
+                               left=True, emit_live=batch.live)
             cols = {}
             for name in batch.names:
                 src = batch[name]
@@ -347,13 +439,14 @@ class LookupJoinOperator(Operator):
             flags = flags.at[res.build_row].set(True, mode="drop")
             return Batch(cols, res.live), flags, res.overflow
 
-        self._step = step
+        self._full_step = step
 
     def process_full(self, batch: Batch, flags):
         """One FULL OUTER probe step: returns (out_batch, new_flags).
         Raises CapacityOverflow on expansion overflow — the caller
         retries the same batch with the PREVIOUS flags."""
         assert self.build.build_side is not None, "build side not finished"
+        self._check_probe_dict(batch)
         self._ensure_full_step()
         if self.unique:
             side = (
@@ -361,13 +454,43 @@ class LookupJoinOperator(Operator):
                 if self.build.dense_side is not None
                 else self.build.build_side
             )
-            return self._step(side, self.build.payload, flags, batch)
-        out, new_flags, overflow = self._step(
+            return self._full_step(side, self.build.payload, flags, batch)
+        out, new_flags, overflow = self._full_step(
             self.build.build_side, self.build.payload, flags, batch
         )
         if bool(overflow):
             raise CapacityOverflow("LookupJoin", self.out_capacity)
         return out, new_flags
+
+
+def verified_unique_probe(side, key, verify, payload: Batch, batch: Batch):
+    """Unique probe over hashed keys with in-kernel verification.
+
+    Distinct build values can collide on one hashed key, making the
+    hashed key non-unique even though the original build keys are
+    unique — searchsorted alone would return one arbitrary colliding
+    candidate and the bytes check would then wrongly reject the true
+    match, silently dropping join rows. So scan the whole collision
+    run (VERIFY_CANDIDATES wide; builds refuse longer runs via
+    ``long_dup_runs``) and keep the value-verified candidate. Shared
+    by LookupJoinOperator and the distributed repartition-join step."""
+    v = evaluate(key, batch)
+    plive = batch.live & v.valid
+    pk = jnp.where(plive, v.data.astype(jnp.int64), _I64_SENTINEL)
+    lo = jnp.searchsorted(side.sorted_keys, pk, side="left", method="sort")
+    cap = side.row_idx.shape[0]
+    best = jnp.full(pk.shape, cap, side.row_idx.dtype)
+    matched = jnp.zeros(pk.shape, jnp.bool_)
+    for k in range(VERIFY_CANDIDATES):
+        pos = lo + k
+        hit = gather_padded(side.sorted_keys, pos, _I64_SENTINEL)
+        row = gather_padded(side.row_idx, pos, cap)
+        ok = (hit == pk) & plive & (pk != _I64_SENTINEL)
+        ok = verify_mask(verify, batch, payload, row, init=ok)
+        take = ok & ~matched
+        best = jnp.where(take, row, best)
+        matched = matched | ok
+    return UniqueProbe(jnp.where(matched, best, cap), matched)
 
 
 def full_init_flags(build: JoinBuildOperator):

@@ -273,67 +273,43 @@ class LocalExecutor:
     # ---- joins -----------------------------------------------------------
     def _join_key_exprs(
         self, lkeys: Sequence[Expr], rkeys: Sequence[Expr],
-        left, right, scalars,
+        left, right, scalars, lnode: N.PlanNode, rnode: N.PlanNode,
     ):
-        """Single-key passthrough or multi-key bit-packing using
-        runtime maxima over both sides (keys must be non-negative).
-        Multi-key joins pay one extra streaming pass over the probe
-        side to find the maxima (the stream replays for the probe).
-
-        String (BYTES) keys become integer keys first: exact
-        order-preserving packs for width <= 7, 63-bit hashes beyond —
-        the returned ``verify`` pairs carry the original (probe, build)
-        exprs the unique probe must re-check against collisions.
+        """Shared key normalization (see ``exec/joinkeys.py``): BYTES
+        pack/hash+verify, cross-dictionary VARCHAR handling, multi-key
+        bit-packing with stats-derived widths. The runtime min/max
+        fallback streams over both sides (replayable streams re-run for
+        the actual probe) — only multi-key pairs without stats pay it.
         Returns (lkey, rkey, verify)."""
-        lkeys = [bind_scalars(k, scalars) for k in lkeys]
-        rkeys = [bind_scalars(k, scalars) for k in rkeys]
-        verify: list[tuple[Expr, Expr]] = []
+        from presto_tpu.exec.joinkeys import join_key_exprs
+        from presto_tpu.expr import evaluate
 
-        def wrap(lk, rk):
-            if lk.dtype.kind is not TypeKind.BYTES:
-                return lk, rk
-            if lk.dtype.width != rk.dtype.width:
-                # equal CHAR values of different declared widths would
-                # pack/hash differently (padding is part of the bytes)
-                raise NotImplementedError("string join keys of unequal width")
-            if lk.dtype.width <= 7:
-                fn = "bytes_pack"
-            else:
-                fn = "bytes_hash"
-                verify.append((lk, rk))
-            return Call(BIGINT, fn, (lk,)), Call(BIGINT, fn, (rk,))
+        def runtime_minmax(side: int, key: Expr):
+            batches = left if side == 0 else right
+            mn, mx = 0, 0
+            for b in batches:
+                v = evaluate(key, b)
+                data = v.data.astype(jnp.int64)
+                live = b.live & v.valid
+                mx = max(mx, int(jnp.max(jnp.where(live, data, 0))))
+                mn = min(mn, int(jnp.min(jnp.where(live, data, 0))))
+            return (mn, mx)
 
-        pairs = [wrap(lk, rk) for lk, rk in zip(lkeys, rkeys)]
-        lkeys = [p[0] for p in pairs]
-        rkeys = [p[1] for p in pairs]
-        if len(lkeys) == 1:
-            return lkeys[0], rkeys[0], verify
-        widths = []
-        for lk, rk in zip(lkeys, rkeys):
-            mx = 0
-            for batches, key in ((left, lk), (right, rk)):
-                for b in batches:
-                    from presto_tpu.expr import evaluate
+        def runtime_dict(side: int, key: Expr):
+            batches = left if side == 0 else right
+            b = (
+                batches.peek() if hasattr(batches, "peek")
+                else (batches[0] if len(batches) else None)
+            )
+            if b is None or key.name not in b:
+                return None
+            return b[key.name].dictionary
 
-                    v = evaluate(key, b)
-                    data = v.data.astype(jnp.int64)
-                    m = int(jnp.max(jnp.where(b.live & v.valid, data, 0)))
-                    mn = int(jnp.min(jnp.where(b.live & v.valid, data, 0)))
-                    if mn < 0:
-                        raise NotImplementedError("negative join keys")
-                    mx = max(mx, m)
-            widths.append(max(1, int(mx).bit_length()))
-        if sum(widths) > 63:
-            raise NotImplementedError("packed join key exceeds 63 bits")
-
-        def pack(keys):
-            e = Call(BIGINT, "cast_bigint", (keys[0],))
-            for k, w in zip(keys[1:], widths[1:]):
-                shifted = Call(BIGINT, "mul", (e, Literal(BIGINT, 1 << w)))
-                e = Call(BIGINT, "add", (shifted, Call(BIGINT, "cast_bigint", (k,))))
-            return e
-
-        return pack(lkeys), pack(rkeys), verify
+        return join_key_exprs(
+            lkeys, rkeys, scalars,
+            catalog=self.catalog, lnode=lnode, rnode=rnode,
+            runtime_minmax=runtime_minmax, runtime_dict=runtime_dict,
+        )
 
     def _dense_domain(self, node_right, right_keys, right_batches):
         """(key_min, domain) when connector stats bound a single build
@@ -368,7 +344,8 @@ class LocalExecutor:
         # unmatched-build tail yet
         if est > self.join_build_budget and node.kind != "full":
             lkey, rkey, verify = self._join_key_exprs(
-                node.left_keys, node.right_keys, left, right_stream, scalars
+                node.left_keys, node.right_keys, left, right_stream, scalars,
+                node.left, node.right,
             )
             if verify:
                 raise NotImplementedError(
@@ -381,7 +358,8 @@ class LocalExecutor:
         # concatenates it); the PROBE side streams batch-by-batch
         right = right_stream.materialize()
         lkey, rkey, verify = self._join_key_exprs(
-            node.left_keys, node.right_keys, left, right, scalars
+            node.left_keys, node.right_keys, left, right, scalars,
+            node.left, node.right,
         )
         if verify and not node.unique and node.kind != "inner":
             raise NotImplementedError(
@@ -593,14 +571,16 @@ class LocalExecutor:
             # for both semi AND anti (an absent bucket means globally
             # absent for anti rows routed there)
             lkey, rkey, verify = self._join_key_exprs(
-                node.left_keys, node.right_keys, left, right_stream, scalars
+                node.left_keys, node.right_keys, left, right_stream, scalars,
+                node.left, node.right,
             )
             if verify:
                 raise NotImplementedError("wide string semi-join keys")
             return self._exec_grouped_semijoin(left, right_stream, lkey, rkey, est, jt)
         right = right_stream.materialize()
         lkey, rkey, verify = self._join_key_exprs(
-            node.left_keys, node.right_keys, left, right, scalars
+            node.left_keys, node.right_keys, left, right, scalars,
+            node.left, node.right,
         )
         if verify:
             # existence probes have no build_row to verify against;

@@ -201,12 +201,14 @@ class HashAggregationOperator(Operator):
     @staticmethod
     def _sortable(v):
         """Group-sort surrogate: BYTES(<=7) packs big-endian into int64
-        (order-preserving with zero padding); others pass through."""
+        (order-preserving under PAD SPACE collation — zero padding is
+        normalized to spaces like bytes_pack); others pass through."""
         data, dtype = v.data, v.dtype
         if dtype.kind is TypeKind.BYTES:
             w = dtype.width
             if w > 7:
                 raise ValueError("cannot sort-group wide BYTES keys")
+            data = jnp.where(data == 0, jnp.uint8(32), data)
             out = jnp.zeros(data.shape[0], jnp.int64)
             for i in range(w):
                 out = (out << np.int64(8)) | data[:, i].astype(jnp.int64)

@@ -355,12 +355,36 @@ def _concat(args, out):
     return jnp.concatenate(parts, axis=1), None
 
 
+def _t_dict_bytes(args):
+    raise NotImplementedError(
+        "dict_bytes width is planner-assigned (construct the Call with "
+        "an explicit fixed_bytes dtype)"
+    )
+
+
+@register("dict_bytes", _t_dict_bytes)
+def _dict_bytes(args, out):
+    """Dictionary-encoded VARCHAR -> fixed-width BYTES: materialize
+    codes through the dictionary's decode table. The join planner uses
+    this to compare keys from DIFFERENT dictionaries by value (codes
+    are only comparable within one dictionary; cross-dictionary code
+    joins would be silently wrong)."""
+    a = args[0]
+    if a.dictionary is None:
+        raise NotImplementedError("dict_bytes on dictionary-less VARCHAR")
+    mat = jnp.asarray(a.dictionary.bytes_matrix(out.width))
+    codes = jnp.clip(a.data.astype(jnp.int32), 0, len(a.dictionary) - 1)
+    return mat[codes], None
+
+
 @register("bytes_pack", lambda args: BIGINT)
 def _bytes_pack(args, out):
     """BYTES(w<=7) -> exact big-endian int64 (order-preserving,
     non-negative, < 2^56): narrow string join/group keys become plain
-    integer keys for the sorted kernels."""
-    d = args[0].data.astype(jnp.int64)
+    integer keys for the sorted kernels. Padding is normalized to
+    spaces first so packs agree with PAD SPACE comparison semantics
+    (a space-padded concat result equals zero-padded storage)."""
+    d = _pad_space(args[0].data).astype(jnp.int64)
     h = jnp.zeros(d.shape[0], jnp.int64)
     for i in range(d.shape[1]):
         h = h * 256 + d[:, i]
@@ -371,12 +395,17 @@ def _bytes_pack(args, out):
 def _bytes_hash(args, out):
     """BYTES(w>7) -> 63-bit polynomial hash (FNV prime, int64 wrap).
     NOT injective: callers must verify candidate matches on the
-    original bytes (LookupJoinOperator ``verify`` pairs)."""
-    d = args[0].data.astype(jnp.int64)
+    original bytes (LookupJoinOperator ``verify`` pairs). Hashes over
+    space-normalized padding (PAD SPACE, like _bytes_pack) and never
+    yields the int64-max lookup sentinel (a hash landing there would
+    silently drop the row from the sorted lookup source)."""
+    d = _pad_space(args[0].data).astype(jnp.int64)
     h = jnp.zeros(d.shape[0], jnp.int64)
     for i in range(d.shape[1]):
         h = h * jnp.int64(1099511628211) + d[:, i]
-    return h & jnp.int64((1 << 63) - 1), None
+    h = h & jnp.int64((1 << 63) - 1)
+    sentinel = jnp.int64(np.iinfo(np.int64).max)
+    return jnp.where(h == sentinel, 0, h), None
 
 
 # ---- comparisons ----------------------------------------------------------

@@ -88,7 +88,8 @@ class ExpandedProbe(NamedTuple):
 
 
 def probe_expand(
-    build: BuildSide, probe_keys, probe_live, out_capacity: int, left: bool = False
+    build: BuildSide, probe_keys, probe_live, out_capacity: int,
+    left: bool = False, emit_live=None,
 ) -> ExpandedProbe:
     """General join probe with duplicate build keys.
 
@@ -97,13 +98,20 @@ def probe_expand(
     prefix-sum expansion into a static out_capacity. With ``left=True``
     (probe-outer), match-less probe rows emit one row whose build_row is
     the miss sentinel (build payload gathers yield invalid/null).
+
+    ``emit_live`` (left only): rows that must emit a null-extended
+    output row even though their key cannot match — a live probe row
+    with a NULL join key is excluded from ``probe_live`` (NULL matches
+    nothing) but still appears in a LEFT/FULL OUTER result. Defaults
+    to ``probe_live``.
     """
     probe_cap = probe_keys.shape[0]
     pk = jnp.where(probe_live, probe_keys.astype(jnp.int64), _I64_MAX)
     lo = jnp.searchsorted(build.sorted_keys, pk, side="left", method="sort")
     hi = jnp.searchsorted(build.sorted_keys, pk, side="right", method="sort")
     matches = jnp.where(probe_live & (pk != _I64_MAX), hi - lo, 0)
-    counts = jnp.where(probe_live & (matches == 0), 1, matches) if left else matches
+    el = probe_live if emit_live is None else emit_live
+    counts = jnp.where(el & (matches == 0), 1, matches) if left else matches
     offsets = jnp.cumsum(counts) - counts  # exclusive prefix
     total = jnp.sum(counts)
 

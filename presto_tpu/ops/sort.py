@@ -25,7 +25,11 @@ def _desc_transform(k):
 def bytes_sort_chunks(data) -> list[jnp.ndarray]:
     """[n, W] bytes -> big-endian int64 chunks (7 bytes each), most
     significant first; comparing the chunk tuple == lexicographic
-    byte comparison."""
+    byte comparison under PAD SPACE collation (zero padding compares
+    as spaces, matching expr comparisons / bytes_pack / bytes_hash so
+    a space-padded computed string groups and sorts with zero-padded
+    storage of the same value)."""
+    data = jnp.where(data == 0, jnp.uint8(32), data)
     w = data.shape[1]
     out = []
     for c0 in range(0, w, 7):

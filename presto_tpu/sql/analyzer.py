@@ -787,9 +787,17 @@ class Analyzer:
             fields = []
             cols = []
             types = []
+            # internal names must be unique ACROSS the FROM clause: an
+            # unaliased table keeps its plain column names only while
+            # they don't collide with an earlier relation's (two
+            # unaliased tables sharing a column name would otherwise
+            # collide in the joined Batch's column dict)
+            used = {f.name for r in rels for f in r.scope.fields}
             for cname, t in meta.schema.items():
-                iname = self.fresh(f"{binding}.{cname}") if rel.alias else cname
-                iname = iname if rel.alias else cname
+                if rel.alias or cname in used:
+                    iname = self.fresh(f"{binding}.{cname}")
+                else:
+                    iname = cname
                 fields.append(FieldRef(iname, t, binding, cname, meta.table))
                 cols.append((iname, cname))
                 types.append(t)
@@ -916,11 +924,22 @@ class Analyzer:
     # ------------------------------------------------------------------
     # WHERE conjunct classification
     # ------------------------------------------------------------------
+    @staticmethod
+    def _rel_has(r, f: FieldRef) -> bool:
+        """Does rel ``r`` own field ``f``? Matched on (name, binding) —
+        name alone is ambiguous when two unaliased tables expose the
+        same column name (t1.k = t2.k must not resolve both sides to
+        the first rel and silently degenerate to a cross join)."""
+        return any(
+            sf.name == f.name and sf.binding == f.binding
+            for sf in r.scope.fields
+        )
+
     def _rel_of(self, ident_fields: list[FieldRef], rels) -> int | None:
         owners = set()
         for f in ident_fields:
             for i, r in enumerate(rels):
-                if any(sf.name == f.name for sf in r.scope.fields):
+                if self._rel_has(r, f):
                     owners.add(i)
         if len(owners) == 1:
             return owners.pop()
@@ -944,18 +963,25 @@ class Analyzer:
         if unresolved_outer:
             residual.append(c)
             return
+        nullable = set()
+        for e2 in edges:
+            nullable |= e2.get("nullable", set())
         # equi-join conjunct?
         pair = self._equi_pair_any(c, rels, scope)
         if pair is not None:
             a, b, ae, be = pair
+            if a in nullable or b in nullable:
+                # a WHERE equality over a NULL-extended side of an
+                # outer join must filter AFTER the join (it drops the
+                # null-extended rows); merging it into the outer join
+                # as a key would retain them
+                residual.append(c)
+                return
             edges.append(dict(kind="inner", pair=(a, b), akeys=[ae], bkeys=[be],
                               residual=[]))
             return
         owner = self._rel_of(refs, rels)
         if owner is not None:
-            nullable = set()
-            for e2 in edges:
-                nullable |= e2.get("nullable", set())
             if owner in nullable:
                 # nullable-side predicate: SQL applies it AFTER the
                 # outer join (it sees the null-extended rows)
@@ -975,6 +1001,8 @@ class Analyzer:
                 pair = self._equi_pair_any(cc, rels, scope)
                 if pair is not None:
                     a, b, ae, be = pair
+                    if a in nullable or b in nullable:
+                        continue  # same outer-join guard as above
                     edges.append(dict(kind="inner", pair=(a, b),
                                       akeys=[ae], bkeys=[be], residual=[]))
         residual.append(c)
@@ -1026,7 +1054,7 @@ class Analyzer:
 
     def _owner_index(self, rels, f: FieldRef) -> int | None:
         for i, r in enumerate(rels):
-            if any(sf.name == f.name for sf in r.scope.fields):
+            if self._rel_has(r, f):
                 return i
         return None
 

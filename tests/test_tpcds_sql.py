@@ -59,11 +59,14 @@ def test_tpcds_query_matches_oracle(env, name):
     compare(got, want, name)
 
 
-@pytest.mark.parametrize("name", ["q3", "q7", "q98", "q33", "q36", "q38"])
+@pytest.mark.parametrize(
+    "name", ["q3", "q7", "q98", "q33", "q36", "q38", "q97", "q10"]
+)
 def test_tpcds_distributed_matches_oracle(env, name):
     """Star joins, NULL-key joins, window-over-aggregate (q98),
     three-channel UNION ALL (q33), ROLLUP + grouping() + rank (q36),
-    and INTERSECT (q38) through the real mesh exchanges
+    INTERSECT (q38), FULL OUTER JOIN (q97), and OR-of-EXISTS mark
+    joins (q10) through the real mesh exchanges
     (DistributedQueryRunner analog)."""
     from presto_tpu.parallel.mesh import make_mesh
 
