@@ -51,6 +51,7 @@ class Dictionary:
         self.values = np.array(vals, dtype=object)
         self._values_str = np.array(vals, dtype=str)
         self._index = {v: i for i, v in enumerate(vals)}
+        self._bytes_mats: dict = {}  # materialization caches (see below)
 
     def __len__(self) -> int:
         return len(self.values)
@@ -74,10 +75,7 @@ class Dictionary:
     def max_bytes(self) -> int:
         """Longest value's encoded byte length (cached: planners ask
         per join key pair)."""
-        try:
-            mats = self._bytes_mats
-        except AttributeError:
-            mats = self._bytes_mats = {}
+        mats = self._bytes_mats
         m = mats.get("max_bytes")
         if m is None:
             m = max((len(v.encode()) for v in self.values.tolist()), default=0)
@@ -89,10 +87,7 @@ class Dictionary:
         the decode table behind ``dict_bytes`` (cross-dictionary join
         keys materialize codes into comparable fixed-width bytes).
         Cached per width (dictionaries are shared, long-lived objects)."""
-        try:
-            mats = self._bytes_mats
-        except AttributeError:
-            mats = self._bytes_mats = {}
+        mats = self._bytes_mats
         m = mats.get(width)
         if m is None:
             m = np.zeros((len(self.values), width), np.uint8)

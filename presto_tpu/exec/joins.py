@@ -164,6 +164,13 @@ class JoinBuildOperator(CollectingOperator):
         side, dense, long_runs = build(batch)
         if bool(side.overflow):
             raise CapacityOverflow("JoinBuild", cap, int(side.n_rows))
+        if bool(side.sentinel_hit):
+            raise NotImplementedError(
+                "a join build key equals the reserved int64 sentinel "
+                f"({np.iinfo(np.int64).max}); such keys are "
+                "indistinguishable from dead slots and would silently "
+                "lose their matches"
+            )
         self.build_side = side
         self.long_dup_runs = bool(long_runs)
         # dictionary provenance for the probe-side runtime guard:
@@ -498,16 +505,18 @@ def full_init_flags(build: JoinBuildOperator):
     return jnp.zeros(build.payload.capacity, dtype=bool)
 
 
-def full_tail(
-    build: JoinBuildOperator,
+def full_tail_batch(
+    payload: Batch,
     build_outputs: Sequence[BuildOutput],
     flags,
     probe_schema: Batch,
 ) -> Batch:
-    """Unmatched build rows with NULL probe columns. ``probe_schema``
-    supplies probe-side names/dtypes/dictionaries (any probe batch).
-    Runs once per query — plain eager ops, no jit."""
-    payload = build.payload
+    """Unmatched ``payload`` rows (live & ~flags) with NULL probe
+    columns. ``probe_schema`` supplies probe-side names/dtypes/
+    dictionaries (any probe batch). The ONE tail constructor behind
+    both FULL OUTER paths: called eagerly by the local/broadcast tiers
+    and traced inside the distributed repartition step — the two must
+    never diverge on tail semantics."""
     cap = payload.capacity
     out_names = {bo.name for bo in build_outputs}
     cols = {}
@@ -525,3 +534,14 @@ def full_tail(
         src = payload[bo.source]
         cols[bo.name] = Column(src.data, src.valid, src.dtype, src.dictionary)
     return Batch(cols, payload.live & ~flags)
+
+
+def full_tail(
+    build: JoinBuildOperator,
+    build_outputs: Sequence[BuildOutput],
+    flags,
+    probe_schema: Batch,
+) -> Batch:
+    """Eager wrapper over ``full_tail_batch`` for operator-held builds
+    (runs once per query)."""
+    return full_tail_batch(build.payload, build_outputs, flags, probe_schema)

@@ -45,3 +45,18 @@ def partition_ids(columns, num_partitions: int) -> jnp.ndarray:
     row->consumer map (reference: PagePartitioner)."""
     h = hash_columns(columns)
     return (h % np.uint64(num_partitions)).astype(jnp.int32)
+
+
+_BUCKET_SEED = np.uint64(0xA24BAED4963EE407)
+
+
+def bucket_ids(columns, num_buckets: int) -> jnp.ndarray:
+    """Grouped-execution bucket assignment in [0, num_buckets).
+
+    Applies one extra seeded mix on top of ``hash_columns`` so bucket
+    ids are DECORRELATED from ``partition_ids`` over the same key:
+    ``h % B`` and ``h % P`` share low-bit structure whenever B and P
+    share factors, which would route each bucket's rows onto a subset
+    of the mesh during the in-bucket repartition exchange."""
+    h = mix64(hash_columns(columns) ^ _BUCKET_SEED)
+    return (h % np.uint64(num_buckets)).astype(jnp.int32)

@@ -37,6 +37,12 @@ class BuildSide(NamedTuple):
     row_idx: jnp.ndarray  # [build_cap] original row index (cap = dead)
     n_rows: jnp.ndarray  # traced scalar
     overflow: jnp.ndarray  # traced bool
+    #: a LIVE build key equals the reserved I64_MAX dead-slot sentinel:
+    #: such a row is indistinguishable from a dead slot, so its matches
+    #: would silently vanish — builders surface this flag and the host
+    #: refuses loudly instead (bytes_hash already avoids the sentinel
+    #: by construction; this guards plain integer keys)
+    sentinel_hit: jnp.ndarray
 
 
 _I64_MAX = np.int64(np.iinfo(np.int64).max)
@@ -45,6 +51,7 @@ _I64_MAX = np.int64(np.iinfo(np.int64).max)
 def build_lookup(keys, live, build_capacity: int) -> BuildSide:
     """Compact live rows and sort them by key."""
     cap = keys.shape[0]
+    sentinel_hit = jnp.any(live & (keys.astype(jnp.int64) == _I64_MAX))
     k = jnp.where(live, keys.astype(jnp.int64), _I64_MAX)
     order = jnp.argsort(k, stable=True)
     sk = k[order]
@@ -55,7 +62,8 @@ def build_lookup(keys, live, build_capacity: int) -> BuildSide:
     row_idx = gather_padded(order, take, cap)
     row_idx = jnp.where(sorted_keys == _I64_MAX, cap, row_idx)
     n_live = jnp.sum(live.astype(jnp.int32))
-    return BuildSide(sorted_keys, row_idx, n_live, n_live > build_capacity)
+    return BuildSide(sorted_keys, row_idx, n_live, n_live > build_capacity,
+                     sentinel_hit)
 
 
 class UniqueProbe(NamedTuple):

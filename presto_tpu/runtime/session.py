@@ -119,6 +119,7 @@ class Session:
             broadcast_limit=self.prop("broadcast_join_row_limit"),
             gather_limit=self.prop("gather_row_limit"),
             direct_group_limit=self.prop("direct_group_limit"),
+            join_build_budget=self.prop("join_build_budget_bytes"),
         )
 
     def _profiled(self):
