@@ -154,6 +154,52 @@ def substr(data, start: int, length: int):
     return data[:, start - 1 : start - 1 + length]
 
 
+def rtrim_bytes(data):
+    """Strip trailing spaces: canonical zero-padding after the last
+    non-space content byte (positions past it become pad zeros)."""
+    content = (data != 0) & (data != 32)
+    w = data.shape[1]
+    # last content index + 1 per row (0 when all spaces/pad)
+    rev_any = jnp.cumsum(content[:, ::-1].astype(jnp.int32), axis=1)[:, ::-1]
+    keep = rev_any > 0  # position <= last content byte
+    return jnp.where(keep, data, 0).astype(jnp.uint8)
+
+
+def ltrim_bytes(data):
+    """Strip leading spaces: content shifts left, tail becomes pad."""
+    w = data.shape[1]
+    lead = jnp.cumprod((data == 32).astype(jnp.int32), axis=1).sum(
+        axis=1, keepdims=True
+    )  # count of leading spaces per row
+    idx = jnp.arange(w)[None, :] + lead
+    shifted = jnp.take_along_axis(data, jnp.minimum(idx, w - 1), axis=1)
+    return jnp.where(idx < w, shifted, 0).astype(jnp.uint8)
+
+
+def trim_bytes(data):
+    return ltrim_bytes(rtrim_bytes(data))
+
+
+def reverse_bytes(data):
+    """Reverse each row's logical content (padding stays behind)."""
+    w = data.shape[1]
+    lens = row_lengths(data)
+    idx = lens[:, None] - 1 - jnp.arange(w)[None, :]
+    out = jnp.take_along_axis(data, jnp.clip(idx, 0, w - 1), axis=1)
+    return jnp.where(idx >= 0, out, 0).astype(jnp.uint8)
+
+
+def position_in(data, needle: str) -> jnp.ndarray:
+    """SQL POSITION(needle IN col): 1-based first occurrence, 0 when
+    absent; empty needle is position 1."""
+    n = data.shape[0]
+    if needle == "":
+        return jnp.ones(n, jnp.int32)
+    enc = encode_needle(needle)
+    found, ok = find_from(data, enc, jnp.zeros(n, jnp.int32))
+    return jnp.where(ok, found + 1, 0).astype(jnp.int32)
+
+
 def bytes_eq_literal(data, s: str) -> jnp.ndarray:
     lit = pad_literal(s, data.shape[1])
     return jnp.all(data == jnp.asarray(lit), axis=1)

@@ -2127,15 +2127,33 @@ class Analyzer:
             return self._cast(v, n.type_name)
         if isinstance(n, A.Extract):
             v = self._expr(n.value, scope, outer, ctes, scalar_binds, agg_map, key_map)
-            if n.field not in ("year", "month", "day"):
+            field = {"dow": "day_of_week", "doy": "day_of_year",
+                     "day_of_week": "day_of_week",
+                     "day_of_year": "day_of_year"}.get(n.field, n.field)
+            if field not in ("year", "month", "day", "quarter",
+                             "day_of_week", "day_of_year"):
                 raise AnalysisError(f"EXTRACT({n.field}) unsupported")
-            return Call(INTEGER, n.field, (v,))
+            return Call(INTEGER, field, (v,))
         if isinstance(n, A.Substring):
             v = self._expr(n.value, scope, outer, ctes, scalar_binds, agg_map, key_map)
-            if not (isinstance(n.start, A.NumberLit)
+            start_node = n.start
+            start_neg = False
+            if (isinstance(start_node, A.UnaryOp) and start_node.op == "-"):
+                start_neg, start_node = True, start_node.operand
+            if not (isinstance(start_node, A.NumberLit)
                     and (n.length is None or isinstance(n.length, A.NumberLit))):
                 raise AnalysisError("SUBSTRING bounds must be literals")
-            start = int(n.start.text)
+            start = -int(start_node.text) if start_neg else int(start_node.text)
+            if start < 1 and v.dtype.kind is not TypeKind.VARCHAR:
+                raise AnalysisError(
+                    "negative SUBSTRING start requires a dictionary VARCHAR")
+            if v.dtype.kind is TypeKind.VARCHAR:
+                # general dictionary substr: derived-dictionary transform
+                from presto_tpu.expr import substr_dict_fn
+
+                length = (int(n.length.text) if n.length is not None
+                          else 1 << 20)
+                return Call(v.dtype, substr_dict_fn(start, length), (v,))
             length = int(n.length.text) if n.length is not None else (
                 v.dtype.width - start + 1
             )
@@ -2187,6 +2205,10 @@ class Analyzer:
                 for a in args[1:]:
                     t = common_super_type(t, a.dtype)
                 return Call(t, "coalesce", args)
+            handled = self._scalar_function(n, scope, outer, ctes,
+                                            scalar_binds, agg_map, key_map)
+            if handled is not None:
+                return handled
             raise AnalysisError(f"unknown function {n.name}")
         if isinstance(n, A.ScalarSubquery):
             # scalar subquery in a value position (uncorrelated only)
@@ -2198,6 +2220,120 @@ class Analyzer:
             scalar_binds.append(N.ScalarValue(sub_plan, sname, t))
             return Unbound(t, sname)
         raise AnalysisError(f"unsupported expression {type(n).__name__}")
+
+    def _scalar_function(self, n: A.FunctionCall, scope, outer, ctes,
+                         scalar_binds, agg_map, key_map):
+        """Round-5 scalar-function breadth (SURVEY §2.1 functions row):
+        math, string, and date families beyond the bootstrap set. Returns
+        None for unknown names (caller raises)."""
+        from presto_tpu.expr import (
+            date_add_fn,
+            date_diff_fn,
+            date_trunc_fn,
+            split_part_fn,
+            substr_dict_fn,
+        )
+
+        _ARITY = {"quarter": 1, "day_of_week": 1, "dow": 1,
+                  "day_of_year": 1, "doy": 1, "last_day_of_month": 1,
+                  "date_trunc": 2, "date_add": 3, "date_diff": 3,
+                  "length": 1, "char_length": 1, "character_length": 1,
+                  "trim": 1, "ltrim": 1, "rtrim": 1, "reverse": 1,
+                  "strpos": 2, "replace": 3, "split_part": 3,
+                  "regexp_like": 2, "power": 2, "pow": 2, "exp": 1,
+                  "ln": 1, "log10": 1, "log2": 1, "truncate": 1,
+                  "sign": 1, "mod": 2}
+        want = _ARITY.get(n.name)
+        if want is not None and len(n.args) != want:
+            raise AnalysisError(
+                f"{n.name}() expects {want} argument(s), got {len(n.args)}")
+        if n.name == "substr" and len(n.args) not in (2, 3):
+            raise AnalysisError("substr() expects 2 or 3 arguments")
+        if n.name in ("greatest", "least") and len(n.args) < 2:
+            raise AnalysisError(f"{n.name}() expects at least 2 arguments")
+
+        def sub(i):
+            return self._expr(n.args[i], scope, outer, ctes, scalar_binds,
+                              agg_map, key_map)
+
+        def str_lit(i, what):
+            a = n.args[i]
+            if not isinstance(a, A.StringLit):
+                raise AnalysisError(f"{n.name}() {what} must be a string literal")
+            return a.value
+
+        def int_lit(i, what):
+            a = n.args[i]
+            neg = False
+            if isinstance(a, A.UnaryOp) and a.op == "-":
+                neg, a = True, a.operand
+            if not isinstance(a, A.NumberLit):
+                raise AnalysisError(f"{n.name}() {what} must be an integer literal")
+            v = int(a.text)
+            return -v if neg else v
+
+        name = n.name
+        if name in ("quarter", "day_of_week", "dow", "day_of_year", "doy"):
+            canon = {"dow": "day_of_week", "doy": "day_of_year"}.get(name, name)
+            return Call(INTEGER, canon, (sub(0),))
+        if name == "last_day_of_month":
+            return Call(DATE, "last_day_of_month", (sub(0),))
+        if name == "date_trunc":
+            return Call(DATE, date_trunc_fn(str_lit(0, "unit")), (sub(1),))
+        if name == "date_add":
+            return Call(DATE, date_add_fn(str_lit(0, "unit")),
+                        (sub(1), sub(2)))
+        if name == "date_diff":
+            return Call(BIGINT, date_diff_fn(str_lit(0, "unit")),
+                        (sub(1), sub(2)))
+        if name in ("length", "char_length", "character_length"):
+            return Call(INTEGER, "length", (sub(0),))
+        if name in ("trim", "ltrim", "rtrim", "reverse"):
+            v = sub(0)
+            return Call(v.dtype, name, (v,))
+        if name == "strpos":
+            v = sub(0)
+            return Call(INTEGER, "strpos",
+                        (v, Literal(varchar(), str_lit(1, "needle"))))
+        if name == "replace":
+            v = sub(0)
+            return Call(v.dtype, "replace",
+                        (v, Literal(varchar(), str_lit(1, "search")),
+                         Literal(varchar(), str_lit(2, "replacement"))))
+        if name == "split_part":
+            v = sub(0)
+            fn = split_part_fn(str_lit(1, "separator"), int_lit(2, "index"))
+            return Call(v.dtype, fn, (v,))
+        if name == "regexp_like":
+            v = sub(0)
+            return Call(BOOLEAN, "regexp_like",
+                        (v, Literal(varchar(), str_lit(1, "pattern"))))
+        if name == "substr":
+            length = (A.NumberLit(str(int_lit(2, "length")))
+                      if len(n.args) >= 3 else None)
+            start = n.args[1]
+            return self._expr(A.Substring(n.args[0], start, length), scope,
+                              outer, ctes, scalar_binds, agg_map, key_map)
+        if name in ("greatest", "least"):
+            from presto_tpu.types import common_super_type
+
+            args = tuple(sub(i) for i in range(len(n.args)))
+            t = args[0].dtype
+            for a in args[1:]:
+                t = common_super_type(t, a.dtype)
+            return Call(t, name, args)
+        if name in ("power", "pow"):
+            return Call(DOUBLE, "power", (sub(0), sub(1)))
+        if name in ("exp", "ln", "log10", "log2", "truncate"):
+            return Call(DOUBLE, name, (sub(0),))
+        if name == "sign":
+            return Call(INTEGER, "sign", (sub(0),))
+        if name == "mod":
+            from presto_tpu.types import common_super_type
+
+            a, b = sub(0), sub(1)
+            return Call(common_super_type(a.dtype, b.dtype), "mod", (a, b))
+        return None
 
     def _case(self, n: A.CaseExpr, scope, outer, ctes, scalar_binds, agg_map, key_map):
         def is_bare_null(x):
@@ -2265,6 +2401,38 @@ class Analyzer:
                 raise AnalysisError(f"bad decimal type {type_name}")
             fn = rescale_decimal(int(m.group(2)))
             return Call(decimal(int(m.group(1)), int(m.group(2))), fn, (v,))
+        if type_name == "varchar" or type_name.startswith("varchar("):
+            import re as _re
+
+            from presto_tpu.expr import cast_varchar_fn
+            from presto_tpu.types import fixed_bytes
+
+            m = _re.match(r"varchar\((\d+)\)", type_name)
+            if v.dtype.kind is TypeKind.VARCHAR and m is None:
+                return v  # identity
+            if m is not None:
+                w = int(m.group(1))
+            elif v.dtype.kind is TypeKind.BYTES:
+                w = v.dtype.width
+            else:
+                w = {TypeKind.INTEGER: 11, TypeKind.BIGINT: 20,
+                     TypeKind.DATE: 10}.get(v.dtype.kind)
+                if w is None and v.dtype.kind is TypeKind.DECIMAL:
+                    w = v.dtype.precision + 2
+                if w is None:
+                    raise AnalysisError(f"cast {v.dtype} to varchar unsupported")
+            return Call(fixed_bytes(w), cast_varchar_fn(w), (v,))
+        if type_name == "date":
+            from presto_tpu.expr import Literal as _Lit
+            from presto_tpu.expr import parse_date_fn
+
+            if isinstance(v, _Lit) and isinstance(v.value, str):
+                return _Lit(DATE, v.value)  # host-parsed at to_physical
+            if v.dtype.kind is TypeKind.DATE:
+                return v
+            if v.dtype.kind is TypeKind.VARCHAR:
+                return Call(DATE, parse_date_fn(), (v,))
+            raise AnalysisError(f"cast {v.dtype} to date unsupported")
         raise AnalysisError(f"unsupported cast to {type_name}")
 
     def _number(self, text: str) -> Literal:

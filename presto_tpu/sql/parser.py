@@ -571,6 +571,18 @@ class Parser:
                 length = self.parse_expr() if self.accept_op(",") else None
             self.expect_op(")")
             return A.Substring(v, start, length)
+        if t.kind == "IDENT" and t.text.lower() == "position":
+            # POSITION(needle IN haystack) special form -> strpos
+            nxt = self.toks[self.i + 1] if self.i + 1 < len(self.toks) else None
+            if nxt is not None and nxt.kind == "OP" and nxt.text == "(":
+                self.eat()
+                self.eat()
+                # additive level: the IN belongs to the POSITION form
+                needle = self.parse_additive()
+                self.expect_kw("in")
+                hay = self.parse_expr()
+                self.expect_op(")")
+                return A.FunctionCall("strpos", (hay, needle))
         if self.kw("exists"):
             self.eat()
             self.expect_op("(")
