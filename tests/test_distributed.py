@@ -12,6 +12,8 @@ import numpy as np
 import pandas as pd
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from presto_tpu.batch import Batch
 from presto_tpu.connectors.tpch import TpchConnector
 from presto_tpu.connectors.tpch.queries import QUERIES

@@ -13,6 +13,8 @@ local executor's.
 import pandas as pd
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from presto_tpu.connectors.tpch import TpchConnector
 from presto_tpu.exec.distributed import DistributedExecutor
 from presto_tpu.parallel.mesh import make_mesh

@@ -13,6 +13,8 @@ VERDICT weak #1/#2); this file is the gate that would have caught both.
 import pandas as pd
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from presto_tpu.connectors.tpch import TpchConnector
 from presto_tpu.parallel.mesh import make_mesh
 from presto_tpu.runtime.session import Session

@@ -8,6 +8,8 @@ HBM-bounded bucket passes (SURVEY §2.1 L9 rows, §7.4 #5).
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from presto_tpu.connectors.tpch import TpchConnector
 from presto_tpu.runtime.session import Session
 

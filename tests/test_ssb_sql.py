@@ -5,6 +5,8 @@ routes) [SURVEY §4, §6 config 5]."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from presto_tpu.connectors.ssb import SsbConnector
 from presto_tpu.connectors.ssb.queries import QUERIES
 from presto_tpu.oracle.ssb_oracle import ORACLES

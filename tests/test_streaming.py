@@ -11,6 +11,8 @@ replay-correct retries.
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from presto_tpu.connectors.tpch import TpchConnector
 from presto_tpu.runtime.session import Session
 

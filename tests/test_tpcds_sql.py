@@ -7,6 +7,8 @@ import numpy as np
 import pandas as pd
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from presto_tpu.connectors.tpcds import TpcdsConnector
 from presto_tpu.connectors.tpcds.queries import QUERIES
 from presto_tpu.oracle.tpcds_oracle import ORACLES

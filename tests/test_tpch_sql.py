@@ -6,6 +6,8 @@ import numpy as np
 import pandas as pd
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from presto_tpu.connectors.tpch import TpchConnector
 from presto_tpu.connectors.tpch.queries import QUERIES
 from presto_tpu.oracle.tpch_oracle import ORACLES
