@@ -259,7 +259,63 @@ def fused_small_sums(values, bits_list, contribs, gids, max_groups: int,
     - value_overflow: scalar bool — True when any contributing |value|
       exceeded its declared bits bound (the declared-stats runtime guard:
       a violated bound would otherwise silently truncate high lanes).
+
+    Fast path: on TPU, when every bound fits int32 and the capacity is
+    lane-chunk aligned, the whole computation runs as ONE Pallas pass
+    (ops.pallas_groupby) — the XLA einsum below materializes the lane
+    matrix + one-hot in HBM (~6 round trips; measured 73 ms vs ~20 ms
+    for 60M rows). Falls back here when the compile probe fails.
     """
+    # identical mask objects (e.g. one ``live`` reused for every
+    # aggregate) get ONE count column — slots map back through uniq
+    all_masks = list(contribs) + list(extra_count_masks)
+    uniq: dict[int, int] = {}
+    slot = []
+    mask_cols = []
+    for m in all_masks:
+        if id(m) not in uniq:
+            uniq[id(m)] = len(mask_cols)
+            mask_cols.append(m)
+        slot.append(uniq[id(m)])
+
+    pallas_ok = (
+        all(not jnp.issubdtype(v.dtype, jnp.floating) for v in values)
+        and all(b <= 31 for b in bits_list)
+    )
+    if pallas_ok:
+        from presto_tpu.ops.strings import use_pallas
+
+        pallas_ok = use_pallas()
+    if pallas_ok:
+        from presto_tpu.ops import pallas_groupby as PG
+
+        eff_bits = [
+            min(b, jnp.iinfo(v.dtype).bits - 1)
+            for v, b in zip(values, bits_list)
+        ]
+        if PG.probe_supported(eff_bits, len(mask_cols), max_groups,
+                              gids.shape[0]):
+            # bound check on the ORIGINAL dtype, before the int32 cast
+            # (a wide value would wrap and dodge the in-kernel check);
+            # XLA fuses this into the zeroing pass below
+            oflow = jnp.zeros((), jnp.bool_)
+            for v, c, eb in zip(values, contribs, eff_bits):
+                if eb < jnp.iinfo(v.dtype).bits - 1:
+                    oflow = oflow | jnp.any(
+                        jnp.where(c, jnp.abs(v) >> eb, 0) != 0)
+            zeroed = [
+                jnp.where(c, v, 0).astype(jnp.int32)
+                for v, c in zip(values, contribs)
+            ]
+            sums, counts_all, k_oflow = PG.fused_lane_sums(
+                zeroed, eff_bits, mask_cols, gids.astype(jnp.int32),
+                max_groups,
+            )
+            counts = [counts_all[slot[i]] for i in range(len(contribs))]
+            extra = [counts_all[slot[len(contribs) + i]]
+                     for i in range(len(extra_count_masks))]
+            return sums, counts, extra, oflow | k_oflow
+
     lane_cols = []
     spans = []
     oflow = jnp.zeros((), jnp.bool_)
@@ -276,17 +332,7 @@ def fused_small_sums(values, bits_list, contribs, gids, max_groups: int,
         for k in range(nlanes):
             lane = ((mag >> (_MM_LANE_BITS * k)) & 127).astype(jnp.int8)
             lane_cols.append(jnp.where(neg, -lane, lane))
-    # identical mask objects (e.g. one ``live`` reused for every
-    # aggregate) get ONE count column — slots map back through uniq
-    all_masks = list(contribs) + list(extra_count_masks)
-    uniq: dict[int, int] = {}
-    slot = []
-    count_cols = []
-    for m in all_masks:
-        if id(m) not in uniq:
-            uniq[id(m)] = len(count_cols)
-            count_cols.append(m.astype(jnp.int8))
-        slot.append(uniq[id(m)])
+    count_cols = [m.astype(jnp.int8) for m in mask_cols]
     X = jnp.stack(lane_cols + count_cols, axis=1)  # [rows, L] int8
     x3 = _mm_chunked(X, 0)  # [nch, chunk, L]
     g3 = _mm_chunked(gids, max_groups)  # [nch, chunk]

@@ -1,0 +1,204 @@
+"""Fused small-group segment sums as a single-pass Pallas kernel.
+
+Reference parity: the hot loop of ``InMemoryHashAggregationBuilder``
+for tiny group counts (Q1's 6 groups) [SURVEY §2.1, §6]. The XLA path
+(``ops.groupby.fused_small_sums``) packs 8-bit lanes into an [rows, L]
+int8 matrix and contracts it against a one-hot matrix on the MXU — but
+the lane matrix + one-hot materialization costs ~6 HBM round trips
+(measured round 5: 73 ms for 60M rows where the read floor is ~16 ms).
+
+This kernel does the whole thing in ONE pass: a sequential grid over
+row blocks loads the int32 value columns once, splits signed 8-bit
+lanes in registers, and accumulates per-(lane, group) partial sums into
+a [128-slot] int32 vector in VMEM. Exactness: every output slot sums
+|lane| <= 255 over at most 2^23 rows per output *major* (255 * 2^23 <
+2^31), majors recombine in int64 outside the kernel. The f32-reciprocal
+trick is NOT needed here — callers pass precomputed int32 values.
+
+Eligibility (callers check ``supported(...)``): integer values whose
+declared |value| bit bound <= 31 (fits int32), slot count <= 1024, and
+capacity divisible by 2^16 (the groupby lane-chunk, which put_table and
+the executors already align to).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+LANE_BITS = 8
+_MAJOR_ROWS = 1 << 23  # 255 * 2^23 < 2^31: int32-exact per major
+_SLOTS = 1024  # [8, 128] int32 output tile per major
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _nlanes(bits: int) -> int:
+    return max(1, -(-min(bits, 31) // LANE_BITS))
+
+
+def _block_rows(cap: int) -> int | None:
+    for b in (1 << 18, 1 << 17, 1 << 16):
+        if cap % b == 0:
+            return b
+    return None
+
+
+def supported(bits_list, num_slots: int, cap: int) -> bool:
+    """Static eligibility for the fused kernel."""
+    return (
+        all(b <= 31 for b in bits_list)
+        and num_slots <= _SLOTS
+        and _block_rows(cap) is not None
+    )
+
+
+def _kernel(nlanes_list, max_groups, spm, nval, nmask, *refs):
+    """Grid body: refs = [v_0..v_{nval-1}, m_0..m_{nmask-1}, gids, out].
+
+    Values are int32 (dead rows already zeroed by the caller), masks
+    int8, gids int32 with >= max_groups meaning "no group" (trash).
+    """
+    i = pl.program_id(0)
+    vals = [r[...] for r in refs[:nval]]
+    masks = [r[...].astype(jnp.int32) for r in refs[nval:nval + nmask]]
+    gid = refs[nval + nmask][...]
+    o_ref = refs[-1]
+
+    lanes = []
+    oflow = None
+    for v, (nl, bits) in zip(vals, nlanes_list):
+        neg = v < 0
+        mag = jnp.abs(v)
+        if bits < 31:
+            # count violating rows (NOT sum of excess bits — that sum
+            # could itself overflow int32 across a block)
+            viol = jnp.sum(((mag >> bits) != 0).astype(jnp.int32))
+            oflow = viol if oflow is None else oflow + viol
+        for k in range(nl):
+            lane = (mag >> (LANE_BITS * k)) & 255
+            lanes.append(jnp.where(neg, -lane, lane))
+
+    scalars = []
+    for g in range(max_groups):
+        m = gid == g
+        for lane in lanes:
+            scalars.append(jnp.sum(jnp.where(m, lane, 0)))
+        for mk in masks:
+            scalars.append(jnp.sum(jnp.where(m, mk, 0)))
+    scalars.append(oflow if oflow is not None else jnp.zeros((), jnp.int32))
+    vec = jnp.stack(scalars)
+    vec = jnp.pad(vec, (0, _SLOTS - vec.shape[0])).reshape(1, 8, 128)
+
+    @pl.when(i % spm == 0)
+    def _init():
+        o_ref[...] = vec
+
+    @pl.when(i % spm != 0)
+    def _acc():
+        o_ref[...] = o_ref[...] + vec
+
+
+def fused_lane_sums(values, bits_list, count_masks, gids, max_groups: int):
+    """Exact per-group integer sums + mask counts in one device pass.
+
+    values: list of int32 [cap] arrays, dead rows ZEROED by the caller.
+    bits_list: static |value| bit bounds (<= 31 each).
+    count_masks: list of bool [cap] arrays counted per group.
+    gids: int32 [cap], group id in [0, max_groups) or >= max_groups for
+    dead rows.
+
+    Returns (sums, counts, overflow): int64 [max_groups] per value /
+    mask; overflow True when a declared bound was violated.
+    """
+    cap = gids.shape[0]
+    B = _block_rows(cap)
+    nlanes_list = [(_nlanes(b), min(b, 31)) for b in bits_list]
+    nl_total = sum(n for n, _ in nlanes_list)
+    num_slots = max_groups * (nl_total + len(count_masks)) + 1
+    if not supported(bits_list, num_slots, cap):
+        raise ValueError("fused_lane_sums: ineligible shapes/bounds")
+    nblk = cap // B
+    spm = max(1, _MAJOR_ROWS // B)
+    nmajor = -(-nblk // spm)
+
+    def shape3(a, dt):
+        return a.astype(dt).reshape(nblk, 8, B // 8)
+
+    args = ([shape3(v, jnp.int32) for v in values]
+            + [shape3(m, jnp.int8) for m in count_masks]
+            + [shape3(jnp.minimum(gids, max_groups), jnp.int32)])
+    out = pl.pallas_call(
+        partial(_kernel, nlanes_list, max_groups, spm, len(values),
+                len(count_masks)),
+        grid=(nblk,),
+        in_specs=[pl.BlockSpec((1, 8, B // 8), lambda i: (i, 0, 0))
+                  for _ in args],
+        out_specs=pl.BlockSpec((1, 8, 128), lambda i: (i // spm, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((nmajor, 8, 128), jnp.int32),
+        interpret=_interpret(),
+    )(*args)
+    o = out.astype(jnp.int64).sum(axis=0).reshape(_SLOTS)
+
+    per_g = o[: max_groups * (nl_total + len(count_masks))].reshape(
+        max_groups, nl_total + len(count_masks))
+    sums = []
+    idx = 0
+    for nl, _bits in nlanes_list:
+        s = jnp.zeros(max_groups, jnp.int64)
+        for k in range(nl):
+            s = s + (per_g[:, idx + k] << (LANE_BITS * k))
+        sums.append(s)
+        idx += nl
+    counts = [per_g[:, idx + j] for j in range(len(count_masks))]
+    oflow = o[max_groups * (nl_total + len(count_masks))] != 0
+    return sums, counts, oflow
+
+
+# ---------------------------------------------------------------------------
+# Compile probe: the tunnel's remote Mosaic compile helper can reject
+# valid programs; callers fall back to the XLA einsum path (visible in
+# the log, never silent). Keyed per (nval, nmask, groups, lane config,
+# block) — the compiled artifact is shape-generic beyond that.
+# ---------------------------------------------------------------------------
+
+_PROBE_CACHE: dict = {}
+
+
+def probe_supported(bits_list, nmasks: int, max_groups: int, cap: int) -> bool:
+    nlanes_list = tuple((_nlanes(b), min(b, 31)) for b in bits_list)
+    num_slots = max_groups * (sum(n for n, _ in nlanes_list) + nmasks) + 1
+    if not supported(bits_list, num_slots, cap):
+        return False
+    key = (nlanes_list, nmasks, max_groups, _block_rows(cap))
+    if key not in _PROBE_CACHE:
+        if _interpret():
+            _PROBE_CACHE[key] = True
+        else:
+            try:
+                # probe with the SAME block size the real call will use
+                # (VMEM pressure scales with the block; a 2^16 probe
+                # proving a 2^18-block program would be vacuous) and two
+                # blocks so the accumulate branch compiles too
+                c = 2 * _block_rows(cap)
+                vals = [jnp.ones(c, jnp.int32) for _ in bits_list]
+                masks = [jnp.ones(c, jnp.bool_) for _ in range(nmasks)]
+                g = jnp.zeros(c, jnp.int32)
+                jax.block_until_ready(
+                    fused_lane_sums(vals, list(bits_list), masks, g,
+                                    max_groups))
+                _PROBE_CACHE[key] = True
+            except Exception as e:  # noqa: BLE001 — fallback must be visible
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "pallas groupby kernel probe failed (falling back to "
+                    "the XLA einsum path): %s: %s", type(e).__name__, e)
+                _PROBE_CACHE[key] = False
+    return _PROBE_CACHE[key]
