@@ -621,13 +621,19 @@ def bench_q1_streaming(sf: float, dev, split_units: int = 1 << 22):
 
     first = jax.jit(q1_fused_step)
 
-    # -- timed pass: generate -> transfer -> fold, nothing else ----------
+    # -- timed pass: one-slot prefetch — split k+1 generates/transfers
+    # on a worker thread while the device folds split k (SURVEY §7.1
+    # double-buffered H2D; PRESTO_TPU_PREFETCH=0 reverts to serial)
+    from presto_tpu.exec.pipeline import prefetch_iter
+
+    def load(split):
+        arrays = conn.scan_numpy(split, Q1_COLS)
+        return put_table("lineitem", arrays, dev)
+
     state = None
     total_rows = 0
     t0 = time.perf_counter()
-    for split in splits:
-        arrays = conn.scan_numpy(split, Q1_COLS)
-        batch, n = put_table("lineitem", arrays, dev)
+    for batch, n in prefetch_iter(load, splits):
         state = first(batch) if state is None else fold(state, batch)
         total_rows += n
     jax.block_until_ready(state)

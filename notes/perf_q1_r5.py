@@ -1,0 +1,189 @@
+"""Round-5 Q1 roofline probe: where do ~120 ms go on 60M resident rows?
+
+Times isolated stages of the fused Q1 MXU path on the live chip:
+  floor   — read-only pass (sum every narrow column once)
+  x_build — lane-split X construction only (16 int8 lanes + count col)
+  onehot  — one-hot [rows, G] int8 construction only
+  einsum  — the contraction alone, on prebuilt X/onehot
+  full    — q1_fused_step (the shipped kernel)
+plus variants (chunking, fori accumulation) the results suggest.
+
+Run: python notes/perf_q1_r5.py [tile]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from bench import put_table  # noqa: E402
+from presto_tpu.connectors.tpch import TpchConnector  # noqa: E402
+from presto_tpu.workloads import Q1_BITS, Q1_COLS, q1_exprs, q1_fused_step  # noqa: E402
+from presto_tpu.expr import evaluate, evaluate_predicate  # noqa: E402
+from presto_tpu.ops.groupby import (  # noqa: E402
+    _MM_CHUNK,
+    _MM_LANE_BITS,
+    _mm_chunked,
+    group_ids_direct,
+)
+
+TILE = int(sys.argv[1]) if len(sys.argv) > 1 else 10
+
+dev = jax.devices()[0]
+print("device:", dev, flush=True)
+_ = int(jax.device_put(jnp.arange(4), dev).sum())  # force sync mode
+
+conn = TpchConnector(sf=1.0, units_per_split=1 << 26)
+arrays = conn.table_numpy("lineitem", list(Q1_COLS))
+batch, n = put_table("lineitem", arrays, dev, tile=TILE, narrow=True)
+print(f"rows={n} cap={batch.capacity}", flush=True)
+
+
+def timeit(name, fn, *args, iters=3):
+    f = jax.jit(fn)
+    out = f(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = f(*args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+    print(f"{name:28s} {dt * 1e3:9.2f} ms   {n / dt / 1e9:7.3f} Grows/s",
+          flush=True)
+    return out
+
+
+# ---- floor: one fused read of every column --------------------------------
+def floor(b):
+    tot = jnp.zeros((), jnp.int64)
+    for c in Q1_COLS:
+        tot = tot + b[c].data.astype(jnp.int64).sum()
+    return tot
+
+
+timeit("floor (read all cols)", floor, batch)
+
+
+# ---- shipped kernel -------------------------------------------------------
+timeit("full q1_fused_step", q1_fused_step, batch)
+
+
+# ---- stage isolation ------------------------------------------------------
+def stage_pred_gid(b):
+    pred, _, _ = q1_exprs()
+    live = b.live & evaluate_predicate(pred, b)
+    gids, _ = group_ids_direct(
+        [b["l_returnflag"].data, b["l_linestatus"].data],
+        (0, 0), (2, 1), live, 6,
+    )
+    return gids.astype(jnp.int32).sum()
+
+
+timeit("pred+gid only", stage_pred_gid, batch)
+
+
+def make_inputs(b):
+    pred, disc_price, charge = q1_exprs()
+    live = b.live & evaluate_predicate(pred, b)
+    gids, _ = group_ids_direct(
+        [b["l_returnflag"].data, b["l_linestatus"].data],
+        (0, 0), (2, 1), live, 6,
+    )
+    vals = [b["l_quantity"].data, b["l_extendedprice"].data,
+            evaluate(disc_price, b).data, evaluate(charge, b).data]
+    bits = [Q1_BITS[k] for k in
+            ("sum_qty", "sum_base_price", "sum_disc_price", "sum_charge")]
+    return live, gids, vals, bits
+
+
+def build_x(b):
+    live, gids, vals, bits = make_inputs(b)
+    lane_cols = []
+    for v, nb in zip(vals, bits):
+        vv = jnp.where(live, v, 0)
+        neg = vv < 0
+        mag = jnp.abs(vv)
+        nlanes = max(1, -(-nb // _MM_LANE_BITS))
+        for k in range(nlanes):
+            lane = ((mag >> (_MM_LANE_BITS * k)) & 127).astype(jnp.int8)
+            lane_cols.append(jnp.where(neg, -lane, lane))
+    lane_cols.append(live.astype(jnp.int8))
+    return jnp.stack(lane_cols, axis=1)
+
+
+def x_only(b):
+    return build_x(b).astype(jnp.int32).sum()
+
+
+timeit("X build only", x_only, batch)
+
+
+def onehot_only(b):
+    live, gids, _, _ = make_inputs(b)
+    g3 = _mm_chunked(gids, 6)
+    onehot = (g3[..., None] == jnp.arange(6, dtype=gids.dtype)).astype(jnp.int8)
+    return onehot.astype(jnp.int32).sum()
+
+
+timeit("onehot build only", onehot_only, batch)
+
+
+# prebuilt operands, einsum alone
+X = jax.jit(build_x)(batch)
+live0, gids0, _, _ = jax.jit(make_inputs)(batch)
+jax.block_until_ready((X, gids0))
+L = X.shape[1]
+print(f"X: {X.shape} {X.dtype}", flush=True)
+
+
+def einsum_only(X, gids):
+    x3 = _mm_chunked(X, 0)
+    g3 = _mm_chunked(gids, 6)
+    onehot = (g3[..., None] == jnp.arange(6, dtype=gids.dtype)).astype(jnp.int8)
+    partials = jnp.einsum("ncl,ncg->ngl", x3, onehot,
+                          preferred_element_type=jnp.int32)
+    return partials.astype(jnp.int64).sum(axis=0)
+
+
+timeit("einsum only (prebuilt X)", einsum_only, X, gids0)
+
+
+def einsum_nochunk(X, gids):
+    onehot = (gids[:, None] == jnp.arange(6, dtype=gids.dtype)).astype(jnp.int8)
+    return jnp.einsum("nl,ng->gl", X, onehot,
+                      preferred_element_type=jnp.int32)
+
+
+timeit("einsum no-chunk int32", einsum_nochunk, X, gids0)
+
+
+# masked per-group reduction over prebuilt X (VPU alternative to MXU)
+def masked_x(X, gids):
+    outs = []
+    for g in range(6):
+        m = (gids == g)[:, None]
+        outs.append(jnp.sum(jnp.where(m, X, 0), axis=0, dtype=jnp.int32))
+    return jnp.stack(outs)
+
+
+timeit("masked per-group over X", masked_x, X, gids0)
+
+
+# bf16 einsum with f32 accumulation: int8 lanes are exact in bf16
+def einsum_bf16(X, gids):
+    x3 = _mm_chunked(X, 0).astype(jnp.bfloat16)
+    g3 = _mm_chunked(gids, 6)
+    onehot = (g3[..., None] == jnp.arange(6, dtype=gids.dtype)).astype(
+        jnp.bfloat16)
+    partials = jnp.einsum("ncl,ncg->ngl", x3, onehot,
+                          preferred_element_type=jnp.float32)
+    return partials.astype(jnp.float64).sum(axis=0)
+
+
+timeit("einsum bf16/f32 acc", einsum_bf16, X, gids0)

@@ -1,0 +1,187 @@
+"""Round-5 Q1 probe B: transposed-lane layout candidates.
+
+perf_q1_r5.py showed the [rows, L] int8 X build is the killer (padded
+(32,128) tiling -> ~130 GB of write amplification when stacking lane
+columns). Candidates here keep every lane a CONTIGUOUS [N] row:
+
+  xT build      — X^T [L, N] int8 stack(axis=0)
+  dotT          — dot_general X^T [L,N] x onehot [Gc,N] contracting N,
+                  Gc = groups x chunks so int32 accumulation is exact
+  fullT         — build + dot + int64 combine (candidate kernel)
+  vpuT          — masked VPU per-group sums over X^T reshaped
+                  [L, nch, chunk]
+
+Run: python notes/perf_q1_r5b.py [tile]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from bench import put_table  # noqa: E402
+from presto_tpu.connectors.tpch import TpchConnector  # noqa: E402
+from presto_tpu.workloads import Q1_BITS, Q1_COLS, q1_exprs  # noqa: E402
+from presto_tpu.expr import evaluate, evaluate_predicate  # noqa: E402
+from presto_tpu.ops.groupby import group_ids_direct  # noqa: E402
+
+TILE = int(sys.argv[1]) if len(sys.argv) > 1 else 10
+LANE_BITS = 7
+CHUNK = 1 << 23  # 127 * 2^23 < 2^31
+G = 6
+
+dev = jax.devices()[0]
+print("device:", dev, flush=True)
+_ = int(jax.device_put(jnp.arange(4), dev).sum())
+
+conn = TpchConnector(sf=1.0, units_per_split=1 << 26)
+arrays = conn.table_numpy("lineitem", list(Q1_COLS))
+batch, n = put_table("lineitem", arrays, dev, tile=TILE, narrow=True)
+cap = batch.capacity
+nch = -(-cap // CHUNK)
+print(f"rows={n} cap={cap} nch={nch}", flush=True)
+
+
+def timeit(name, fn, *args, iters=3):
+    f = jax.jit(fn)
+    out = f(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = f(*args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+    print(f"{name:28s} {dt * 1e3:9.2f} ms   {n / dt / 1e9:7.3f} Grows/s",
+          flush=True)
+    return out
+
+
+def make_inputs(b):
+    pred, disc_price, charge = q1_exprs()
+    live = b.live & evaluate_predicate(pred, b)
+    gids, _ = group_ids_direct(
+        [b["l_returnflag"].data, b["l_linestatus"].data],
+        (0, 0), (2, 1), live, G,
+    )
+    vals = [b["l_quantity"].data, b["l_extendedprice"].data,
+            evaluate(disc_price, b).data, evaluate(charge, b).data]
+    bits = [Q1_BITS[k] for k in
+            ("sum_qty", "sum_base_price", "sum_disc_price", "sum_charge")]
+    return live, gids, vals, bits
+
+
+def build_xT(b):
+    live, gids, vals, bits = make_inputs(b)
+    rows = []
+    for v, nb in zip(vals, bits):
+        vv = jnp.where(live, v, 0)
+        neg = vv < 0
+        mag = jnp.abs(vv)
+        nlanes = max(1, -(-nb // LANE_BITS))
+        for k in range(nlanes):
+            lane = ((mag >> (LANE_BITS * k)) & 127).astype(jnp.int8)
+            rows.append(jnp.where(neg, -lane, lane))
+    rows.append(live.astype(jnp.int8))
+    return jnp.stack(rows, axis=0), gids  # [L, N]
+
+
+def xT_only(b):
+    xT, _ = build_xT(b)
+    return xT.astype(jnp.int32).sum()
+
+
+timeit("xT build only", xT_only, batch)
+
+xT, gids0 = jax.jit(build_xT)(batch)
+jax.block_until_ready((xT, gids0))
+L = xT.shape[0]
+print(f"xT: {xT.shape} {xT.dtype}", flush=True)
+
+
+def combined_onehot(gids):
+    # cid in [0, G*nch): group + G * chunk index -> int32 sums exact
+    cid = gids + G * (jnp.arange(cap, dtype=jnp.int32) >> 23)
+    cid = jnp.where(gids >= G, G * nch, cid)  # trash rows -> no column
+    return (cid[None, :] == jnp.arange(G * nch, dtype=jnp.int32)[:, None]).astype(
+        jnp.int8
+    )  # [Gc, N]
+
+
+def dotT(xT, gids):
+    oh = combined_onehot(gids)
+    out = jax.lax.dot_general(
+        xT, oh, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )  # [L, Gc]
+    return out
+
+
+timeit("dotT (prebuilt xT)", dotT, xT, gids0)
+
+
+def fullT(b):
+    xT, gids = build_xT(b)
+    out = dotT(xT, gids)  # [L, Gc] int32
+    o3 = out.reshape(L, nch, G).astype(jnp.int64).sum(axis=1)  # [L, G]
+    spans = []
+    bits = [Q1_BITS[k] for k in
+            ("sum_qty", "sum_base_price", "sum_disc_price", "sum_charge")]
+    i = 0
+    res = {}
+    for name, nb in zip(("sum_qty", "sum_base_price", "sum_disc_price",
+                         "sum_charge"), bits):
+        nlanes = max(1, -(-nb // LANE_BITS))
+        s = jnp.zeros(G, jnp.int64)
+        for k in range(nlanes):
+            s = s + (o3[i + k] << (LANE_BITS * k))
+        res[name] = s
+        i += nlanes
+    res["count_order"] = o3[i]
+    return res
+
+
+state = timeit("fullT (candidate kernel)", fullT, batch)
+
+# exactness check vs numpy over the base SF1 slice
+m = arrays["l_shipdate"] <= 10471
+gid = (arrays["l_returnflag"].astype(np.int64) * 2
+       + arrays["l_linestatus"].astype(np.int64))[m]
+dp = arrays["l_extendedprice"][m].astype(np.int64) * (100 - arrays["l_discount"][m])
+ch = (np.abs(dp * (100 + arrays["l_tax"][m])) + 50) // 100
+
+
+def seg(v):
+    out = np.zeros(G, np.int64)
+    np.add.at(out, gid, v)
+    return out
+
+
+got = {k: np.asarray(v) for k, v in state.items()}
+np.testing.assert_array_equal(got["sum_qty"], TILE * seg(arrays["l_quantity"][m].astype(np.int64)))
+np.testing.assert_array_equal(got["sum_base_price"], TILE * seg(arrays["l_extendedprice"][m].astype(np.int64)))
+np.testing.assert_array_equal(got["sum_disc_price"], TILE * seg(dp))
+np.testing.assert_array_equal(got["sum_charge"], TILE * seg(ch))
+np.testing.assert_array_equal(got["count_order"], TILE * np.bincount(gid, minlength=G))
+print("fullT EXACT vs numpy", flush=True)
+
+
+def vpuT(xT, gids):
+    x3 = xT.reshape(L, nch, CHUNK) if cap % CHUNK == 0 else None
+    g2 = gids.reshape(nch, CHUNK)
+    outs = []
+    for g in range(G):
+        m = (g2 == g)[None, :, :]
+        outs.append(jnp.sum(jnp.where(m, x3, 0), axis=2, dtype=jnp.int32))
+    return jnp.stack(outs)  # [G, L, nch]
+
+
+if cap % CHUNK == 0:
+    timeit("vpuT masked per-group", vpuT, xT, gids0)
+else:
+    print("vpuT skipped: cap not chunk-aligned", flush=True)

@@ -1,0 +1,229 @@
+"""Round-5 Q1 probe C: chunk-scan fused build+dot.
+
+r5b showed: dot is ~floor-cheap, the [L,N] lane build (~80 ms real) now
+dominates, and the combined one-hot [G*nch, N] wastes 8x storage on
+zero blocks. Candidate: lax.scan over 2^23-row chunks — build the lane
+block [L, chunk] and one-hot [G, chunk] per chunk, dot them (int32,
+exact), accumulate int64. X and the one-hot never hit HBM whole.
+
+Also bisects the lane build: int64 vs int32 lane math, expr eval cost.
+
+Run: python notes/perf_q1_r5c.py [tile]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from bench import put_table  # noqa: E402
+from presto_tpu.connectors.tpch import TpchConnector  # noqa: E402
+from presto_tpu.workloads import Q1_BITS, Q1_COLS, q1_exprs  # noqa: E402
+from presto_tpu.expr import evaluate, evaluate_predicate  # noqa: E402
+from presto_tpu.ops.groupby import group_ids_direct  # noqa: E402
+
+TILE = int(sys.argv[1]) if len(sys.argv) > 1 else 10
+LANE_BITS = 7
+CHUNK = 1 << 23
+G = 6
+NAMES = ("sum_qty", "sum_base_price", "sum_disc_price", "sum_charge")
+BITS = [Q1_BITS[k] for k in NAMES]
+NLANES = [max(1, -(-b // LANE_BITS)) for b in BITS]
+L = sum(NLANES) + 1  # + count lane
+
+dev = jax.devices()[0]
+print("device:", dev, flush=True)
+_ = int(jax.device_put(jnp.arange(4), dev).sum())
+
+conn = TpchConnector(sf=1.0, units_per_split=1 << 26)
+arrays = conn.table_numpy("lineitem", list(Q1_COLS))
+batch, n = put_table("lineitem", arrays, dev, tile=TILE, narrow=True)
+cap = batch.capacity
+nch = -(-cap // CHUNK)
+pad = nch * CHUNK - cap
+print(f"rows={n} cap={cap} nch={nch} pad={pad} L={L}", flush=True)
+
+
+def timeit(name, fn, *args, iters=3):
+    f = jax.jit(fn)
+    out = f(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = f(*args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+    print(f"{name:34s} {dt * 1e3:9.2f} ms   {n / dt / 1e9:7.3f} Grows/s",
+          flush=True)
+    return out
+
+
+def make_vals(b):
+    """live, gids, and the four aggregate value columns as int32.
+
+    dp fits int32 (|dp| < 1.1e9); ch needs one int64 round-trip but is
+    converted to int32 immediately (|ch| < 1.2e9).
+    """
+    pred, _, _ = q1_exprs()
+    live = b.live & evaluate_predicate(pred, b)
+    gids, _ = group_ids_direct(
+        [b["l_returnflag"].data, b["l_linestatus"].data],
+        (0, 0), (2, 1), live, G,
+    )
+    qty = b["l_quantity"].data.astype(jnp.int32)
+    ep = b["l_extendedprice"].data.astype(jnp.int32)
+    disc = b["l_discount"].data.astype(jnp.int32)
+    tax = b["l_tax"].data.astype(jnp.int32)
+    dp = ep * (100 - disc)  # < 2^31, exact in int32
+    prod = dp.astype(jnp.int64) * (100 + tax).astype(jnp.int64)
+    ch = ((prod + 50) // 100).astype(jnp.int32)  # all values >= 0
+    return live, gids, [qty, ep, dp, ch]
+
+
+def lanes_i32(v, nlanes, live):
+    vv = jnp.where(live, v, 0)
+    neg = vv < 0
+    mag = jnp.abs(vv)
+    out = []
+    for k in range(nlanes):
+        lane = ((mag >> (LANE_BITS * k)) & 127).astype(jnp.int8)
+        out.append(jnp.where(neg, -lane, lane))
+    return out
+
+
+def build_xT_i32(b):
+    live, gids, vals = make_vals(b)
+    rows = []
+    for v, nl in zip(vals, NLANES):
+        rows.extend(lanes_i32(v, nl, live))
+    rows.append(live.astype(jnp.int8))
+    return jnp.stack(rows, axis=0), gids
+
+
+def xT_i32_only(b):
+    xT, _ = build_xT_i32(b)
+    return xT.astype(jnp.int32).sum()
+
+
+timeit("xT build int32 math", xT_i32_only, batch)
+
+
+def vals_only(b):
+    live, gids, vals = make_vals(b)
+    t = gids.astype(jnp.int32).sum()
+    for v in vals:
+        t = t + v.sum()
+    return t
+
+
+timeit("vals+gid only (int32)", vals_only, batch)
+
+
+def combine(partials):  # [nch or scan-summed][L, G] int64 -> state
+    o = partials  # [L, G] int64
+    res = {}
+    i = 0
+    for name, nl in zip(NAMES, NLANES):
+        s = jnp.zeros(G, jnp.int64)
+        for k in range(nl):
+            s = s + (o[i + k] << (LANE_BITS * k))
+        res[name] = s
+        i += nl
+    res["count_order"] = o[i]
+    return res
+
+
+def scan_fused(b):
+    live, gids, vals = make_vals(b)
+
+    def pad_to(x, fill):
+        if pad:
+            x = jnp.concatenate([x, jnp.full((pad,), fill, x.dtype)])
+        return x.reshape(nch, CHUNK)
+
+    live2 = pad_to(live, False)
+    gids2 = pad_to(jnp.where(live, gids, G), G)
+    vals2 = [pad_to(v, 0) for v in vals]
+
+    def body(acc, xs):
+        lv, gd, *vs = xs
+        rows = []
+        for v, nl in zip(vs, NLANES):
+            rows.extend(lanes_i32(v, nl, lv))
+        rows.append(lv.astype(jnp.int8))
+        xc = jnp.stack(rows, axis=0)  # [L, CHUNK] int8
+        oh = (gd[None, :] == jnp.arange(G, dtype=gids.dtype)[:, None]).astype(
+            jnp.int8
+        )  # [G, CHUNK]
+        part = jax.lax.dot_general(
+            xc, oh, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )  # [L, G] int32, exact per chunk
+        return acc + part.astype(jnp.int64), None
+
+    acc0 = jnp.zeros((L, G), jnp.int64)
+    acc, _ = jax.lax.scan(body, acc0, (live2, gids2, *vals2))
+    return combine(acc)
+
+
+state = timeit("scan fused build+dot", scan_fused, batch)
+
+
+def unrolled_fused(b):
+    live, gids, vals = make_vals(b)
+
+    def pad_to(x, fill):
+        if pad:
+            x = jnp.concatenate([x, jnp.full((pad,), fill, x.dtype)])
+        return x.reshape(nch, CHUNK)
+
+    live2 = pad_to(live, False)
+    gids2 = pad_to(jnp.where(live, gids, G), G)
+    vals2 = [pad_to(v, 0) for v in vals]
+    acc = jnp.zeros((L, G), jnp.int64)
+    for c in range(nch):
+        rows = []
+        for v, nl in zip(vals2, NLANES):
+            rows.extend(lanes_i32(v[c], nl, live2[c]))
+        rows.append(live2[c].astype(jnp.int8))
+        xc = jnp.stack(rows, axis=0)
+        oh = (gids2[c][None, :] == jnp.arange(G, dtype=gids.dtype)[:, None]
+              ).astype(jnp.int8)
+        part = jax.lax.dot_general(
+            xc, oh, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )
+        acc = acc + part.astype(jnp.int64)
+    return combine(acc)
+
+
+state2 = timeit("unrolled fused build+dot", unrolled_fused, batch)
+
+# exactness
+m = arrays["l_shipdate"] <= 10471
+gid = (arrays["l_returnflag"].astype(np.int64) * 2
+       + arrays["l_linestatus"].astype(np.int64))[m]
+dp = arrays["l_extendedprice"][m].astype(np.int64) * (100 - arrays["l_discount"][m])
+ch = (np.abs(dp * (100 + arrays["l_tax"][m])) + 50) // 100
+
+
+def seg(v):
+    out = np.zeros(G, np.int64)
+    np.add.at(out, gid, v)
+    return out
+
+
+for tag, st in (("scan", state), ("unrolled", state2)):
+    got = {k: np.asarray(v) for k, v in st.items()}
+    np.testing.assert_array_equal(got["sum_qty"], TILE * seg(arrays["l_quantity"][m].astype(np.int64)), err_msg=tag)
+    np.testing.assert_array_equal(got["sum_base_price"], TILE * seg(arrays["l_extendedprice"][m].astype(np.int64)), err_msg=tag)
+    np.testing.assert_array_equal(got["sum_disc_price"], TILE * seg(dp), err_msg=tag)
+    np.testing.assert_array_equal(got["sum_charge"], TILE * seg(ch), err_msg=tag)
+    np.testing.assert_array_equal(got["count_order"], TILE * np.bincount(gid, minlength=G), err_msg=tag)
+    print(f"{tag} EXACT vs numpy", flush=True)

@@ -1,0 +1,173 @@
+"""Round-5 Q1 probe D: cheaper lane extraction.
+
+r5c: lane extraction (~50 ms real) dominates; dot ~12 ms; reads ~16 ms.
+Candidates:
+  nosign    — skip neg/abs/where for non-negative values (all Q1 sums)
+  u8        — unsigned 8-bit lanes (14 cols vs 17; 255*2^23 < 2^31 exact)
+  bcast     — one broadcasted (mag[None] >> shifts[:,None]) & mask op
+              per aggregate instead of per-lane op chains
+  fullD     — best-of combination end-to-end, exactness-checked
+
+Run: python notes/perf_q1_r5d.py [tile]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from bench import put_table  # noqa: E402
+from presto_tpu.connectors.tpch import TpchConnector  # noqa: E402
+from presto_tpu.workloads import Q1_BITS, Q1_COLS, q1_exprs  # noqa: E402
+from presto_tpu.expr import evaluate_predicate  # noqa: E402
+from presto_tpu.ops.groupby import group_ids_direct  # noqa: E402
+
+TILE = int(sys.argv[1]) if len(sys.argv) > 1 else 10
+G = 6
+NAMES = ("sum_qty", "sum_base_price", "sum_disc_price", "sum_charge")
+BITS = [Q1_BITS[k] for k in NAMES]
+
+dev = jax.devices()[0]
+print("device:", dev, flush=True)
+_ = int(jax.device_put(jnp.arange(4), dev).sum())
+
+conn = TpchConnector(sf=1.0, units_per_split=1 << 26)
+arrays = conn.table_numpy("lineitem", list(Q1_COLS))
+batch, n = put_table("lineitem", arrays, dev, tile=TILE, narrow=True)
+cap = batch.capacity
+print(f"rows={n} cap={cap}", flush=True)
+
+
+def timeit(name, fn, *args, iters=3):
+    f = jax.jit(fn)
+    out = f(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = f(*args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+    print(f"{name:34s} {dt * 1e3:9.2f} ms   {n / dt / 1e9:7.3f} Grows/s",
+          flush=True)
+    return out
+
+
+def make_vals(b):
+    pred, _, _ = q1_exprs()
+    live = b.live & evaluate_predicate(pred, b)
+    gids, _ = group_ids_direct(
+        [b["l_returnflag"].data, b["l_linestatus"].data],
+        (0, 0), (2, 1), live, G,
+    )
+    qty = b["l_quantity"].data.astype(jnp.int32)
+    ep = b["l_extendedprice"].data.astype(jnp.int32)
+    disc = b["l_discount"].data.astype(jnp.int32)
+    tax = b["l_tax"].data.astype(jnp.int32)
+    dp = ep * (100 - disc)
+    prod = dp.astype(jnp.int64) * (100 + tax).astype(jnp.int64)
+    ch = ((prod + 50) // 100).astype(jnp.int32)
+    return live, gids, [qty, ep, dp, ch]
+
+
+LANE_BITS = 8  # unsigned lanes, values known non-negative
+NLANES = [max(1, -(-b // LANE_BITS)) for b in BITS]
+L = sum(NLANES) + 1
+CHUNK = 1 << 23  # 255 * 2^23 = 2139095040 < 2^31
+nch = -(-cap // CHUNK)
+print(f"u8 lanes: L={L} nch={nch}", flush=True)
+
+
+def build_u8_bcast(b):
+    live, gids, vals = make_vals(b)
+    blocks = []
+    for v, nl in zip(vals, NLANES):
+        vv = jnp.where(live, v, 0)
+        if nl == 1:
+            blocks.append(vv.astype(jnp.uint8)[None, :])
+        else:
+            shifts = jnp.arange(nl, dtype=jnp.int32)[:, None] * LANE_BITS
+            blocks.append(((vv[None, :] >> shifts) & 255).astype(jnp.uint8))
+    blocks.append(live.astype(jnp.uint8)[None, :])
+    return jnp.concatenate(blocks, axis=0), gids  # [L, N] uint8
+
+
+def u8_only(b):
+    xT, _ = build_u8_bcast(b)
+    return xT.astype(jnp.int32).sum()
+
+
+timeit("u8 bcast build only", u8_only, batch)
+
+
+def build_u8_perlane(b):
+    live, gids, vals = make_vals(b)
+    rows = []
+    for v, nl in zip(vals, NLANES):
+        vv = jnp.where(live, v, 0)
+        for k in range(nl):
+            rows.append(((vv >> (LANE_BITS * k)) & 255).astype(jnp.uint8))
+    rows.append(live.astype(jnp.uint8))
+    return jnp.stack(rows, axis=0), gids
+
+
+def u8pl_only(b):
+    xT, _ = build_u8_perlane(b)
+    return xT.astype(jnp.int32).sum()
+
+
+timeit("u8 per-lane build only", u8pl_only, batch)
+
+
+def fullD(b, build):
+    xT, gids = build(b)
+    cid = jnp.where(gids >= G, G * nch,
+                    gids + G * (jnp.arange(cap, dtype=jnp.int32) >> 23))
+    oh = (cid[None, :] == jnp.arange(G * nch, dtype=jnp.int32)[:, None]).astype(
+        jnp.uint8)
+    out = jax.lax.dot_general(
+        xT, oh, (((1,), (1,)), ((), ())), preferred_element_type=jnp.int32,
+    )  # [L, G*nch]
+    o3 = out.reshape(L, nch, G).astype(jnp.int64).sum(axis=1)
+    res = {}
+    i = 0
+    for name, nl in zip(NAMES, NLANES):
+        s = jnp.zeros(G, jnp.int64)
+        for k in range(nl):
+            s = s + (o3[i + k] << (LANE_BITS * k))
+        res[name] = s
+        i += nl
+    res["count_order"] = o3[i]
+    return res
+
+
+state = timeit("fullD u8 bcast + dot", lambda b: fullD(b, build_u8_bcast), batch)
+state2 = timeit("fullD u8 per-lane + dot", lambda b: fullD(b, build_u8_perlane), batch)
+
+# exactness
+m = arrays["l_shipdate"] <= 10471
+gid = (arrays["l_returnflag"].astype(np.int64) * 2
+       + arrays["l_linestatus"].astype(np.int64))[m]
+dpw = arrays["l_extendedprice"][m].astype(np.int64) * (100 - arrays["l_discount"][m])
+chw = (np.abs(dpw * (100 + arrays["l_tax"][m])) + 50) // 100
+
+
+def seg(v):
+    out = np.zeros(G, np.int64)
+    np.add.at(out, gid, v)
+    return out
+
+
+for tag, st in (("bcast", state), ("perlane", state2)):
+    got = {k: np.asarray(v) for k, v in st.items()}
+    np.testing.assert_array_equal(got["sum_qty"], TILE * seg(arrays["l_quantity"][m].astype(np.int64)), err_msg=tag)
+    np.testing.assert_array_equal(got["sum_base_price"], TILE * seg(arrays["l_extendedprice"][m].astype(np.int64)), err_msg=tag)
+    np.testing.assert_array_equal(got["sum_disc_price"], TILE * seg(dpw), err_msg=tag)
+    np.testing.assert_array_equal(got["sum_charge"], TILE * seg(chw), err_msg=tag)
+    np.testing.assert_array_equal(got["count_order"], TILE * np.bincount(gid, minlength=G), err_msg=tag)
+    print(f"{tag} EXACT vs numpy", flush=True)

@@ -1,0 +1,161 @@
+"""Round-5 Q1 probe E: small one-hot via batch dims + int32-only charge.
+
+r5d left three known wastes:
+  - one-hot [G*nch, N] is 8x zeros -> batched dot "lcn,gcn->clg" keeps
+    the one-hot at [G, N] (360 MB not 2.9 GB);
+  - where(live, v, 0) zeroing is redundant: dead rows have an all-zero
+    one-hot column, so their lanes never contribute; count lane = ones;
+  - charge's int64 (dp*t+50)//100 -> int32 identity
+    q*t + (r*t+50)//100 with q,r = divmod(dp, 100)  (q*t < 1.19e9).
+
+Run: python notes/perf_q1_r5e.py [tile]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from bench import put_table  # noqa: E402
+from presto_tpu.connectors.tpch import TpchConnector  # noqa: E402
+from presto_tpu.workloads import Q1_BITS, Q1_COLS, q1_exprs  # noqa: E402
+from presto_tpu.expr import evaluate_predicate  # noqa: E402
+from presto_tpu.ops.groupby import group_ids_direct  # noqa: E402
+
+TILE = int(sys.argv[1]) if len(sys.argv) > 1 else 10
+G = 6
+NAMES = ("sum_qty", "sum_base_price", "sum_disc_price", "sum_charge")
+BITS = [Q1_BITS[k] for k in NAMES]
+LANE_BITS = 8
+NLANES = [max(1, -(-b // LANE_BITS)) for b in BITS]
+L = sum(NLANES) + 1
+CHUNK = 1 << 23
+
+dev = jax.devices()[0]
+print("device:", dev, flush=True)
+_ = int(jax.device_put(jnp.arange(4), dev).sum())
+
+conn = TpchConnector(sf=1.0, units_per_split=1 << 26)
+arrays = conn.table_numpy("lineitem", list(Q1_COLS))
+batch, n = put_table("lineitem", arrays, dev, tile=TILE, narrow=True)
+cap = batch.capacity
+nch = -(-cap // CHUNK)
+pad = nch * CHUNK - cap
+print(f"rows={n} cap={cap} nch={nch} pad={pad} L={L}", flush=True)
+
+
+def timeit(name, fn, *args, iters=3):
+    f = jax.jit(fn)
+    out = f(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = f(*args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+    print(f"{name:34s} {dt * 1e3:9.2f} ms   {n / dt / 1e9:7.3f} Grows/s",
+          flush=True)
+    return out
+
+
+def make_vals_i32(b):
+    pred, _, _ = q1_exprs()
+    live = b.live & evaluate_predicate(pred, b)
+    gids, _ = group_ids_direct(
+        [b["l_returnflag"].data, b["l_linestatus"].data],
+        (0, 0), (2, 1), live, G,
+    )
+    qty = b["l_quantity"].data.astype(jnp.int32)
+    ep = b["l_extendedprice"].data.astype(jnp.int32)
+    disc = b["l_discount"].data.astype(jnp.int32)
+    tax = b["l_tax"].data.astype(jnp.int32)
+    dp = ep * (100 - disc)
+    t = 100 + tax
+    q, r = dp // 100, dp % 100
+    ch = q * t + (r * t + 50) // 100  # int32-exact, see module docstring
+    return live, gids, [qty, ep, dp, ch]
+
+
+def vals_i32_only(b):
+    live, gids, vals = make_vals_i32(b)
+    t = gids.astype(jnp.int32).sum()
+    for v in vals:
+        t = t + v.sum()
+    return t
+
+
+timeit("vals+gid int32-only charge", vals_i32_only, batch)
+
+
+def fullE(b):
+    live, gids, vals = make_vals_i32(b)
+    blocks = []
+    oflow = jnp.zeros((), jnp.bool_)
+    for v, nl, bits in zip(vals, NLANES, BITS):
+        oflow = oflow | jnp.any(jnp.where(live, v, 0) >> bits != 0)
+        if nl == 1:
+            blocks.append(v.astype(jnp.uint8)[None, :])
+        else:
+            shifts = jnp.arange(nl, dtype=jnp.int32)[:, None] * LANE_BITS
+            blocks.append(((v[None, :] >> shifts) & 255).astype(jnp.uint8))
+    blocks.append(jnp.ones((1, cap), jnp.uint8))  # count lane: ones
+    xT = jnp.concatenate(blocks, axis=0)  # [L, N] uint8
+
+    def pad_to(x, fill):
+        if pad:
+            x = jnp.concatenate([x, jnp.full((pad,), fill, x.dtype)])
+        return x
+
+    g1 = pad_to(jnp.where(live, gids, G), G)  # dead/pad -> no one-hot row
+    oh = (g1[None, :] == jnp.arange(G, dtype=jnp.int32)[:, None]).astype(
+        jnp.uint8)  # [G, Np]
+    if pad:
+        xT = jnp.concatenate([xT, jnp.zeros((L, pad), jnp.uint8)], axis=1)
+    x3 = xT.reshape(L, nch, CHUNK)
+    oh3 = oh.reshape(G, nch, CHUNK)
+    partials = jnp.einsum("lcn,gcn->clg", x3, oh3,
+                          preferred_element_type=jnp.int32)  # [nch, L, G]
+    o3 = partials.astype(jnp.int64).sum(axis=0)  # [L, G]
+    res = {}
+    i = 0
+    for name, nl in zip(NAMES, NLANES):
+        s = jnp.zeros(G, jnp.int64)
+        for k in range(nl):
+            s = s + (o3[i + k] << (LANE_BITS * k))
+        res[name] = s
+        i += nl
+    res["count_order"] = o3[i]
+    res["value_overflow"] = oflow
+    return res
+
+
+state = timeit("fullE small-onehot batched", fullE, batch)
+
+# exactness
+m = arrays["l_shipdate"] <= 10471
+gidw = (arrays["l_returnflag"].astype(np.int64) * 2
+        + arrays["l_linestatus"].astype(np.int64))[m]
+dpw = arrays["l_extendedprice"][m].astype(np.int64) * (100 - arrays["l_discount"][m])
+chw = (np.abs(dpw * (100 + arrays["l_tax"][m])) + 50) // 100
+
+
+def seg(v):
+    out = np.zeros(G, np.int64)
+    np.add.at(out, gidw, v)
+    return out
+
+
+got = {k: np.asarray(v) for k, v in state.items()}
+assert not bool(got["value_overflow"])
+np.testing.assert_array_equal(got["sum_qty"], TILE * seg(arrays["l_quantity"][m].astype(np.int64)))
+np.testing.assert_array_equal(got["sum_base_price"], TILE * seg(arrays["l_extendedprice"][m].astype(np.int64)))
+np.testing.assert_array_equal(got["sum_disc_price"], TILE * seg(dpw))
+np.testing.assert_array_equal(got["sum_charge"], TILE * seg(chw))
+np.testing.assert_array_equal(got["count_order"], TILE * np.bincount(gidw, minlength=G))
+print("fullE EXACT vs numpy", flush=True)

@@ -1,0 +1,179 @@
+"""Round-5 Q1 probe F: single-pass Pallas kernel.
+
+One grid pass over the narrow resident columns; per block: predicate,
+gid, dp/ch (f32-reciprocal divmod-100, exactness proven over the full
+domain in-round), unsigned 8-bit lane split, 6 masked per-group sums
+per lane — all in VMEM/registers. Output: [nmajor, 128] int32 scalar
+slots (each major covers <= 2^23 rows so 255*2^23 < 2^31 keeps int32
+exact); an XLA epilogue recombines lanes into int64 sums.
+
+Run: python notes/perf_q1_r5f.py [tile]
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+import time
+
+sys.setrecursionlimit(100000)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+sys.path.insert(0, ".")
+
+from bench import put_table  # noqa: E402
+from presto_tpu.connectors.tpch import TpchConnector  # noqa: E402
+from presto_tpu.workloads import Q1_COLS  # noqa: E402
+
+TILE = int(sys.argv[1]) if len(sys.argv) > 1 else 10
+G = 6
+NAMES = ("sum_qty", "sum_base_price", "sum_disc_price", "sum_charge")
+NLANES = [2, 3, 4, 4]  # 13/24/31/31 bits in unsigned 8-bit lanes
+NL = sum(NLANES)  # 13 value lanes
+B = 1 << 18
+SPM = 32  # blocks per major: 32 * 2^18 = 2^23 rows
+CUTOFF = 10471
+
+dev = jax.devices()[0]
+print("device:", dev, flush=True)
+_ = int(jax.device_put(jnp.arange(4), dev).sum())
+
+conn = TpchConnector(sf=1.0, units_per_split=1 << 26)
+arrays = conn.table_numpy("lineitem", list(Q1_COLS))
+batch, n = put_table("lineitem", arrays, dev, tile=TILE, narrow=True)
+cap = batch.capacity
+assert cap % B == 0, (cap, B)
+nblk = cap // B
+nmajor = -(-nblk // SPM)
+print(f"rows={n} cap={cap} nblk={nblk} nmajor={nmajor}", flush=True)
+
+
+def divmod100(dp):
+    """Exact (dp//100, dp%100) for 0 <= dp < 1.1e9 in int32/f32 ops."""
+    q = jnp.floor(dp.astype(jnp.float32) * np.float32(0.01)).astype(jnp.int32)
+    r = dp - 100 * q
+    for _ in range(2):
+        over = (r >= 100).astype(jnp.int32)
+        q = q + over
+        r = r - 100 * over
+        under = (r < 0).astype(jnp.int32)
+        q = q - under
+        r = r + 100 * under
+    return q, r
+
+
+def kernel(ship_ref, rf_ref, ls_ref, qty_ref, ep_ref, disc_ref, tax_ref,
+           live_ref, o_ref):
+    i = pl.program_id(0)
+    live = (live_ref[...] != 0) & (ship_ref[...].astype(jnp.int32) <= CUTOFF)
+    gid = jnp.where(
+        live, rf_ref[...].astype(jnp.int32) * 2 + ls_ref[...].astype(jnp.int32),
+        G,
+    )
+    qty = qty_ref[...].astype(jnp.int32)
+    ep = ep_ref[...].astype(jnp.int32)
+    disc = disc_ref[...].astype(jnp.int32)
+    tax = tax_ref[...].astype(jnp.int32)
+    dp = ep * (100 - disc)
+    t = 100 + tax
+    q, r = divmod100(dp)
+    # (r*t + 50)//100 via verified magic 5243 >> 19 (range <= 10742)
+    ch = q * t + (((r * t + 50) * 5243) >> 19)
+
+    lanes = []
+    for v, nl in zip((qty, ep, dp, ch), NLANES):
+        for k in range(nl):
+            lanes.append((v >> (8 * k)) & 255)
+
+    scalars = []
+    for g in range(G):
+        m = gid == g
+        for lane in lanes:
+            scalars.append(jnp.sum(jnp.where(m, lane, 0)))
+        scalars.append(jnp.sum(m.astype(jnp.int32)))
+    # overflow guard: any live value beyond its declared lanes
+    ov = jnp.sum(jnp.where(live, (qty >> 16) | (ep >> 24), 0))
+    scalars.append(ov)
+    vec = jnp.stack(scalars)  # [G*(NL+1) + 1]
+    vec = jnp.pad(vec, (0, 1024 - vec.shape[0])).reshape(1, 8, 128)
+
+    @pl.when(i % SPM == 0)
+    def _init():
+        o_ref[...] = vec
+
+    @pl.when(i % SPM != 0)
+    def _acc():
+        o_ref[...] = o_ref[...] + vec
+
+
+def q1_pallas(b):
+    cols = {c: b[c].data for c in Q1_COLS}
+    live = b.live.astype(jnp.int8)
+    args = [cols["l_shipdate"], cols["l_returnflag"], cols["l_linestatus"],
+            cols["l_quantity"], cols["l_extendedprice"], cols["l_discount"],
+            cols["l_tax"], live]
+    args = [a.reshape(nblk, 8, B // 8) for a in args]
+    out = pl.pallas_call(
+        kernel,
+        grid=(nblk,),
+        in_specs=[pl.BlockSpec((1, 8, B // 8), lambda i: (i, 0, 0))
+                  for _ in args],
+        out_specs=pl.BlockSpec((1, 8, 128), lambda i: (i // SPM, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((nmajor, 8, 128), jnp.int32),
+    )(*args)
+    o = out.astype(jnp.int64).sum(axis=0).reshape(1024)  # [1024]
+    per_g = o[: G * (NL + 1)].reshape(G, NL + 1)  # [G, lanes+count]
+    res = {}
+    idx = 0
+    for name, nl in zip(NAMES, NLANES):
+        s = jnp.zeros(G, jnp.int64)
+        for k in range(nl):
+            s = s + (per_g[:, idx + k] << (8 * k))
+        res[name] = s
+        idx += nl
+    res["count_order"] = per_g[:, NL]
+    res["value_overflow"] = o[G * (NL + 1)] != 0
+    return res
+
+
+def timeit(name, fn, *args, iters=3):
+    f = jax.jit(fn)
+    out = f(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = f(*args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+    print(f"{name:34s} {dt * 1e3:9.2f} ms   {n / dt / 1e9:7.3f} Grows/s",
+          flush=True)
+    return out
+
+
+state = timeit("pallas one-pass Q1", q1_pallas, batch)
+
+m = arrays["l_shipdate"] <= CUTOFF
+gidw = (arrays["l_returnflag"].astype(np.int64) * 2
+        + arrays["l_linestatus"].astype(np.int64))[m]
+dpw = arrays["l_extendedprice"][m].astype(np.int64) * (100 - arrays["l_discount"][m])
+chw = (np.abs(dpw * (100 + arrays["l_tax"][m])) + 50) // 100
+
+
+def seg(v):
+    out = np.zeros(G, np.int64)
+    np.add.at(out, gidw, v)
+    return out
+
+
+got = {k: np.asarray(v) for k, v in state.items()}
+assert not bool(got["value_overflow"])
+np.testing.assert_array_equal(got["sum_qty"], TILE * seg(arrays["l_quantity"][m].astype(np.int64)))
+np.testing.assert_array_equal(got["sum_base_price"], TILE * seg(arrays["l_extendedprice"][m].astype(np.int64)))
+np.testing.assert_array_equal(got["sum_disc_price"], TILE * seg(dpw))
+np.testing.assert_array_equal(got["sum_charge"], TILE * seg(chw))
+np.testing.assert_array_equal(got["count_order"], TILE * np.bincount(gidw, minlength=G))
+print("pallas EXACT vs numpy", flush=True)

@@ -285,6 +285,9 @@ def decode_values(
         vals = data.astype(np.float64) / 10**t.scale
     elif t.kind is TypeKind.DATE and logical:
         vals = np.datetime64("1970-01-01", "D") + data.astype(np.int64)
+    elif t.kind is TypeKind.TIMESTAMP and logical:
+        vals = (np.datetime64("1970-01-01T00:00:00", "us")
+                + data.astype("timedelta64[us]"))
     else:
         vals = data
     if valid is not None and not valid.all():

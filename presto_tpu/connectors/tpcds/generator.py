@@ -55,6 +55,8 @@ _ST = {
             "webpage", "retflag", "retdate", "retqty", "retreason",
             "retcust", "fee", "sqft", "charcnt", "linkcnt", "wtype",
             "invqty", "null4", "null5",
+            # round-5: official-template NULL-FK columns (q76 shape)
+            "nulladdr", "nullcust",
         ]
     )
 }
@@ -675,6 +677,7 @@ class TpcdsGenerator:
         arrays["ss_store_sk"] = r("store").integers(
             1, self.counts["store"] + 1, size=n, dtype=np.int64
         )
+        arrays["ss_store_sk$valid"] = r("null5").random(n) >= 0.02
         arrays["ss_ticket_number"] = np.arange(lo + 1, hi + 1, dtype=np.int64)
         net_paid = arrays["ss_net_paid"]
         tax = (net_paid * 9) // 200
@@ -698,6 +701,7 @@ class TpcdsGenerator:
         )
         gift = r("retcust").random(n) < 0.1
         arrays["cs_ship_customer_sk"] = np.where(gift, other, bill)
+        arrays["cs_ship_addr_sk$valid"] = r("nulladdr").random(n) >= 0.02
         arrays["cs_ship_date_sk"] = arrays["cs_sold_date_sk"] + r(
             "shipdate"
         ).integers(2, 121, size=n)
@@ -720,7 +724,10 @@ class TpcdsGenerator:
             1, S.FIXED_ROWS["customer_demographics"] + 1, size=n, dtype=np.int64
         )
         arrays["cs_bill_cdemo_sk$valid"] = r("null3").random(n) >= 0.04
-        arrays["cs_order_number"] = np.arange(lo + 1, hi + 1, dtype=np.int64)
+        # multi-line orders (~10 lines each, like dsdgen): the official
+        # q16/q94/q95 EXISTS shapes ("same order, another warehouse")
+        # are vacuous when every row has a unique order number
+        arrays["cs_order_number"] = np.arange(lo, hi, dtype=np.int64) // 10 + 1
         return _project(arrays, S.TABLES["catalog_sales"], columns)
 
     def web_sales_chunk(self, chunk: int, lo: int, hi: int, columns=None):
@@ -734,6 +741,7 @@ class TpcdsGenerator:
         )
         gift = r("retcust").random(n) < 0.1
         arrays["ws_ship_customer_sk"] = np.where(gift, other, bill)
+        arrays["ws_ship_customer_sk$valid"] = r("nullcust").random(n) >= 0.02
         arrays["ws_sold_time_sk"] = r("soldtime").integers(
             0, 86_400, size=n, dtype=np.int64
         )
@@ -759,7 +767,7 @@ class TpcdsGenerator:
         arrays["ws_warehouse_sk"] = r("warehouse").integers(
             1, self.counts["warehouse"] + 1, size=n, dtype=np.int64
         )
-        arrays["ws_order_number"] = np.arange(lo + 1, hi + 1, dtype=np.int64)
+        arrays["ws_order_number"] = np.arange(lo, hi, dtype=np.int64) // 8 + 1
         return _project(arrays, S.TABLES["web_sales"], columns)
 
     # -- returns channels --------------------------------------------------
@@ -817,6 +825,7 @@ class TpcdsGenerator:
             "sr_hdemo_sk": parent["ss_hdemo_sk"][idx],
             "sr_addr_sk": parent["ss_addr_sk"][idx],
             "sr_store_sk": parent["ss_store_sk"][idx],
+            "sr_store_sk$valid": parent["ss_store_sk$valid"][idx],
             "sr_reason_sk": c["reason"],
             "sr_ticket_number": parent["ss_ticket_number"][idx],
             "sr_return_quantity": c["ret_qty"],
@@ -845,6 +854,7 @@ class TpcdsGenerator:
             "cr_refunded_customer_sk": parent["cs_bill_customer_sk"][idx],
             "cr_returning_customer_sk": parent["cs_ship_customer_sk"][idx],
             "cr_returning_addr_sk": parent["cs_ship_addr_sk"][idx],
+            "cr_returning_addr_sk$valid": parent["cs_ship_addr_sk$valid"][idx],
             "cr_call_center_sk": parent["cs_call_center_sk"][idx],
             "cr_reason_sk": c["reason"],
             "cr_order_number": parent["cs_order_number"][idx],
@@ -884,6 +894,8 @@ class TpcdsGenerator:
             "wr_refunded_cdemo_sk": cdemo[idx],
             "wr_refunded_addr_sk": parent["ws_ship_addr_sk"][idx],
             "wr_returning_customer_sk": parent["ws_ship_customer_sk"][idx],
+            "wr_returning_customer_sk$valid":
+                parent["ws_ship_customer_sk$valid"][idx],
             "wr_returning_cdemo_sk": cdemo2[idx],
             "wr_reason_sk": c["reason"],
             "wr_order_number": parent["ws_order_number"][idx],

@@ -1,4 +1,4 @@
-"""TPC-DS query suite (modeled subset, adapted dialect) — 71 queries.
+"""TPC-DS query suite (modeled dialect) — all 99 queries.
 
 Reference parity: the TPC-DS SQL templates shipped with
 ``presto-tpcds`` / run by its query tests [SURVEY §2.2, §4; reference
@@ -748,11 +748,11 @@ where i_manufact_id <= 150
 
 # -- round-3 breadth batch 3: correlated EXISTS / count-distinct (q1,
 # q16, q94), three-channel UNION ALL reports (q33/q56/q60/q71/q76),
-# ROLLUP hierarchies (q22/q36/q86). Adaptations: q16/q94's EXISTS
-# correlates warehouse-equality + order-inequality (order numbers are
-# unique here); q76's channel tags are integers (string-literal group
-# keys are not supported); q22 drops i_product_name (wide free-text
-# group key) from the rollup.
+# ROLLUP hierarchies (q22/q36/q86). Round 5: q16/q94/q95 use the
+# official order-equality/warehouse-inequality EXISTS correlation
+# (the generator now emits multi-line orders), q76 the official
+# string-literal channel keys and per-channel NULL columns, q22 the
+# official rollup including i_product_name.
 
 QUERIES.update({
     # q1: customers returning more than 1.2x their store's average
@@ -782,8 +782,8 @@ where d_date between date '2000-03-01' and date '2000-06-30'
   and cs1.cs_ship_addr_sk = ca_address_sk
   and cs1.cs_call_center_sk = cc_call_center_sk
   and exists (select * from catalog_sales cs2
-              where cs1.cs_warehouse_sk = cs2.cs_warehouse_sk
-                and cs1.cs_order_number <> cs2.cs_order_number)
+              where cs1.cs_order_number = cs2.cs_order_number
+                and cs1.cs_warehouse_sk <> cs2.cs_warehouse_sk)
   and not exists (select * from catalog_returns cr1
                   where cs1.cs_order_number = cr1.cr_order_number)
 """,
@@ -797,8 +797,8 @@ where d_date between date '2000-03-01' and date '2000-06-30'
   and ws1.ws_web_site_sk = web_site_sk
   and web_company_name = 'able'
   and exists (select * from web_sales ws2
-              where ws1.ws_warehouse_sk = ws2.ws_warehouse_sk
-                and ws1.ws_order_number <> ws2.ws_order_number)
+              where ws1.ws_order_number = ws2.ws_order_number
+                and ws1.ws_warehouse_sk <> ws2.ws_warehouse_sk)
   and not exists (select * from web_returns wr1
                   where ws1.ws_order_number = wr1.wr_order_number)
 """,
@@ -926,40 +926,43 @@ group by i_brand_id, i_brand, t_hour, t_minute
 order by ext_price desc, brand_id, t_hour, t_minute
 limit 100
 """,
-    # q76: sales rows with NULL promo keys, per channel
+    # q76: sales rows with NULL keys per channel (official shape:
+    # string-literal channel/col_name group keys, per-channel null cols)
     "q76": """
-select channel, d_year, d_qoy, i_category,
+select channel, col_name, d_year, d_qoy, i_category,
        count(*) sales_cnt, sum(ext_sales_price) sales_amt
 from (
-  select 1 as channel, d_year, d_qoy, i_category,
-         ss_ext_sales_price as ext_sales_price
+  select 'store' as channel, 'ss_store_sk' col_name, d_year, d_qoy,
+         i_category, ss_ext_sales_price as ext_sales_price
   from store_sales, item, date_dim
-  where ss_promo_sk is null and ss_sold_date_sk = d_date_sk
+  where ss_store_sk is null and ss_sold_date_sk = d_date_sk
     and ss_item_sk = i_item_sk
   union all
-  select 2 as channel, d_year, d_qoy, i_category,
-         ws_ext_sales_price as ext_sales_price
+  select 'web' as channel, 'ws_ship_customer_sk' col_name, d_year, d_qoy,
+         i_category, ws_ext_sales_price as ext_sales_price
   from web_sales, item, date_dim
-  where ws_promo_sk is null and ws_sold_date_sk = d_date_sk
+  where ws_ship_customer_sk is null and ws_sold_date_sk = d_date_sk
     and ws_item_sk = i_item_sk
   union all
-  select 3 as channel, d_year, d_qoy, i_category,
-         cs_ext_sales_price as ext_sales_price
+  select 'catalog' as channel, 'cs_ship_addr_sk' col_name, d_year, d_qoy,
+         i_category, cs_ext_sales_price as ext_sales_price
   from catalog_sales, item, date_dim
-  where cs_promo_sk is null and cs_sold_date_sk = d_date_sk
+  where cs_ship_addr_sk is null and cs_sold_date_sk = d_date_sk
     and cs_item_sk = i_item_sk) foo
-group by channel, d_year, d_qoy, i_category
-order by channel, d_year, d_qoy, i_category
+group by channel, col_name, d_year, d_qoy, i_category
+order by channel, col_name, d_year, d_qoy, i_category
 limit 100
 """,
     # q22: inventory quantity-on-hand over the brand hierarchy
     "q22": """
-select i_brand, i_class, i_category, avg(inv_quantity_on_hand) qoh
+select i_product_name, i_brand, i_class, i_category,
+       avg(inv_quantity_on_hand) qoh
 from inventory, date_dim, item
 where inv_date_sk = d_date_sk and inv_item_sk = i_item_sk
   and d_month_seq between 1200 and 1211
-group by rollup(i_brand, i_class, i_category)
-order by qoh, i_brand nulls last, i_class nulls last, i_category nulls last
+group by rollup(i_product_name, i_brand, i_class, i_category)
+order by qoh, i_product_name nulls last, i_brand nulls last,
+         i_class nulls last, i_category nulls last
 limit 100
 """,
     # q36: gross margin ranked within the category/class hierarchy
@@ -2680,7 +2683,7 @@ with ws_wh as (
   select ws1.ws_order_number, ws1.ws_warehouse_sk as wh1,
          ws2.ws_warehouse_sk as wh2
   from web_sales ws1, web_sales ws2
-  where ws1.ws_bill_customer_sk = ws2.ws_bill_customer_sk
+  where ws1.ws_order_number = ws2.ws_order_number
     and ws1.ws_warehouse_sk <> ws2.ws_warehouse_sk)
 select count(distinct ws1.ws_order_number) as order_count,
        sum(ws_ext_sales_price) as total_shipping_cost,

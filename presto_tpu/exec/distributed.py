@@ -383,6 +383,7 @@ class DistributedExecutor:
 
     # ---- aggregation -----------------------------------------------------
     def _exec_aggregate(self, node: N.Aggregate, scalars) -> DistBatch:
+        from presto_tpu.exec.operators import NullGroupKeys
         from presto_tpu.ops.groupby import ValueBitsOverflow
         from presto_tpu.plan.bounds import agg_value_bits
 
@@ -423,11 +424,17 @@ class DistributedExecutor:
             try:
                 op = HashAggregationOperator(keys, aggs, strategy)
                 out = Pipeline(BatchSource([d.batch]), [op]).run()
+                return DistBatch(out[0], sharded=False)
             except ValueBitsOverflow:
                 aggs = [dataclasses.replace(a, value_bits=63) for a in aggs]
                 op = HashAggregationOperator(keys, aggs, strategy)
                 out = Pipeline(BatchSource([d.batch]), [op]).run()
-            return DistBatch(out[0], sharded=False)
+                return DistBatch(out[0], sharded=False)
+            except NullGroupKeys:
+                # the packed direct domain has no NULL slot (same replan
+                # the local planner does): fall through to the sort path
+                strategy = pick_group_strategy(
+                    keys, pax, dict_len, live_count(first), direct_limit=0)
         if not d.sharded:
             for _ in range(MAX_RETRIES):
                 op = HashAggregationOperator(keys, aggs, strategy, passengers=pax)
@@ -476,10 +483,13 @@ class DistributedExecutor:
         Pn = self.nworkers
         mesh = self.mesh
 
+        from presto_tpu.exec.operators import null_safe_key
+
         def partial_phase(b: Batch):
-            kvals = [evaluate(e, b) for _, e in keys]
+            kvals = [null_safe_key(evaluate(e, b)) for _, e in keys]
             pvals = [evaluate(e, b) for _, e in pax]
-            sortables = [c for v in kvals for c in _sortables(v)]
+            sortables = [v.valid.astype(jnp.int8) for v in kvals] + [
+                c for v in kvals for c in _sortables(v)]
             gids, rep, ng, ovf = group_ids_sort(sortables, b.live, mg)
             cols: dict[str, Column] = {}
             for (n, e), v in zip(keys, kvals):
@@ -515,8 +525,11 @@ class DistributedExecutor:
             return Batch(cols, live), ovf
 
         def final_phase(b: Batch):
+            # partial outputs are already zero-normalized; the validity
+            # sort column still separates the NULL group from real zeros
             kvals = [b[n] for n, _ in keys]
-            sortables = [c for v in kvals for c in _sortables(v)]
+            sortables = [v.valid.astype(jnp.int8) for v in kvals] + [
+                c for v in kvals for c in _sortables(v)]
             gids, rep, ng, ovf = group_ids_sort(sortables, b.live, mgf)
             cols: dict[str, Column] = {}
             for (n, e), v in zip(keys, kvals):

@@ -31,6 +31,7 @@ from presto_tpu.exec.joins import BuildOutput, JoinBuildOperator, LookupJoinOper
 from presto_tpu.exec.operators import (
     AggSpec,
     CapacityOverflow,
+    NullGroupKeys,
     DirectStrategy,
     FilterProjectOperator,
     HashAggregationOperator,
@@ -246,6 +247,11 @@ class LocalExecutor:
                 return BatchStream.of(Pipeline(child, [op]).run())
             except ValueBitsOverflow:
                 aggs = [dataclasses.replace(a, value_bits=63) for a in aggs]
+            except NullGroupKeys:
+                # the packed direct domain has no NULL slot; re-plan on
+                # the sort strategy, which groups NULL as its own value
+                strategy = self._pick_group_strategy(
+                    keys, pax, node, child, force_sort=True)
             except CapacityOverflow as e:
                 # only THIS aggregation's group overflow is retryable
                 # here — an overflow raised by the lazy child stream
@@ -258,7 +264,8 @@ class LocalExecutor:
                 strategy = SortStrategy(strategy.max_groups * 2)
         raise CapacityOverflow("Aggregate", strategy.max_groups)
 
-    def _pick_group_strategy(self, keys, pax, node: N.Aggregate, child: BatchStream):
+    def _pick_group_strategy(self, keys, pax, node: N.Aggregate,
+                             child: BatchStream, force_sort: bool = False):
         from presto_tpu.plan.bounds import estimate_rows, key_dictionary
 
         def dict_len(name: str):
@@ -267,7 +274,7 @@ class LocalExecutor:
 
         return pick_group_strategy(
             keys, pax, dict_len, estimate_rows(node.child, self.catalog),
-            direct_limit=self.direct_group_limit,
+            direct_limit=0 if force_sort else self.direct_group_limit,
         )
 
     # ---- joins -----------------------------------------------------------

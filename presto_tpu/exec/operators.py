@@ -47,6 +47,23 @@ from presto_tpu.ops.sort import sort_indices, top_n_indices
 from presto_tpu.types import BIGINT, DOUBLE, DataType, TypeKind
 
 
+def null_safe_key(v: "Val") -> "Val":
+    """Normalize a group-key Val for NULL-aware grouping: NULL rows'
+    stored data is arbitrary, so zero-fill it (all NULLs compare equal)
+    — callers ALSO sort/hash on ``v.valid`` so the NULL group stays
+    distinct from real zeros. One definition shared by the local sort
+    path and the distributed partial/final phases: the tiers must group
+    NULLs identically."""
+    mask = v.valid[:, None] if v.data.ndim > 1 else v.valid
+    return Val(jnp.where(mask, v.data, 0), v.valid, v.dtype, v.dictionary)
+
+
+class NullGroupKeys(RuntimeError):
+    """A direct-addressed grouping met NULL key values at runtime: the
+    packed-domain gid has no NULL slot, so the planner must retry with
+    the sort strategy (which groups NULL as its own key value)."""
+
+
 class CapacityOverflow(RuntimeError):
     """An operator's static output capacity was exceeded; the host
     re-plans with a larger bucket (SURVEY §7.4 hard part #1)."""
@@ -292,7 +309,13 @@ class HashAggregationOperator(Operator):
         masked-reduction path.
         """
         st: DirectStrategy = self.strategy
-        keys = [v.data for v in self._eval_keys(batch)]
+        kvals = self._eval_keys(batch)
+        nk = state["null_key"]
+        for v in kvals:
+            nk = nk | jnp.any(batch.live & ~v.valid)
+        state = dict(state)
+        state["null_key"] = nk
+        keys = [v.data for v in kvals]
         gids, _ = group_ids_direct(
             keys, st.mins, st.strides, batch.live, st.num_groups
         )
@@ -360,6 +383,7 @@ class HashAggregationOperator(Operator):
         state: dict[str, Any] = {
             "present": jnp.zeros(g, jnp.bool_),
             "value_overflow": jnp.zeros((), jnp.bool_),
+            "null_key": jnp.zeros((), jnp.bool_),
         }
         for a in self.aggs:
             kind = self._agg_kind(a)
@@ -383,20 +407,33 @@ class HashAggregationOperator(Operator):
         inputs = self._eval_inputs(batch)
 
         # concat: state group rows [g] + batch rows [cap]; wide BYTES
-        # keys contribute one sort column per 7-byte chunk
-        cat_sort = []
+        # keys contribute one sort column per 7-byte chunk. NULL keys
+        # form their OWN group (SQL): data is normalized to the zero
+        # fill so all NULLs compare equal, and a per-key validity
+        # column joins the sort keys so NULL != any real value.
+        cat_sort = []  # ALL sort columns (validity flags + key data)
+        cat_data = []  # key data columns only, aligned with sort_names
         sort_names = []
+        cat_valids = {}
         for (n, e), v in zip(self.group_keys, kvals):
+            valid = v.valid
+            cat_v = jnp.concatenate([state["keyv$" + n], valid])
+            cat_valids[n] = cat_v
+            cat_sort.append(cat_v.astype(jnp.int8))
             if e.dtype.kind is TypeKind.BYTES:
-                for j, c in enumerate(self._sortables(v)):
+                masked = null_safe_key(v)
+                for j, c in enumerate(self._sortables(masked)):
                     key = f"key${n}${j}"
-                    cat_sort.append(jnp.concatenate([state[key], c]))
+                    cat = jnp.concatenate([state[key], c])
+                    cat_sort.append(cat)
+                    cat_data.append(cat)
                     sort_names.append(key)
             else:
                 key = "key$" + n
-                cat_sort.append(jnp.concatenate(
-                    [state[key], v.data.astype(state[key].dtype)]
-                ))
+                kd = null_safe_key(v).data.astype(state[key].dtype)
+                cat = jnp.concatenate([state[key], kd])
+                cat_sort.append(cat)
+                cat_data.append(cat)
                 sort_names.append(key)
         cat_live = jnp.concatenate([state["present"], batch.live])
         gids, rep, ng, ovf = group_ids_sort(cat_sort, cat_live, g)
@@ -409,7 +446,9 @@ class HashAggregationOperator(Operator):
 
         new = dict(state)
         new["overflow"] = state["overflow"] | ovf
-        for key, cat in zip(sort_names, cat_sort):
+        for (n, _e) in self.group_keys:
+            new["keyv$" + n] = gather_padded(cat_valids[n], rep, False)
+        for key, cat in zip(sort_names, cat_data):
             new[key] = gat(cat)
         for (n, e), v in zip(self.group_keys, kvals):
             if e.dtype.kind is TypeKind.BYTES:
@@ -445,6 +484,7 @@ class HashAggregationOperator(Operator):
             "overflow": jnp.zeros((), jnp.bool_),
         }
         for name, e in self.group_keys:
+            state["keyv$" + name] = jnp.zeros(g, jnp.bool_)
             if e.dtype.kind is TypeKind.BYTES:
                 for j in range(self._key_chunks(e)):
                     state[f"key${name}${j}"] = jnp.zeros(g, jnp.int64)
@@ -487,6 +527,11 @@ class HashAggregationOperator(Operator):
         st = self.state
         if isinstance(self.strategy, SortStrategy) and bool(st["overflow"]):
             raise CapacityOverflow("HashAggregation", self.strategy.max_groups)
+        if isinstance(self.strategy, DirectStrategy) and bool(st["null_key"]):
+            raise NullGroupKeys(
+                "direct-addressed grouping met NULL key values "
+                f"({[n for n, _ in self.group_keys]}) — replan with the "
+                "sort strategy")
         if isinstance(self.strategy, DirectStrategy) and bool(st["value_overflow"]):
             raise ValueBitsOverflow(
                 "a declared AggSpec.value_bits bound was exceeded at "
@@ -520,7 +565,7 @@ class HashAggregationOperator(Operator):
                 else:
                     data = st["key$" + name]
                 cols[name] = Column(
-                    data, jnp.ones(g, jnp.bool_), e.dtype, self._dicts.get(name)
+                    data, st["keyv$" + name], e.dtype, self._dicts.get(name)
                 )
             for name, e in self.passengers:
                 cols[name] = Column(

@@ -60,8 +60,39 @@ class ScanSource:
         )
 
     def __iter__(self) -> Iterator[Batch]:
-        for split in self.splits:
-            yield self.connector.scan(split, self.columns, self.capacity)
+        def load(split):
+            return self.connector.scan(split, self.columns, self.capacity)
+
+        return prefetch_iter(load, self.splits)
+
+
+def prefetch_enabled() -> bool:
+    import os
+
+    return os.environ.get("PRESTO_TPU_PREFETCH", "1").strip().lower() \
+        not in ("0", "false", "off", "no")
+
+
+def prefetch_iter(load, items):
+    """One-slot prefetch (SURVEY §2.4 PP row, §7.1 double-buffered H2D):
+    item k+1 loads (generate + transfer) on a worker thread while the
+    consumer holds item k — XLA dispatches are async, so the consumer
+    returns to this loop immediately and host-side generation overlaps
+    device compute. Exactly one item is in flight (bounded host
+    memory). ``PRESTO_TPU_PREFETCH=0`` reverts to a serial loop."""
+    if len(items) <= 1 or not prefetch_enabled():
+        for it in items:
+            yield load(it)
+        return
+    from concurrent.futures import ThreadPoolExecutor
+
+    with ThreadPoolExecutor(max_workers=1) as ex:
+        fut = ex.submit(load, items[0])
+        for nxt in items[1:]:
+            out = fut.result()
+            fut = ex.submit(load, nxt)
+            yield out
+        yield fut.result()
 
 
 class BatchSource:

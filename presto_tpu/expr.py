@@ -35,6 +35,7 @@ from presto_tpu.types import (
     DATE,
     DOUBLE,
     INTEGER,
+    TIMESTAMP,
     DataType,
     TypeKind,
     common_super_type,
@@ -257,6 +258,10 @@ def _to_physical(v: Val, target: DataType):
             f = np.int64(10 ** (src.scale - target.scale))
             return _round_half_away(data.astype(jnp.int64), f)
         return data.astype(jnp.int64) * np.int64(10**target.scale)
+    if target.kind is TypeKind.TIMESTAMP:
+        if src.kind is TypeKind.DATE:
+            return data.astype(jnp.int64) * np.int64(86_400_000_000)
+        return data.astype(jnp.int64)
     if target.kind in (TypeKind.BIGINT, TypeKind.INTEGER, TypeKind.DATE):
         return data.astype(target.jnp_dtype)
     if target.kind is TypeKind.BOOLEAN:
@@ -677,21 +682,90 @@ def civil_from_days(days):
     return y, m, d
 
 
+_MICROS_PER_DAY = np.int64(86_400_000_000)
+
+
+def _days_of(v: Val):
+    """Days-since-epoch view of a DATE or TIMESTAMP Val (micros floor
+    to days, correct for pre-epoch instants)."""
+    if v.dtype.kind is TypeKind.TIMESTAMP:
+        return (v.data.astype(jnp.int64) // _MICROS_PER_DAY).astype(jnp.int32)
+    return v.data
+
+
+def _time_of_day_us(v: Val):
+    return v.data.astype(jnp.int64) % _MICROS_PER_DAY
+
+
 @register("year", _t_int)
 def _year(args, out):
-    y, _, _ = civil_from_days(args[0].data)
+    y, _, _ = civil_from_days(_days_of(args[0]))
     return y, None
+
+
+@register("hour", _t_int)
+def _hour(args, out):
+    return (_time_of_day_us(args[0]) // 3_600_000_000).astype(jnp.int32), None
+
+
+@register("minute", _t_int)
+def _minute(args, out):
+    return ((_time_of_day_us(args[0]) // 60_000_000) % 60).astype(jnp.int32), None
+
+
+@register("second", _t_int)
+def _second(args, out):
+    return ((_time_of_day_us(args[0]) // 1_000_000) % 60).astype(jnp.int32), None
+
+
+@register("cast_timestamp", lambda args: TIMESTAMP)
+def _cast_timestamp(args, out):
+    return _to_physical(args[0], out), None
+
+
+def parse_timestamp_fn() -> str:
+    """cast(varchar AS timestamp) over a dictionary column (host parse;
+    ISO 'YYYY-MM-DD[ HH:MM:SS[.ffffff]]')."""
+    name = "parse_timestamp"
+    if name not in _REGISTRY:
+
+        def rule(args):
+            return TIMESTAMP
+
+        @register(name, rule)
+        def impl(args, out):
+            a = args[0]
+            if a.dictionary is None:
+                raise NotImplementedError(
+                    "cast to timestamp on dictionary-less VARCHAR")
+            bad_v = -(2**63)
+
+            def f(v):
+                try:
+                    return int((np.datetime64(v.strip().replace(" ", "T"), "us")
+                                - np.datetime64("1970-01-01T00:00:00", "us"))
+                               .astype(np.int64))
+                except ValueError:
+                    return bad_v
+
+            t = _dict_int_table(a.dictionary, "parse_timestamp", f,
+                                dtype=np.int64)
+            d = _gather_dict(a, t)
+            bad = d == bad_v
+            return jnp.where(bad, 0, d), ~bad & a.valid
+
+    return name
 
 
 @register("month", _t_int)
 def _month(args, out):
-    _, m, _ = civil_from_days(args[0].data)
+    _, m, _ = civil_from_days(_days_of(args[0]))
     return m, None
 
 
 @register("day", _t_int)
 def _day(args, out):
-    _, _, d = civil_from_days(args[0].data)
+    _, _, d = civil_from_days(_days_of(args[0]))
     return d, None
 
 
@@ -911,13 +985,14 @@ def _least(args, out):
 # ---- string breadth -------------------------------------------------------
 
 
-def _dict_int_table(dictionary: Dictionary, key, fn) -> np.ndarray:
-    """Host int32 table over a dictionary's values, cached per (key)."""
+def _dict_int_table(dictionary: Dictionary, key, fn,
+                    dtype=np.int32) -> np.ndarray:
+    """Host integer table over a dictionary's values, cached per (key)."""
     cache = dictionary._bytes_mats
     k = ("int_table", key)
     if k not in cache:
         cache[k] = np.fromiter(
-            (fn(v) for v in dictionary.values), dtype=np.int32,
+            (fn(v) for v in dictionary.values), dtype=dtype,
             count=len(dictionary),
         )
     return cache[k]
@@ -1121,47 +1196,63 @@ def days_from_civil(y, m, d):
 
 @register("quarter", _t_int)
 def _quarter(args, out):
-    _, m, _ = civil_from_days(args[0].data)
+    _, m, _ = civil_from_days(_days_of(args[0]))
     return (m + 2) // 3, None
 
 
 @register("day_of_week", _t_int)
 def _day_of_week(args, out):
     """ISO: Monday=1 .. Sunday=7 (1970-01-01 was a Thursday)."""
-    d = args[0].data.astype(jnp.int32)
+    d = _days_of(args[0]).astype(jnp.int32)
     return (d + 3) % 7 + 1, None
 
 
 @register("day_of_year", _t_int)
 def _day_of_year(args, out):
-    y, _, _ = civil_from_days(args[0].data)
+    d = _days_of(args[0])
+    y, _, _ = civil_from_days(d)
     jan1 = days_from_civil(y, jnp.ones_like(y), jnp.ones_like(y))
-    return (args[0].data.astype(jnp.int32) - jan1 + 1).astype(jnp.int32), None
+    return (d.astype(jnp.int32) - jan1 + 1).astype(jnp.int32), None
 
 
 def date_trunc_fn(unit: str) -> str:
     name = f"date_trunc_{unit}"
     if name not in _REGISTRY:
-        if unit not in ("day", "week", "month", "quarter", "year"):
+        if unit not in ("second", "minute", "hour", "day", "week", "month",
+                       "quarter", "year"):
             raise NotImplementedError(f"date_trunc unit {unit!r}")
 
         def rule(args):
-            return DATE
+            return args[0]  # DATE stays DATE, TIMESTAMP stays TIMESTAMP
 
         @register(name, rule)
         def impl(args, out, _u=unit):
-            d = args[0].data.astype(jnp.int32)
+            is_ts = args[0].dtype.kind is TypeKind.TIMESTAMP
+            if _u in ("hour", "minute", "second"):
+                if not is_ts:  # sub-day truncation of a DATE: identity
+                    return args[0].data, None
+                us = _time_of_day_us(args[0])
+                per = {"hour": 3_600_000_000, "minute": 60_000_000,
+                       "second": 1_000_000}[_u]
+                return args[0].data - us % per, None
+            d = _days_of(args[0]).astype(jnp.int32)
             if _u == "day":
-                return d, None
-            if _u == "week":  # ISO week starts Monday
-                return d - (d + 3) % 7, None
-            y, m, _day = civil_from_days(d)
-            if _u == "month":
-                return days_from_civil(y, m, jnp.ones_like(y)), None
-            if _u == "quarter":
-                qm = ((m - 1) // 3) * 3 + 1
-                return days_from_civil(y, qm, jnp.ones_like(y)), None
-            return days_from_civil(y, jnp.ones_like(y), jnp.ones_like(y)), None
+                days = d
+            elif _u == "week":  # ISO week starts Monday
+                days = d - (d + 3) % 7
+            else:
+                y, m, _day = civil_from_days(d)
+                if _u == "month":
+                    days = days_from_civil(y, m, jnp.ones_like(y))
+                elif _u == "quarter":
+                    qm = ((m - 1) // 3) * 3 + 1
+                    days = days_from_civil(y, qm, jnp.ones_like(y))
+                else:
+                    days = days_from_civil(y, jnp.ones_like(y),
+                                           jnp.ones_like(y))
+            if is_ts:
+                return days.astype(jnp.int64) * _MICROS_PER_DAY, None
+            return days, None
 
     return name
 
@@ -1296,6 +1387,28 @@ def cast_varchar_fn(width: int) -> str:
                 if a.dictionary is None:
                     raise NotImplementedError("cast on dictionary-less VARCHAR")
                 return _gather_dict(a, a.dictionary.bytes_matrix(_w)), None
+            if k is TypeKind.TIMESTAMP:
+                days = (a.data.astype(jnp.int64) // _MICROS_PER_DAY)
+                us = a.data.astype(jnp.int64) % _MICROS_PER_DAY
+                y, m, d = civil_from_days(days.astype(jnp.int32))
+                hh = us // 3_600_000_000
+                mi = (us // 60_000_000) % 60
+                ss = (us // 1_000_000) % 60
+                dash = jnp.full_like(y, 45)
+                colon = jnp.full_like(y, 58)
+                space = jnp.full_like(y, 32)
+                cols = [48 + (y // 1000) % 10, 48 + (y // 100) % 10,
+                        48 + (y // 10) % 10, 48 + y % 10, dash,
+                        48 + m // 10, 48 + m % 10, dash,
+                        48 + d // 10, 48 + d % 10, space,
+                        48 + hh // 10, 48 + hh % 10, colon,
+                        48 + mi // 10, 48 + mi % 10, colon,
+                        48 + ss // 10, 48 + ss % 10]
+                txt = jnp.stack(cols, axis=1).astype(jnp.uint8)
+                if _w <= 19:
+                    return txt[:, :_w], None
+                pad = jnp.zeros((txt.shape[0], _w - 19), jnp.uint8)
+                return jnp.concatenate([txt, pad], axis=1), None
             if k is TypeKind.DATE:
                 y, m, d = civil_from_days(a.data)
                 dash = jnp.full_like(y, 45)  # '-'

@@ -105,7 +105,8 @@ def _kernel(nlanes_list, max_groups, spm, nval, nmask, *refs):
         o_ref[...] = o_ref[...] + vec
 
 
-def fused_lane_sums(values, bits_list, count_masks, gids, max_groups: int):
+def fused_lane_sums(values, bits_list, count_masks, gids, max_groups: int,
+                    block_rows: int | None = None):
     """Exact per-group integer sums + mask counts in one device pass.
 
     values: list of int32 [cap] arrays, dead rows ZEROED by the caller.
@@ -118,7 +119,7 @@ def fused_lane_sums(values, bits_list, count_masks, gids, max_groups: int):
     mask; overflow True when a declared bound was violated.
     """
     cap = gids.shape[0]
-    B = _block_rows(cap)
+    B = block_rows if block_rows is not None else _block_rows(cap)
     nlanes_list = [(_nlanes(b), min(b, 31)) for b in bits_list]
     nl_total = sum(n for n, _ in nlanes_list)
     num_slots = max_groups * (nl_total + len(count_masks)) + 1
@@ -185,14 +186,17 @@ def probe_supported(bits_list, nmasks: int, max_groups: int, cap: int) -> bool:
                 # probe with the SAME block size the real call will use
                 # (VMEM pressure scales with the block; a 2^16 probe
                 # proving a 2^18-block program would be vacuous) and two
-                # blocks so the accumulate branch compiles too
-                c = 2 * _block_rows(cap)
+                # blocks so the accumulate branch compiles too — the
+                # block is pinned explicitly, since _block_rows(2B)
+                # would otherwise pick a LARGER block for small B
+                B = _block_rows(cap)
+                c = 2 * B
                 vals = [jnp.ones(c, jnp.int32) for _ in bits_list]
                 masks = [jnp.ones(c, jnp.bool_) for _ in range(nmasks)]
                 g = jnp.zeros(c, jnp.int32)
                 jax.block_until_ready(
                     fused_lane_sums(vals, list(bits_list), masks, g,
-                                    max_groups))
+                                    max_groups, block_rows=B))
                 _PROBE_CACHE[key] = True
             except Exception as e:  # noqa: BLE001 — fallback must be visible
                 import logging

@@ -863,12 +863,14 @@ def _multi_order_unreturned(t, fact, prefix, returns, rprefix, extra):
     j = j.merge(t["customer_address"], left_on=f"{prefix}_ship_addr_sk",
                 right_on="ca_address_sk")
     j = extra(j)
-    # EXISTS: another order from the same warehouse
-    wh_orders = f.groupby(f"{prefix}_warehouse_sk")[
-        f"{prefix}_order_number"
-    ].nunique().rename("n_orders").reset_index()
-    j = j.merge(wh_orders, on=f"{prefix}_warehouse_sk")
-    j = j[j.n_orders > 1]
+    # EXISTS (official): the same order shipped from ANOTHER warehouse —
+    # the order has >=2 distinct non-null warehouses and this row's
+    # warehouse is non-null
+    n_wh = f.groupby(f"{prefix}_order_number")[
+        f"{prefix}_warehouse_sk"
+    ].nunique().rename("n_wh").reset_index()
+    j = j.merge(n_wh, on=f"{prefix}_order_number")
+    j = j[(j.n_wh > 1) & j[f"{prefix}_warehouse_sk"].notna()]
     # NOT EXISTS: order never returned
     returned = set(t[returns][f"{rprefix}_order_number"].dropna())
     j = j[~j[f"{prefix}_order_number"].isin(returned)]
@@ -890,7 +892,7 @@ def q16(t):
 def q94(t):
     def extra(j):
         w = t["web_site"]
-        w = w[w.web_company_name == "pri"]
+        w = w[w.web_company_name.str.strip() == "able"]
         return j.merge(w, left_on="ws_web_site_sk", right_on="web_site_sk")
 
     return _multi_order_unreturned(
@@ -970,26 +972,31 @@ def q71(t):
 
 def q76(t):
     parts = []
-    for ch, fact, prefix in ((1, "store_sales", "ss"), (2, "web_sales", "ws"),
-                             (3, "catalog_sales", "cs")):
+    for ch, colname, nullcol, fact, prefix in (
+            ("store", "ss_store_sk", "ss_store_sk", "store_sales", "ss"),
+            ("web", "ws_ship_customer_sk", "ws_ship_customer_sk",
+             "web_sales", "ws"),
+            ("catalog", "cs_ship_addr_sk", "cs_ship_addr_sk",
+             "catalog_sales", "cs")):
         f = t[fact]
-        f = f[f[f"{prefix}_promo_sk"].isna()]
+        f = f[f[nullcol].isna()]
         f = f.merge(t["date_dim"], left_on=f"{prefix}_sold_date_sk",
                     right_on="d_date_sk")
         f = f.merge(t["item"], left_on=f"{prefix}_item_sk",
                     right_on="i_item_sk")
         parts.append(pd.DataFrame({
-            "channel": ch, "d_year": f.d_year, "d_qoy": f.d_qoy,
-            "i_category": f.i_category,
+            "channel": ch, "col_name": colname, "d_year": f.d_year,
+            "d_qoy": f.d_qoy, "i_category": f.i_category,
             "ext_sales_price": f[f"{prefix}_ext_sales_price"],
         }))
     u = pd.concat(parts, ignore_index=True)
-    g = u.groupby(["channel", "d_year", "d_qoy", "i_category"],
+    g = u.groupby(["channel", "col_name", "d_year", "d_qoy", "i_category"],
                   as_index=False).agg(
         sales_cnt=("ext_sales_price", "size"),
         sales_amt=("ext_sales_price", "sum"),
     )
-    return _srt(g, ["channel", "d_year", "d_qoy", "i_category"]).head(100)
+    return _srt(g, ["channel", "col_name", "d_year", "d_qoy",
+                    "i_category"]).head(100)
 
 
 def q22(t):
@@ -999,8 +1006,8 @@ def q22(t):
     j = j[j.d_month_seq.between(1200, 1211)]
     # NULL-able int decodes as an object column; numeric mean needs float
     j = j.assign(inv_quantity_on_hand=pd.to_numeric(j.inv_quantity_on_hand))
-    levels = [["i_brand", "i_class", "i_category"], ["i_brand", "i_class"],
-              ["i_brand"], []]
+    rollup_cols = ["i_product_name", "i_brand", "i_class", "i_category"]
+    levels = [rollup_cols[:k] for k in range(len(rollup_cols), -1, -1)]
     parts = []
     for lv in levels:
         if lv:
@@ -1009,13 +1016,13 @@ def q22(t):
             )
         else:
             g = pd.DataFrame({"qoh": [j.inv_quantity_on_hand.mean()]})
-        for c in ["i_brand", "i_class", "i_category"]:
+        for c in rollup_cols:
             if c not in g:
                 g[c] = None
-        parts.append(g[["i_brand", "i_class", "i_category", "qoh"]])
+        parts.append(g[rollup_cols + ["qoh"]])
     u = pd.concat(parts, ignore_index=True)
     u = u.sort_values(
-        ["qoh", "i_brand", "i_class", "i_category"],
+        ["qoh"] + rollup_cols,
         na_position="last", kind="stable",
     ).reset_index(drop=True)
     return u.head(100)
@@ -2541,12 +2548,8 @@ def q49(t):
 
 def q95(t):
     ws = t["web_sales"]
-    pairs = ws[["ws_order_number", "ws_bill_customer_sk",
-                "ws_warehouse_sk"]].merge(
-        ws[["ws_bill_customer_sk", "ws_warehouse_sk"]],
-        on="ws_bill_customer_sk", suffixes=("1", "2"))
-    multi_wh = set(pairs[pairs.ws_warehouse_sk1
-                         != pairs.ws_warehouse_sk2].ws_order_number)
+    n_wh = ws.groupby("ws_order_number")["ws_warehouse_sk"].nunique()
+    multi_wh = set(n_wh[n_wh > 1].index)
     d = t["date_dim"]
     dd = d[(d.d_date >= D("2000-02-01"))
            & (d.d_date <= D("2000-02-01") + np.timedelta64(60, "D"))][

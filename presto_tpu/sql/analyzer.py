@@ -2048,6 +2048,10 @@ class Analyzer:
                 (np.datetime64(n.value, "D") - np.datetime64("1970-01-01", "D")).astype(int)
             )
             return Literal(DATE, days)
+        if isinstance(n, A.TimestampLit):
+            from presto_tpu.types import TIMESTAMP
+
+            return Literal(TIMESTAMP, TIMESTAMP.to_physical(n.value))
         if isinstance(n, A.BinaryOp):
             if n.op in ("and", "or"):
                 l = self._expr(n.left, scope, outer, ctes, scalar_binds, agg_map, key_map)
@@ -2131,7 +2135,8 @@ class Analyzer:
                      "day_of_week": "day_of_week",
                      "day_of_year": "day_of_year"}.get(n.field, n.field)
             if field not in ("year", "month", "day", "quarter",
-                             "day_of_week", "day_of_year"):
+                             "day_of_week", "day_of_year",
+                             "hour", "minute", "second"):
                 raise AnalysisError(f"EXTRACT({n.field}) unsupported")
             return Call(INTEGER, field, (v,))
         if isinstance(n, A.Substring):
@@ -2236,6 +2241,7 @@ class Analyzer:
 
         _ARITY = {"quarter": 1, "day_of_week": 1, "dow": 1,
                   "day_of_year": 1, "doy": 1, "last_day_of_month": 1,
+                  "hour": 1, "minute": 1, "second": 1,
                   "date_trunc": 2, "date_add": 3, "date_diff": 3,
                   "length": 1, "char_length": 1, "character_length": 1,
                   "trim": 1, "ltrim": 1, "rtrim": 1, "reverse": 1,
@@ -2273,13 +2279,16 @@ class Analyzer:
             return -v if neg else v
 
         name = n.name
+        if name in ("hour", "minute", "second"):
+            return Call(INTEGER, name, (sub(0),))
         if name in ("quarter", "day_of_week", "dow", "day_of_year", "doy"):
             canon = {"dow": "day_of_week", "doy": "day_of_year"}.get(name, name)
             return Call(INTEGER, canon, (sub(0),))
         if name == "last_day_of_month":
             return Call(DATE, "last_day_of_month", (sub(0),))
         if name == "date_trunc":
-            return Call(DATE, date_trunc_fn(str_lit(0, "unit")), (sub(1),))
+            v = sub(1)
+            return Call(v.dtype, date_trunc_fn(str_lit(0, "unit")), (v,))
         if name == "date_add":
             return Call(DATE, date_add_fn(str_lit(0, "unit")),
                         (sub(1), sub(2)))
@@ -2416,12 +2425,29 @@ class Analyzer:
                 w = v.dtype.width
             else:
                 w = {TypeKind.INTEGER: 11, TypeKind.BIGINT: 20,
-                     TypeKind.DATE: 10}.get(v.dtype.kind)
+                     TypeKind.DATE: 10, TypeKind.TIMESTAMP: 19}.get(
+                         v.dtype.kind)
                 if w is None and v.dtype.kind is TypeKind.DECIMAL:
                     w = v.dtype.precision + 2
                 if w is None:
                     raise AnalysisError(f"cast {v.dtype} to varchar unsupported")
             return Call(fixed_bytes(w), cast_varchar_fn(w), (v,))
+        if type_name == "timestamp":
+            from presto_tpu.types import TIMESTAMP
+
+            from presto_tpu.expr import Literal as _Lit
+
+            if isinstance(v, _Lit) and isinstance(v.value, str):
+                return _Lit(TIMESTAMP, v.value)
+            if v.dtype.kind is TypeKind.TIMESTAMP:
+                return v
+            if v.dtype.kind is TypeKind.DATE:
+                return Call(TIMESTAMP, "cast_timestamp", (v,))
+            if v.dtype.kind is TypeKind.VARCHAR:
+                from presto_tpu.expr import parse_timestamp_fn
+
+                return Call(TIMESTAMP, parse_timestamp_fn(), (v,))
+            raise AnalysisError(f"cast {v.dtype} to timestamp unsupported")
         if type_name == "date":
             from presto_tpu.expr import Literal as _Lit
             from presto_tpu.expr import parse_date_fn

@@ -46,6 +46,10 @@ class StringLit(Node):
 class DateLit(Node):
     value: str  # 'YYYY-MM-DD'
 
+@dataclass(frozen=True)
+class TimestampLit(Node):
+    value: str  # 'YYYY-MM-DD HH:MM:SS[.ffffff]'
+
 
 @dataclass(frozen=True)
 class IntervalLit(Node):

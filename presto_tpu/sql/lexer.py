@@ -24,7 +24,7 @@ KEYWORDS = {
     "select", "from", "where", "group", "by", "having", "order", "limit",
     "as", "and", "or", "not", "in", "exists", "between", "like", "is",
     "null", "case", "when", "then", "else", "end", "cast", "extract",
-    "date", "interval", "year", "month", "day", "distinct", "join",
+    "date", "timestamp", "interval", "year", "month", "day", "distinct", "join",
     "inner", "left", "right", "full", "outer", "cross", "on", "with",
     "asc", "desc", "nulls", "first", "last", "substring", "union", "all",
     "true", "false", "count", "sum", "avg", "min", "max", "any", "some",

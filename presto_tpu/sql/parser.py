@@ -530,6 +530,12 @@ class Parser:
             if s.kind != "STRING":
                 raise ParseError("expected date string", s)
             return A.DateLit(s.text)
+        if self.kw("timestamp"):
+            self.eat()
+            s = self.eat()
+            if s.kind != "STRING":
+                raise ParseError("expected timestamp string", s)
+            return A.TimestampLit(s.text)
         if self.kw("interval"):
             self.eat()
             s = self.eat()

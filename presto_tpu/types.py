@@ -17,12 +17,35 @@ BIGINT         int64  (XLA:TPU emulates s64; hot paths downcast when safe)
 DOUBLE         float32 (TPU-native; exactness lives in DECIMAL, not FP)
 DECIMAL(p,s)   int64 scaled by 10**s — exact arithmetic, exact sums
 DATE           int32 days since 1970-01-01
+TIMESTAMP      int64 microseconds since 1970-01-01 00:00:00 UTC
 VARCHAR        int32 codes into an *ordered* host-side dictionary, so
                code comparison == lexicographic comparison (analog of
                the reference's DictionaryBlock, made order-preserving)
 BYTES(w)       uint8[cap, w] fixed-width padded bytes — the raw-string
                representation for Pallas LIKE/substr kernels
 =============  =========================================================
+
+Deliberate cut — nested types (ARRAY/MAP/ROW) and UNNEST
+--------------------------------------------------------
+The reference's block model carries ArrayBlock/MapBlock/RowBlock and an
+UnnestOperator [SURVEY §2.1]. None of the three target workloads
+(TPC-H, TPC-DS, SSB) uses them, so this build cuts them rather than
+shipping untested surface. The TPU-first design, should a connector
+need them, is pinned down so the data model does not dead-end:
+
+- ``ARRAY(T, max_len)``: SoA ``[cap, max_len]`` element tensor in T's
+  physical dtype plus an int32 lengths vector (same pattern as BYTES'
+  fixed width; stats pick max_len like they pick join-key bounds).
+  Variable lengths beyond max_len overflow-flag and re-plan, exactly
+  like capacity buckets (SURVEY §7.4 #1).
+- ``MAP(K, V)``: two parallel ARRAY columns (sorted keys) — lookups are
+  per-row vectorized binary probes on the key tensor.
+- ``ROW(...)``: flattens into one physical column per field at scan
+  time (a struct is just columns; only the analyzer sees the nesting).
+- ``UNNEST``: row expansion with a static output capacity — the same
+  expand-kernel shape as the duplicate-capable join probe
+  (``ops.join.probe_expand``): output row i maps to (source_row,
+  element_index) via cumsum of lengths, one gather per output column.
 """
 
 from __future__ import annotations
@@ -41,6 +64,7 @@ class TypeKind(enum.Enum):
     DOUBLE = "double"
     DECIMAL = "decimal"
     DATE = "date"
+    TIMESTAMP = "timestamp"  # int64 microseconds since the epoch
     VARCHAR = "varchar"  # ordered-dictionary-encoded string
     BYTES = "bytes"  # fixed-width raw bytes
 
@@ -93,6 +117,12 @@ class DataType:
                     np.int32
                 )
             return int(value)
+        if self.kind is TypeKind.TIMESTAMP:
+            if isinstance(value, str):
+                return int((np.datetime64(value.strip(), "us")
+                            - np.datetime64("1970-01-01T00:00:00", "us"))
+                           .astype(np.int64))
+            return int(value)
         if self.kind is TypeKind.BOOLEAN:
             return bool(value)
         if self.kind in (TypeKind.INTEGER, TypeKind.BIGINT):
@@ -111,6 +141,9 @@ class DataType:
             return float(value)
         if self.kind is TypeKind.DATE:
             return str(np.datetime64("1970-01-01", "D") + np.int64(value))
+        if self.kind is TypeKind.TIMESTAMP:
+            return str(np.datetime64("1970-01-01T00:00:00", "us")
+                       + np.timedelta64(int(value), "us"))
         return int(value)
 
     def null_value(self):
@@ -136,6 +169,7 @@ _PHYSICAL = {
     TypeKind.DOUBLE: np.float32,
     TypeKind.DECIMAL: np.int64,
     TypeKind.DATE: np.int32,
+    TypeKind.TIMESTAMP: np.int64,  # microseconds since epoch
     TypeKind.VARCHAR: np.int32,  # dictionary codes
     TypeKind.BYTES: np.uint8,
 }
@@ -145,6 +179,7 @@ INTEGER = DataType(TypeKind.INTEGER)
 BIGINT = DataType(TypeKind.BIGINT)
 DOUBLE = DataType(TypeKind.DOUBLE)
 DATE = DataType(TypeKind.DATE)
+TIMESTAMP = DataType(TypeKind.TIMESTAMP)
 
 
 def decimal(precision: int, scale: int) -> DataType:
@@ -182,6 +217,9 @@ def common_super_type(a: DataType, b: DataType) -> DataType:
         return hi
     if a.kind is TypeKind.DATE and b.kind is TypeKind.DATE:
         return a
+    # DATE widens to TIMESTAMP (midnight) when compared/combined
+    if {a.kind, b.kind} == {TypeKind.DATE, TypeKind.TIMESTAMP}:
+        return a if a.kind is TypeKind.TIMESTAMP else b
     # a string literal (VARCHAR) coerces to the peer fixed-width BYTES
     # type (coalesce(bytes_col, '') — the literal is space-padded)
     if a.kind is TypeKind.BYTES and b.kind is TypeKind.VARCHAR:

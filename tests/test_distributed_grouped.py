@@ -133,3 +133,45 @@ def test_grouped_row_level_full_outer(conn, local):
         got.sort_values(key).reset_index(drop=True),
         check_dtype=False,
     )
+
+
+def test_distributed_null_group_keys_replan():
+    """Grouping on a nullable key must produce a NULL group (its own
+    key value) identically on the local and distributed tiers — the
+    direct strategy has no NULL slot and must replan onto sort."""
+    from presto_tpu.connectors.tpcds import TpcdsConnector
+
+    c = TpcdsConnector(sf=0.002)
+    q = ("select ss_store_sk, count(*) as c from store_sales "
+         "group by ss_store_sk order by ss_store_sk nulls last")
+    a = Session({"tpcds": c}).sql(q)
+    b = Session({"tpcds": c}, mesh=make_mesh(4)).sql(q)
+    pd.testing.assert_frame_equal(
+        a.reset_index(drop=True), b.reset_index(drop=True),
+        check_dtype=False,
+    )
+    # the generator emits ~2% NULL store keys: the NULL group must exist
+    assert a["ss_store_sk"].isna().any()
+
+
+def test_null_varchar_key_direct_replan():
+    """A nullable dictionary-VARCHAR key with a small dense domain picks
+    the DIRECT strategy, whose packed gid has no NULL slot — the
+    NullGroupKeys replan must land on the sort strategy with NULL as its
+    own group, identically on both tiers."""
+    conn = TpchConnector(sf=0.002, units_per_split=1 << 12)
+    q_make = ("create table nk as select nullif(n_name, 'FRANCE') as k "
+              "from nation, region")
+    qq = "select k, count(*) as c from nk group by k order by k nulls last"
+    a_sess = Session({"tpch": conn})
+    a_sess.sql(q_make)
+    a = a_sess.sql(qq)
+    b_sess = Session({"tpch": conn}, mesh=make_mesh(4))
+    b_sess.sql(q_make)
+    b = b_sess.sql(qq)
+    pd.testing.assert_frame_equal(
+        a.reset_index(drop=True), b.reset_index(drop=True),
+        check_dtype=False,
+    )
+    assert a["k"].isna().any(), "NULL group must exist"
+    assert int(a[a["k"].isna()]["c"].iloc[0]) == 5  # FRANCE x 5 regions

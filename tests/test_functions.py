@@ -441,3 +441,63 @@ def test_sql_substr_negative(session):
     )
     assert list(out["tail"]) == ["NCE"]
 
+
+
+# ---------------------------------------------------------------------------
+# TIMESTAMP (int64 microseconds since epoch)
+# ---------------------------------------------------------------------------
+
+
+def ts_batch():
+    stamps = ["1995-03-15 13:45:30", "1970-01-01 00:00:00",
+              "2024-02-29 23:59:59", "1969-12-31 22:30:00"]
+    us = [int((np.datetime64(t.replace(" ", "T"), "us")
+               - np.datetime64("1970-01-01T00:00:00", "us")).astype(np.int64))
+          for t in stamps]
+    from presto_tpu.types import TIMESTAMP
+
+    return Batch.from_numpy({"t": np.array(us, np.int64)},
+                            {"t": TIMESTAMP}), stamps
+
+
+def test_timestamp_extract_parts():
+    from presto_tpu.types import TIMESTAMP
+
+    b, stamps = ts_batch()
+    t = col("t", TIMESTAMP)
+    want = [datetime.datetime.fromisoformat(s) for s in stamps]
+    for fn, pyf in [("year", lambda x: x.year), ("month", lambda x: x.month),
+                    ("day", lambda x: x.day), ("hour", lambda x: x.hour),
+                    ("minute", lambda x: x.minute),
+                    ("second", lambda x: x.second)]:
+        v = evaluate(Call(INTEGER, fn, (t,)), b)
+        np.testing.assert_array_equal(
+            np.asarray(v.data), [pyf(x) for x in want], err_msg=fn)
+
+
+def test_timestamp_trunc_and_cast():
+    from presto_tpu.expr import cast_varchar_fn, date_trunc_fn
+    from presto_tpu.types import TIMESTAMP
+
+    b, stamps = ts_batch()
+    t = col("t", TIMESTAMP)
+    v = evaluate(Call(TIMESTAMP, date_trunc_fn("hour"), (t,)), b)
+    want = [datetime.datetime.fromisoformat(s).replace(minute=0, second=0)
+            for s in stamps]
+    epoch = datetime.datetime(1970, 1, 1)
+    np.testing.assert_array_equal(
+        np.asarray(v.data),
+        [int((x - epoch).total_seconds() * 1_000_000) for x in want])
+    r = evaluate(Call(fixed_bytes(19), cast_varchar_fn(19), (t,)), b)
+    assert decode_bytes(r.data) == stamps
+
+
+def test_timestamp_sql_surface(session):
+    out = session.sql(
+        "select timestamp '1995-03-15 13:45:30' as t, "
+        "hour(timestamp '1995-03-15 13:45:30') as h, "
+        "cast(date '1995-03-15' as timestamp) as d2t, "
+        "date_trunc('minute', timestamp '1995-03-15 13:45:30') as tm"
+    )
+    assert out["h"][0] == 13
+    assert "1995-03-15" in str(out["t"][0])
