@@ -45,9 +45,16 @@ def test_ssb_like_queries_via_pallas(env, name, monkeypatch):
 
 
 def test_ssb_distributed(env):
+    """One query per SSB flight family over the 8-device mesh, plus a
+    4-device flight-3 run (mesh-shape metamorphic)."""
     from presto_tpu.parallel.mesh import make_mesh
 
     session, tables = env
     dist = Session({"ssb": session.catalog.connector("ssb")}, mesh=make_mesh(8))
-    for name in ["q1_1", "q2_1", "q4_2"]:
+    for name in ["q1_1", "q2_1", "q3_2", "q4_2"]:
         compare(dist.sql(QUERIES[name]), ORACLES[name](tables), f"dist_{name}")
+    dist4 = Session({"ssb": session.catalog.connector("ssb")},
+                    mesh=make_mesh(4))
+    for name in ["q3_1", "q4_1"]:
+        compare(dist4.sql(QUERIES[name]), ORACLES[name](tables),
+                f"dist4_{name}")

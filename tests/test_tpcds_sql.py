@@ -62,14 +62,18 @@ def test_tpcds_query_matches_oracle(env, name):
 
 
 @pytest.mark.parametrize(
-    "name", ["q3", "q7", "q98", "q33", "q36", "q38", "q97", "q10"]
+    "name", ["q3", "q7", "q98", "q33", "q36", "q38", "q97", "q10",
+             "q16", "q76", "q22", "q28", "q47", "q95"]
 )
 def test_tpcds_distributed_matches_oracle(env, name):
     """Star joins, NULL-key joins, window-over-aggregate (q98),
     three-channel UNION ALL (q33), ROLLUP + grouping() + rank (q36),
-    INTERSECT (q38), FULL OUTER JOIN (q97), and OR-of-EXISTS mark
-    joins (q10) through the real mesh exchanges
-    (DistributedQueryRunner analog)."""
+    INTERSECT (q38), FULL OUTER JOIN (q97), OR-of-EXISTS mark joins
+    (q10), correlated EXISTS/NOT-EXISTS on multi-line orders (q16),
+    string-literal group keys over UNION ALL (q76), 4-level rollup with
+    a wide free-text key (q22), scalar-subquery fan (q28), window
+    offsets over grouped series (q47), and the q95 double-EXISTS CTE —
+    through the real mesh exchanges (DistributedQueryRunner analog)."""
     from presto_tpu.parallel.mesh import make_mesh
 
     session, tables = env
