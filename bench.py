@@ -400,13 +400,18 @@ def bench_q3_join(li_batch, n_li, orders_batch, li_df, o_df, sf: float,
     cutoff = 9204  # date '1995-03-15' as days since epoch
     build_cap = orders_batch.capacity
     domain = int(6_000_000 * sf) + 1  # o_orderkey in [1, 6M*sf] (stats)
+    # packed (key << bits | row) build: key_bits + cap_bits <= 62 holds
+    # for every benchmark SF (o_orderkey < 6M*sf) -> the sorted probe
+    # needs ONE gather per row instead of two
+    pack_bits = int(build_cap).bit_length()
+    assert domain.bit_length() + pack_bits <= 62
 
     @jax.jit
     def build(ob):
         live = ob.live & (ob["o_orderdate"].data < cutoff)
         keys = ob["o_orderkey"].data
         return (
-            build_lookup(keys, live, build_cap),
+            build_lookup(keys, live, build_cap, pack_bits=pack_bits),
             build_dense(keys, live, 1, domain),
         )
 
@@ -428,7 +433,8 @@ def bench_q3_join(li_batch, n_li, orders_batch, li_df, o_df, sf: float,
     @jax.jit
     def probe_sorted_step(side, lb):
         live = lb.live & (lb["l_shipdate"].data > cutoff)
-        res = probe_unique(side, lb["l_orderkey"].data, live)
+        res = probe_unique(side, lb["l_orderkey"].data, live,
+                           pack_bits=pack_bits)
         return agg(res.matched, lb, live)
 
     out_cap = li_batch.capacity

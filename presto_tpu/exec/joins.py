@@ -123,16 +123,26 @@ class JoinBuildOperator(CollectingOperator):
         key: Expr,
         capacity: int | None = None,
         dense_domain: tuple[int, int] | None = None,
+        key_max: int | None = None,
     ):
         """``dense_domain``: optional (key_min, domain) from planner
         stats — builds a dense direct-address table alongside the sorted
         keys so unique/semi probes become a single gather (no probe
         sort). Stats are advisory: a key outside the domain at runtime
-        just discards the dense side and keeps the sorted fallback."""
+        just discards the dense side and keeps the sorted fallback.
+
+        ``key_max``: stats upper bound on a NON-NEGATIVE key — when
+        key_bits + capacity_bits <= 62, build rows sort as one packed
+        (key << bits | row) int64 and the sorted unique probe needs ONE
+        gather per row instead of two. Advisory like dense_domain: a
+        violating key trips ``sentinel_hit`` and the query refuses
+        loudly rather than mispacking."""
         super().__init__()
         self.key = key
         self.capacity = capacity
         self.dense_domain = dense_domain
+        self.key_max = key_max
+        self.pack_bits: int | None = None
         self.build_side: BuildSide | None = None
         self.dense_side: DenseSide | None = None
         self.payload: Batch | None = None
@@ -148,11 +158,16 @@ class JoinBuildOperator(CollectingOperator):
         cap = self.capacity or batch_capacity(batch.capacity, minimum=16)
         dd = self.dense_domain
 
+        if self.key_max is not None and self.key_max >= 0:
+            pb = int(batch.capacity).bit_length()
+            if int(self.key_max).bit_length() + pb <= 62:
+                self.pack_bits = pb
+
         @jax.jit
         def build(b: Batch):
             v = evaluate(self.key, b)
             live = b.live & v.valid
-            side = build_lookup(v.data, live, cap)
+            side = build_lookup(v.data, live, cap, pack_bits=self.pack_bits)
             dense = build_dense(v.data, live, dd[0], dd[1]) if dd else None
             # key-run length > VERIFY_CANDIDATES detector: hash-key
             # probes scan a fixed candidate window per probe row, so a
@@ -165,6 +180,12 @@ class JoinBuildOperator(CollectingOperator):
         if bool(side.overflow):
             raise CapacityOverflow("JoinBuild", cap, int(side.n_rows))
         if bool(side.sentinel_hit):
+            if self.pack_bits is not None:
+                raise NotImplementedError(
+                    "a join build key violated its advisory stats bound "
+                    f"(key_max={self.key_max}, pack_bits={self.pack_bits}: "
+                    f"packable range is [0, 2^{62 - self.pack_bits})) — "
+                    "stale or wrong connector stats")
             raise NotImplementedError(
                 "a join build key equals the reserved int64 sentinel "
                 f"({np.iinfo(np.int64).max}); such keys are "
@@ -239,8 +260,10 @@ class LookupJoinOperator(Operator):
         key = self.probe_key
         if not self.verify:
             v = evaluate(key, batch)
-            probe = probe_unique_dense if use_dense else probe_unique
-            return probe(side, v.data, batch.live & v.valid)
+            if use_dense:
+                return probe_unique_dense(side, v.data, batch.live & v.valid)
+            return probe_unique(side, v.data, batch.live & v.valid,
+                                pack_bits=self.build.pack_bits)
         assert not use_dense, "dense sides never carry hash verify keys"
         return verified_unique_probe(side, key, self.verify, payload, batch)
 

@@ -318,16 +318,30 @@ class LocalExecutor:
             runtime_minmax=runtime_minmax, runtime_dict=runtime_dict,
         )
 
-    def _dense_domain(self, node_right, right_keys, right_batches):
-        """(key_min, domain) when connector stats bound a single build
-        key tightly enough for a dense direct-address table — the
-        planner's stats-driven probe-kernel choice (one gather vs a
-        probe-side sort). None falls back to the sorted build."""
+    def _build_key_interval(self, node_right, right_keys):
+        """Stats (min, max) interval of a single build key, or None —
+        computed ONCE per join; the dense-domain and packed-build
+        decisions both derive from it."""
         if len(right_keys) != 1:
             return None
         from presto_tpu.plan.bounds import expr_interval, node_intervals
 
-        iv = expr_interval(right_keys[0], node_intervals(node_right, self.catalog))
+        return expr_interval(right_keys[0],
+                             node_intervals(node_right, self.catalog))
+
+    @staticmethod
+    def _key_upper_bound(iv):
+        """Packed-build bound: a non-negative stats max (None otherwise)."""
+        if iv is None or iv[0] < 0:
+            return None
+        return int(iv[1])
+
+    @staticmethod
+    def _dense_domain(iv, right_batches):
+        """(key_min, domain) when the stats interval is tight enough
+        for a dense direct-address table — the planner's stats-driven
+        probe-kernel choice (one gather vs a probe-side sort). None
+        falls back to the sorted build."""
         if iv is None:
             return None
         domain = iv[1] - iv[0] + 1
@@ -373,12 +387,13 @@ class LocalExecutor:
                 "wide string keys on non-unique OUTER joins (verification "
                 "cannot re-synthesize the null-extended row)"
             )
-        dense = (
-            self._dense_domain(node.right, node.right_keys, right)
-            if node.unique
-            else None
-        )
-        build = JoinBuildOperator(rkey, dense_domain=dense)
+        iv = (self._build_key_interval(node.right, node.right_keys)
+              if node.unique else None)
+        # dense/packed only help the UNIQUE probe; other probe kinds
+        # would pay the advisory-stats refusal for no benefit
+        build = JoinBuildOperator(
+            rkey, dense_domain=self._dense_domain(iv, right),
+            key_max=self._key_upper_bound(iv) if node.unique else None)
         Pipeline(BatchSource(right), [build]).run()
         outs = [BuildOutput(n, n) for n in node.output_right]
         if node.kind == "full":
@@ -593,8 +608,11 @@ class LocalExecutor:
             # existence probes have no build_row to verify against;
             # hash collisions could flip semi/anti membership
             raise NotImplementedError("wide string semi-join keys")
-        dense = self._dense_domain(node.right, node.right_keys, right)
-        build = JoinBuildOperator(rkey, dense_domain=dense)
+        # semi/anti existence probes use the dense table when stats
+        # allow; the packed build would be dead weight (probe_exists
+        # has no packed path)
+        iv = self._build_key_interval(node.right, node.right_keys)
+        build = JoinBuildOperator(rkey, dense_domain=self._dense_domain(iv, right))
         Pipeline(BatchSource(right), [build]).run()
         op = LookupJoinOperator(build, lkey, (), jt)
         return left.map(lambda b: op.process(b)[0])

@@ -43,16 +43,51 @@ class BuildSide(NamedTuple):
     #: refuses loudly instead (bytes_hash already avoids the sentinel
     #: by construction; this guards plain integer keys)
     sentinel_hit: jnp.ndarray
+    #: (key << pack_bits) | row packed int64, key-sorted, dead = I64_MAX
+    #: — present when the planner proved key_bits + pack_bits <= 62
+    #: (non-negative keys); the unique probe then needs ONE gather per
+    #: probe row instead of two (key check + row fetch). [SURVEY §6
+    #: BenchmarkHashBuildAndJoinOperators analog; VERDICT r4 ask #4]
+    packed: jnp.ndarray | None = None
 
 
 _I64_MAX = np.int64(np.iinfo(np.int64).max)
 
 
-def build_lookup(keys, live, build_capacity: int) -> BuildSide:
-    """Compact live rows and sort them by key."""
+def build_lookup(keys, live, build_capacity: int,
+                 pack_bits: int | None = None) -> BuildSide:
+    """Compact live rows and sort them by key.
+
+    ``pack_bits``: when the caller proves 0 <= key < 2^(62 - pack_bits)
+    and capacity <= 2^pack_bits, rows sort as ONE packed
+    (key << pack_bits | row) int64 — the sort needs no payload gathers
+    and the unique probe one gather total. Violating keys fall back
+    safely: they set ``sentinel_hit`` (checked by every builder host-
+    side) rather than mispacking.
+    """
     cap = keys.shape[0]
-    sentinel_hit = jnp.any(live & (keys.astype(jnp.int64) == _I64_MAX))
-    k = jnp.where(live, keys.astype(jnp.int64), _I64_MAX)
+    k0 = keys.astype(jnp.int64)
+    if pack_bits is not None:
+        bad = (k0 < 0) | (k0 >= (np.int64(1) << np.int64(62 - pack_bits)))
+        sentinel_hit = jnp.any(live & bad)
+        packed = jnp.where(
+            live & ~bad,
+            (k0 << np.int64(pack_bits)) | jnp.arange(cap, dtype=jnp.int64),
+            _I64_MAX,
+        )
+        sp = jnp.sort(packed)[:build_capacity]
+        if build_capacity > cap:
+            sp = jnp.concatenate(
+                [sp, jnp.full(build_capacity - cap, _I64_MAX)])
+        dead = sp == _I64_MAX
+        sorted_keys = jnp.where(dead, _I64_MAX, sp >> np.int64(pack_bits))
+        mask = (np.int64(1) << np.int64(pack_bits)) - np.int64(1)
+        row_idx = jnp.where(dead, cap, (sp & mask).astype(jnp.int32))
+        n_live = jnp.sum(live.astype(jnp.int32))
+        return BuildSide(sorted_keys, row_idx, n_live,
+                         n_live > build_capacity, sentinel_hit, sp)
+    sentinel_hit = jnp.any(live & (k0 == _I64_MAX))
+    k = jnp.where(live, k0, _I64_MAX)
     order = jnp.argsort(k, stable=True)
     sk = k[order]
     # take the first build_capacity sorted slots (live rows sort first,
@@ -71,15 +106,29 @@ class UniqueProbe(NamedTuple):
     matched: jnp.ndarray  # [probe_cap] bool
 
 
-def probe_unique(build: BuildSide, probe_keys, probe_live) -> UniqueProbe:
+def probe_unique(build: BuildSide, probe_keys, probe_live,
+                 pack_bits: int | None = None) -> UniqueProbe:
     """FK->PK probe: each probe row matches <= 1 build row.
 
     Output is aligned with the probe batch (no expansion): the join
     operator gathers build payload columns through ``build_row`` and
     ANDs ``matched`` into the live mask (inner) or into validity
-    (left outer).
+    (left outer). With a packed build (``pack_bits``), key check and
+    row fetch ride ONE latency-bound gather instead of two.
     """
     pk = probe_keys.astype(jnp.int64)
+    if pack_bits is not None and build.packed is not None:
+        target = pk << np.int64(pack_bits)
+        pos = jnp.searchsorted(build.packed, target, side="left",
+                               method="sort")
+        hit = gather_padded(build.packed, pos, _I64_MAX)
+        in_range = (pk >= 0) & (pk < (np.int64(1) << np.int64(62 - pack_bits)))
+        matched = ((hit >> np.int64(pack_bits)) == pk) & probe_live & (
+            hit != _I64_MAX) & in_range
+        mask = (np.int64(1) << np.int64(pack_bits)) - np.int64(1)
+        build_row = jnp.where(matched, (hit & mask).astype(jnp.int32),
+                              build.row_idx.shape[0])
+        return UniqueProbe(build_row, matched)
     pos = jnp.searchsorted(build.sorted_keys, pk, method="sort")
     hit_key = gather_padded(build.sorted_keys, pos, _I64_MAX)
     matched = (hit_key == pk) & probe_live & (pk != _I64_MAX)

@@ -198,9 +198,9 @@ def test_sql_join_uses_dense_when_stats_bound_the_key():
     built_domains = []
     orig = J.JoinBuildOperator.__init__
 
-    def spy(self, key, capacity=None, dense_domain=None):
+    def spy(self, key, capacity=None, dense_domain=None, **kw):
         built_domains.append(dense_domain)
-        orig(self, key, capacity, dense_domain)
+        orig(self, key, capacity, dense_domain, **kw)
 
     J.JoinBuildOperator.__init__ = spy
     try:
@@ -212,8 +212,8 @@ def test_sql_join_uses_dense_when_stats_bound_the_key():
     # same query with stats disabled -> sorted path; answers must agree
     import presto_tpu.exec.local_planner as LP
 
-    orig_dd = LP.LocalExecutor._dense_domain
-    LP.LocalExecutor._dense_domain = lambda self, *a: None
+    orig_dd = LP.LocalExecutor.__dict__["_dense_domain"]  # keep staticmethod
+    LP.LocalExecutor._dense_domain = staticmethod(lambda *a: None)
     try:
         want = Session({"tpch": TpchConnector(sf=0.01)}).sql(q)
     finally:
@@ -334,3 +334,77 @@ def test_full_outer_sql_vs_pandas_oracle():
     np.testing.assert_array_equal(
         got["r_regionkey"].isna().to_numpy(), want["r_regionkey"].isna().to_numpy()
     )
+
+
+def test_packed_build_matches_unpacked(rng):
+    """(key << bits | row) packed builds: one-gather probe must agree
+    with the two-gather sorted path bit-for-bit, including dead rows,
+    missing keys, and out-of-packable-range probe keys."""
+    import jax.numpy as jnp
+
+    from presto_tpu.ops.join import build_lookup, probe_unique
+
+    bcap, pcap = 512, 2048
+    bkeys = rng.choice(np.arange(0, 40_000), 400, replace=False)
+    bkeys = np.concatenate([bkeys, np.zeros(bcap - 400, np.int64)])
+    blive = np.arange(bcap) < 400
+    pkeys = rng.integers(-100, 50_000, pcap)
+    pkeys[:4] = [2**62, 2**62 - 1, -1, 0]  # unpackable / boundary probes
+    plive = rng.random(pcap) < 0.9
+
+    pb = int(bcap).bit_length()
+    packed = build_lookup(jnp.asarray(bkeys), jnp.asarray(blive), bcap,
+                          pack_bits=pb)
+    plain = build_lookup(jnp.asarray(bkeys), jnp.asarray(blive), bcap)
+    assert not bool(packed.sentinel_hit)
+    got = probe_unique(packed, jnp.asarray(pkeys), jnp.asarray(plive),
+                       pack_bits=pb)
+    want = probe_unique(plain, jnp.asarray(pkeys), jnp.asarray(plive))
+    np.testing.assert_array_equal(np.asarray(got.matched),
+                                  np.asarray(want.matched))
+    m = np.asarray(got.matched)
+    np.testing.assert_array_equal(np.asarray(got.build_row)[m],
+                                  np.asarray(want.build_row)[m])
+
+
+def test_packed_build_flags_oversized_keys():
+    import jax.numpy as jnp
+
+    from presto_tpu.ops.join import build_lookup
+
+    keys = jnp.asarray(np.array([1, 2, 2**61], np.int64))
+    live = jnp.asarray(np.ones(3, bool))
+    side = build_lookup(keys, live, 4, pack_bits=16)  # 2^61 needs >46 bits
+    assert bool(side.sentinel_hit)
+
+
+def test_sql_join_packed_path_fires_and_matches():
+    """An FK->PK join with stats-bounded keys must take the packed
+    build (pack_bits set) and produce identical results."""
+    from presto_tpu.connectors.tpch import TpchConnector
+    from presto_tpu.exec import joins as J
+    from presto_tpu.runtime.session import Session
+
+    q = ("select n_name, count(*) as n from customer, nation "
+         "where c_nationkey = n_nationkey group by n_name "
+         "order by n_name")
+    pack_seen = []
+    orig = J.JoinBuildOperator.finish
+
+    def spy(self):
+        out = orig(self)
+        pack_seen.append(self.pack_bits)
+        return out
+
+    J.JoinBuildOperator.finish = spy
+    try:
+        got = Session({"tpch": TpchConnector(sf=0.01)}).sql(q)
+    finally:
+        J.JoinBuildOperator.finish = orig
+    assert any(p is not None for p in pack_seen), "packed build never used"
+    conn = TpchConnector(sf=0.01)
+    c, n = conn.table_pandas("customer"), conn.table_pandas("nation")
+    want = (c.merge(n, left_on="c_nationkey", right_on="n_nationkey")
+            .groupby("n_name", as_index=False).size()
+            .rename(columns={"size": "n"}).sort_values("n_name"))
+    assert got["n"].tolist() == want["n"].tolist()
