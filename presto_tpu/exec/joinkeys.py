@@ -86,6 +86,8 @@ def join_key_exprs(
             verify.append((lk, rk))
         return Call(BIGINT, fn, (lk,)), Call(BIGINT, fn, (rk,))
 
+    unproven_varchar = [False]  # per-pair flags, filled by wrap below
+
     def wrap(lk: Expr, rk: Expr):
         if lk.dtype.kind is TypeKind.VARCHAR or rk.dtype.kind is TypeKind.VARCHAR:
             if lk.dtype.kind is not rk.dtype.kind:
@@ -107,12 +109,18 @@ def join_key_exprs(
             # unprovable at plan time: pass codes through — the join
             # operators hold a runtime same-dictionary guard that
             # raises instead of joining incomparable code spaces
+            unproven_varchar[-1] = True
             return lk, rk
         if lk.dtype.kind is TypeKind.BYTES:
             return as_bytes_pair(lk, rk)
         return lk, rk
 
-    pairs = [wrap(lk, rk) for lk, rk in zip(lkeys, rkeys)]
+    pairs = []
+    flags = []
+    for lk, rk in zip(lkeys, rkeys):
+        unproven_varchar[-1] = False
+        pairs.append(wrap(lk, rk))
+        flags.append(unproven_varchar[-1])
     lkeys = [p[0] for p in pairs]
     rkeys = [p[1] for p in pairs]
     if len(lkeys) == 1:
@@ -120,20 +128,59 @@ def join_key_exprs(
 
     lenv = node_intervals(lnode, catalog)
     renv = node_intervals(rnode, catalog)
-    widths = []
-    for lk, rk in zip(lkeys, rkeys):
-        mx = 0
-        for side, env, key in ((0, lenv, lk), (1, renv, rk)):
-            iv = expr_interval(key, env)
-            if iv is None:
-                iv = runtime_minmax(side, key)
-            mn, m = int(iv[0]), int(iv[1])
-            if mn < 0:
-                raise NotImplementedError("negative join keys")
-            mx = max(mx, m)
-        widths.append(max(1, int(mx).bit_length()))
-    if sum(widths) > 63:
-        raise NotImplementedError("packed join key exceeds 63 bits")
+
+    _minmax_cache: dict = {}
+
+    def cached_minmax(side, key):
+        # one device readback per (side, key) across the width ladder
+        k = (side, id(key))
+        if k not in _minmax_cache:
+            _minmax_cache[k] = runtime_minmax(side, key)
+        return _minmax_cache[k]
+
+    def key_widths(use_stats: bool):
+        """Per-key pack widths, or None when exact packing is
+        impossible at this rung (negative keys pack wrongly; the mix
+        fallback handles them via its 63-bit mask)."""
+        widths = []
+        for lk, rk in zip(lkeys, rkeys):
+            mx = 0
+            for side, env, key in ((0, lenv, lk), (1, renv, rk)):
+                iv = expr_interval(key, env) if use_stats else None
+                if iv is None:
+                    iv = cached_minmax(side, key)
+                mn, m = int(iv[0]), int(iv[1])
+                if mn < 0:
+                    return None
+                mx = max(mx, m)
+            widths.append(max(1, int(mx).bit_length()))
+        return widths
+
+    widths = key_widths(use_stats=True)
+    if widths is None or sum(widths) > 63:
+        # stats intervals can be loose (derived-column joins, deep
+        # subtrees): retry with tight runtime minima/maxima — a device
+        # readback per key, paid only in this rare case — before
+        # falling back further
+        widths = key_widths(use_stats=False)
+    if widths is None or sum(widths) > 63:
+        # exact packing impossible (e.g. a component is itself a 63-bit
+        # string hash — q64's item x store-name x customer join):
+        # combine as ONE 63-bit FNV mix and verify candidates on the
+        # key pairs (the hash+verify contract wide string keys already
+        # use). Wide-BYTES components are already verified on their
+        # original bytes (as_bytes_pair) — re-verifying their hashes
+        # would be redundant work per probe batch.
+        if any(flags):
+            raise NotImplementedError(
+                "multi-key hash fallback over a dictionary VARCHAR key "
+                "with unprovable dictionary provenance: codes are not "
+                "comparable across dictionaries")
+        verify.extend(
+            (lk, rk) for lk, rk in zip(lkeys, rkeys)
+            if not (isinstance(lk, Call) and lk.fn == "bytes_hash"))
+        return (Call(BIGINT, "hash63_mix", tuple(lkeys)),
+                Call(BIGINT, "hash63_mix", tuple(rkeys)), verify)
 
     def pack(keys):
         e = Call(BIGINT, "cast_bigint", (keys[0],))

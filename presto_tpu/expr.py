@@ -404,21 +404,40 @@ def _bytes_pack(args, out):
     return h, None
 
 
-@register("bytes_hash", lambda args: BIGINT)
-def _bytes_hash(args, out):
-    """BYTES(w>7) -> 63-bit polynomial hash (FNV prime, int64 wrap).
-    NOT injective: callers must verify candidate matches on the
-    original bytes (LookupJoinOperator ``verify`` pairs). Hashes over
-    space-normalized padding (PAD SPACE, like _bytes_pack) and never
-    yields the int64-max lookup sentinel (a hash landing there would
-    silently drop the row from the sorted lookup source)."""
-    d = _pad_space(args[0].data).astype(jnp.int64)
-    h = jnp.zeros(d.shape[0], jnp.int64)
-    for i in range(d.shape[1]):
-        h = h * jnp.int64(1099511628211) + d[:, i]
+def _fnv63_fold(columns):
+    """Order-sensitive FNV fold of int64 column vectors into [0, 2^63),
+    never yielding the int64-max lookup sentinel (a hash landing there
+    would silently drop the row from the sorted lookup source). The ONE
+    definition of the join-hash contract — bytes_hash and hash63_mix
+    must agree on mask and sentinel scheme."""
+    h = columns[0].astype(jnp.int64)
+    for c in columns[1:]:
+        h = h * jnp.int64(1099511628211) + c.astype(jnp.int64)
     h = h & jnp.int64((1 << 63) - 1)
     sentinel = jnp.int64(np.iinfo(np.int64).max)
-    return jnp.where(h == sentinel, 0, h), None
+    return jnp.where(h == sentinel, 0, h)
+
+
+@register("bytes_hash", lambda args: BIGINT)
+def _bytes_hash(args, out):
+    """BYTES(w>7) -> 63-bit polynomial hash (FNV fold). NOT injective:
+    callers must verify candidate matches on the original bytes
+    (LookupJoinOperator ``verify`` pairs). Hashes over space-normalized
+    padding (PAD SPACE, like _bytes_pack)."""
+    d = _pad_space(args[0].data).astype(jnp.int64)
+    cols = [jnp.zeros(d.shape[0], jnp.int64)] + [
+        d[:, i] for i in range(d.shape[1])]
+    return _fnv63_fold(cols), None
+
+
+@register("hash63_mix", lambda args: BIGINT)
+def _hash63_mix(args, out):
+    """Order-sensitive 63-bit FNV mix of N integer key columns — the
+    multi-key join fallback when bit-packed widths exceed 63 (e.g. a
+    string-hash component is itself 63 bits). NOT injective: callers
+    must verify candidates on the original key pairs. Handles negative
+    components (the mask maps any int64 into [0, 2^63))."""
+    return _fnv63_fold([a.data for a in args]), None
 
 
 # ---- comparisons ----------------------------------------------------------

@@ -1155,7 +1155,11 @@ def _returners_above_state_avg(t, returns, cust_col, addr_col, amt_col):
     ctr = ctr[ctr.d_year == 2000]
     ctr = ctr.merge(t["customer_address"], left_on=addr_col,
                     right_on="ca_address_sk")
-    g = ctr.groupby([cust_col, "ca_state"], as_index=False).agg(
+    # dropna=False: SQL keeps the NULL-customer group (the generator
+    # makes wr_returning_customer_sk ~2% NULL), and the per-state
+    # average in the subquery includes it
+    g = ctr.groupby([cust_col, "ca_state"], as_index=False,
+                    dropna=False).agg(
         ctr_total_return=(amt_col, "sum")
     )
     ave = g.groupby("ca_state")["ctr_total_return"].mean().rename(
