@@ -133,15 +133,21 @@ def _watchdog() -> None:
         done = _EMITTED
     if not done:
         try:
-            RESULT.setdefault(
-                "error",
+            note = (
                 f"watchdog: budget {BUDGET:.0f}s exhausted at phase "
-                f"{_PHASES[-1] if _PHASES else '<start>'}",
+                f"{_PHASES[-1] if _PHASES else '<start>'}"
             )
+            if RESULT.get("value"):
+                # the validated primary already landed — only an extra
+                # overran (e.g. a slow probe compile). That is a
+                # successful bench; record the cut in extra, exit 0.
+                RESULT.setdefault("extra", {})["note"] = note
+            else:
+                RESULT.setdefault("error", note)
             RESULT["phases"] = _PHASES[-8:]
             _emit()
         finally:
-            os._exit(3)
+            os._exit(0 if RESULT.get("value") else 3)
 
 
 def _ever_captured() -> bool:
@@ -694,6 +700,31 @@ def main() -> None:
 
 
 def _run(sf: float, stream_mode: bool) -> None:
+    # Host-side generation is pure numpy and independent of the device:
+    # it runs in a worker thread DURING backend acquisition + attach
+    # (the cold attach alone measured ~90 s of the 150 s budget in
+    # round 5 — serializing generation behind it forced an SF drop).
+    gen: dict = {}
+
+    def _generate():
+        try:
+            from presto_tpu.connectors.tpch import TpchConnector
+            from presto_tpu.workloads import Q1_COLS
+
+            conn = TpchConnector(sf=sf, units_per_split=1 << 26)
+            li_cols = list(Q1_COLS) + ["l_orderkey"]  # + the Q3 probe key
+            gen["conn"] = conn
+            gen["li_arrays"] = conn.table_numpy("lineitem", li_cols)
+            gen["li_df"] = conn.table_pandas("lineitem",
+                                             arrays=gen["li_arrays"])
+        except BaseException as e:  # noqa: BLE001 — re-raised in main
+            gen["error"] = e
+
+    gen_thread = None
+    if not stream_mode:
+        gen_thread = threading.Thread(target=_generate, daemon=True)
+        gen_thread.start()
+
     _phase("acquiring backend")
     _acquire_backend()
 
@@ -711,17 +742,6 @@ def _run(sf: float, stream_mode: bool) -> None:
     _ = int(jax.device_put(jax.numpy.arange(4), dev).sum())
     _phase("backend attached; sync mode forced")
 
-    if not stream_mode and sf > 0.1 and _remaining() < 90:
-        # late acquisition (empty-scoreboard full-budget probing): a
-        # small-SF validated Q1 beats another value-0 record; the
-        # metric name carries the actual SF so the scoreboard is honest
-        sf = 0.1
-        RESULT["metric"] = f"tpch_q1_rows_per_sec_per_chip_sf{sf:g}"
-        RESULT.setdefault("extra", {})["note"] = (
-            "sf reduced to 0.1: backend acquired late in the budget"
-        )
-        _phase("late acquisition: dropping to sf0.1")
-
     if stream_mode:
         # config-2 capability mode: unbounded-SF streaming Q1 (one chip,
         # bounded memory)
@@ -730,26 +750,49 @@ def _run(sf: float, stream_mode: bool) -> None:
         RESULT["vs_baseline"] = round(rows / BASELINE_ROWS_PER_SEC, 3)
         return
 
-    from presto_tpu.connectors.tpch import TpchConnector
+    # ---- join the generation thread (usually already done: SF1 takes
+    # ~45 s against the ~90 s attach) --------------------------------
+    _phase("joining generation thread")
+    gen_thread.join()
+    if "error" in gen:
+        raise gen["error"]
+    conn = gen["conn"]
+    li_arrays = gen["li_arrays"]
+    li_df = gen["li_df"]
+    n_li = len(li_arrays["l_orderkey"])
 
-    conn = TpchConnector(sf=sf, units_per_split=1 << 26)
+    # ---- primary: device-resident 10x Q1, narrow storage ---------------
+    # The resident tiled batch amortizes the ~15 ms per-dispatch tunnel
+    # round trip that caps ANY single-dispatch SF1 number at ~4e8 rows/s
+    # regardless of kernel speed (notes/PERF.md §2); the per-chip kernel
+    # rate is the honest engine metric — a real deployment keeps data
+    # device-resident. Exact validation against factor x the independent
+    # numpy recomputation happens inside bench_q1_resident BEFORE the
+    # value is recorded. The single-dispatch number stays in extras.
+    # late-attach fallbacks: a smaller tile factor cuts the tiled-batch
+    # transfer so a validated (if less amortized) number still lands;
+    # below ~25 s even a 2x SF1 transfer overruns, so salvage by
+    # regenerating at sf0.1 (~5 s) — a small validated value beats an
+    # error record (the metric name carries the actual SF)
+    if _remaining() < 25 and sf > 0.1:
+        _phase("late attach: regenerating at sf0.1")
+        sf = 0.1
+        from presto_tpu.connectors.tpch import TpchConnector
+        from presto_tpu.workloads import Q1_COLS
 
-    # ---- generate each table ONCE; share arrays with the oracle --------
-    from presto_tpu.workloads import Q1_COLS
-
-    li_cols = list(Q1_COLS) + ["l_orderkey"]  # Q1 cols + the Q3 probe key
-    _phase("generating lineitem")
-    li_arrays = conn.table_numpy("lineitem", li_cols)
-    _phase("decoding oracle frame")
-    li_df = conn.table_pandas("lineitem", arrays=li_arrays)
-
-    _phase("transferring lineitem")
-    li_batch, n_li = put_table("lineitem", li_arrays, dev)
-    _phase("Q1 compile+time+validate")
-    q1_rows = bench_q1(li_batch, n_li, li_df)
-    _phase("Q1 done")
-    RESULT["value"] = round(q1_rows)
-    RESULT["vs_baseline"] = round(q1_rows / BASELINE_ROWS_PER_SEC, 3)
+        conn = TpchConnector(sf=sf, units_per_split=1 << 26)
+        li_arrays = conn.table_numpy("lineitem", list(Q1_COLS) + ["l_orderkey"])
+        li_df = conn.table_pandas("lineitem", arrays=li_arrays)
+        n_li = len(li_arrays["l_orderkey"])
+    factor = 10 if _remaining() > 45 else (4 if _remaining() > 25 else 2)
+    _phase(f"primary: resident {factor}x Q1 (narrow + canonical)")
+    wide_r, narrow_r = bench_q1_resident(li_arrays, n_li, dev, factor=factor)
+    base = f"tpch_q1_rows_per_sec_per_chip_sf{sf:g}x{factor}_resident"
+    RESULT["metric"] = base + "_narrow"
+    RESULT["value"] = round(narrow_r)
+    RESULT["vs_baseline"] = round(narrow_r / BASELINE_ROWS_PER_SEC, 3)
+    RESULT.setdefault("extra", {})[base] = round(wide_r)
+    _phase("primary done")
 
     # ---- extras: only while budget remains; SIGALRM backstop -----------
     def _on_alarm(signum, frame):
@@ -768,22 +811,14 @@ def _run(sf: float, stream_mode: bool) -> None:
             try:
                 # extras in value order, each a separate alarm scope so a
                 # slow one can't starve the rest of the record:
-                # 1) the dispatch-floor-amortized per-chip Q1 (the
-                #    headline device-resident number), 2) the Q3 dense
-                #    probe, 3) the alternative probe kernels, 4) shuffle.
-                if _remaining() > 45:
-                    # device-resident 10x batch (tiled SF1, ~60M rows):
-                    # the dispatch-floor-amortized per-chip numbers,
-                    # canonical + narrow storage from ONE transfer
-                    _phase("extras: resident 10x Q1 (canonical + narrow)")
-                    wide_r, narrow_r = bench_q1_resident(li_arrays, n_li, dev)
-                    base = f"tpch_q1_rows_per_sec_per_chip_sf{sf:g}x10_resident"
-                    extra[base] = round(wide_r)
-                    extra[base + "_narrow"] = round(narrow_r)
+                # 1) the Q3 dense probe, 2) the alternative probe
+                # kernels, 3) single-dispatch Q1, 4) shuffle.
+                li_batch = None
                 if _remaining() > 45:
                     # orders generation/decode is extras-only work: it
                     # stays inside the guard so it can never starve Q1
-                    _phase("extras: orders generate/transfer")
+                    _phase("extras: canonical lineitem + orders transfer")
+                    li_batch, _ = put_table("lineitem", li_arrays, dev)
                     o_arrays = conn.table_numpy(
                         "orders", ["o_orderkey", "o_orderdate"]
                     )
@@ -793,6 +828,13 @@ def _run(sf: float, stream_mode: bool) -> None:
                     bench_q3_join(
                         li_batch, n_li, orders_batch, li_df, o_df, sf, extra
                     )
+                if li_batch is not None and _remaining() > 30:
+                    # the one-dispatch whole-SF Q1 (tunnel-floor bound;
+                    # the round-1..4 headline, kept for continuity)
+                    _phase("extras: single-dispatch Q1")
+                    q1_rows = bench_q1(li_batch, n_li, li_df)
+                    extra[f"tpch_q1_rows_per_sec_per_chip_sf{sf:g}"] = (
+                        round(q1_rows))
                 if len(devices) > 1:
                     if _remaining() > 20:
                         extra["ici_shuffle_gbps"] = round(bench_shuffle(devices), 2)

@@ -30,12 +30,13 @@ from presto_tpu.connectors.tpch import TpchConnector  # noqa: E402
 from presto_tpu.workloads import Q1_COLS  # noqa: E402
 
 TILE = int(sys.argv[1]) if len(sys.argv) > 1 else 10
+LOGB = int(sys.argv[2]) if len(sys.argv) > 2 else 16
 G = 6
 NAMES = ("sum_qty", "sum_base_price", "sum_disc_price", "sum_charge")
 NLANES = [2, 3, 4, 4]  # 13/24/31/31 bits in unsigned 8-bit lanes
 NL = sum(NLANES)  # 13 value lanes
-B = 1 << 18
-SPM = 32  # blocks per major: 32 * 2^18 = 2^23 rows
+B = 1 << LOGB  # 2^18 VMEM-OOMs: 13 int32 lane arrays/block > 16M scoped
+SPM = (1 << 23) // B  # blocks per major: 2^23 rows
 CUTOFF = 10471
 
 dev = jax.devices()[0]
@@ -72,7 +73,7 @@ def kernel(ship_ref, rf_ref, ls_ref, qty_ref, ep_ref, disc_ref, tax_ref,
     live = (live_ref[...] != 0) & (ship_ref[...].astype(jnp.int32) <= CUTOFF)
     gid = jnp.where(
         live, rf_ref[...].astype(jnp.int32) * 2 + ls_ref[...].astype(jnp.int32),
-        G,
+        np.int32(G),
     )
     qty = qty_ref[...].astype(jnp.int32)
     ep = ep_ref[...].astype(jnp.int32)
@@ -89,23 +90,32 @@ def kernel(ship_ref, rf_ref, ls_ref, qty_ref, ep_ref, disc_ref, tax_ref,
         for k in range(nl):
             lanes.append((v >> (8 * k)) & 255)
 
+    # per-axis keepdims sums with pinned int32: scalar-output integer
+    # reductions + weak-int literals both break Mosaic under x64
+    zero = np.int32(0)
+
+    def rsum(x):
+        s = jnp.sum(x, axis=2, dtype=jnp.int32, keepdims=True)
+        return jnp.sum(s, axis=1, dtype=jnp.int32, keepdims=True)
+
     scalars = []
     for g in range(G):
         m = gid == g
         for lane in lanes:
-            scalars.append(jnp.sum(jnp.where(m, lane, 0)))
-        scalars.append(jnp.sum(m.astype(jnp.int32)))
+            scalars.append(rsum(jnp.where(m, lane, zero)))
+        scalars.append(rsum(m.astype(jnp.int32)))
     # overflow guard: any live value beyond its declared lanes
-    ov = jnp.sum(jnp.where(live, (qty >> 16) | (ep >> 24), 0))
+    ov = rsum(jnp.where(live, (qty >> 16) | (ep >> 24), zero))
     scalars.append(ov)
-    vec = jnp.stack(scalars)  # [G*(NL+1) + 1]
-    vec = jnp.pad(vec, (0, 1024 - vec.shape[0])).reshape(1, 8, 128)
+    vec = jnp.concatenate(scalars, axis=2)  # [1,1,G*(NL+1) + 1]
+    vec = jnp.pad(vec, ((0, 0), (0, 0), (0, 1024 - vec.shape[2])),
+                  constant_values=zero)
 
-    @pl.when(i % SPM == 0)
+    @pl.when(i % np.int32(SPM) == 0)
     def _init():
         o_ref[...] = vec
 
-    @pl.when(i % SPM != 0)
+    @pl.when(i % np.int32(SPM) != 0)
     def _acc():
         o_ref[...] = o_ref[...] + vec
 
@@ -120,12 +130,15 @@ def q1_pallas(b):
     out = pl.pallas_call(
         kernel,
         grid=(nblk,),
-        in_specs=[pl.BlockSpec((1, 8, B // 8), lambda i: (i, 0, 0))
-                  for _ in args],
-        out_specs=pl.BlockSpec((1, 8, 128), lambda i: (i // SPM, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((nmajor, 8, 128), jnp.int32),
+        in_specs=[pl.BlockSpec(
+            (1, 8, B // 8),
+            lambda i: (i, np.int32(0), np.int32(0))) for _ in args],
+        out_specs=pl.BlockSpec(
+            (1, 1, 1024),
+            lambda i: (i // np.int32(SPM), np.int32(0), np.int32(0))),
+        out_shape=jax.ShapeDtypeStruct((nmajor, 1, 1024), jnp.int32),
     )(*args)
-    o = out.astype(jnp.int64).sum(axis=0).reshape(1024)  # [1024]
+    o = out.astype(jnp.int64).sum(axis=(0, 1)).reshape(1024)  # [1024]
     per_g = o[: G * (NL + 1)].reshape(G, NL + 1)  # [G, lanes+count]
     res = {}
     idx = 0

@@ -86,9 +86,8 @@ def join_key_exprs(
             verify.append((lk, rk))
         return Call(BIGINT, fn, (lk,)), Call(BIGINT, fn, (rk,))
 
-    unproven_varchar = [False]  # per-pair flags, filled by wrap below
-
     def wrap(lk: Expr, rk: Expr):
+        """-> (lkey, rkey, unproven_varchar_flag) for one key pair."""
         if lk.dtype.kind is TypeKind.VARCHAR or rk.dtype.kind is TypeKind.VARCHAR:
             if lk.dtype.kind is not rk.dtype.kind:
                 raise NotImplementedError(
@@ -98,29 +97,28 @@ def join_key_exprs(
             dl = dict_of(lnode, 0, lk)
             dr = dict_of(rnode, 1, rk)
             if dl is not None and dl is dr:
-                return lk, rk  # one shared dictionary: codes are exact
+                return lk, rk, False  # one shared dictionary: codes exact
             if dl is not None and dr is not None:
                 # different dictionaries: compare by VALUE, not code
                 w = max(dl.max_bytes, dr.max_bytes, 1)
                 t = fixed_bytes(w)
-                return as_bytes_pair(
+                return (*as_bytes_pair(
                     Call(t, "dict_bytes", (lk,)), Call(t, "dict_bytes", (rk,))
-                )
+                ), False)
             # unprovable at plan time: pass codes through — the join
             # operators hold a runtime same-dictionary guard that
             # raises instead of joining incomparable code spaces
-            unproven_varchar[-1] = True
-            return lk, rk
+            return lk, rk, True
         if lk.dtype.kind is TypeKind.BYTES:
-            return as_bytes_pair(lk, rk)
-        return lk, rk
+            return (*as_bytes_pair(lk, rk), False)
+        return lk, rk, False
 
     pairs = []
     flags = []
     for lk, rk in zip(lkeys, rkeys):
-        unproven_varchar[-1] = False
-        pairs.append(wrap(lk, rk))
-        flags.append(unproven_varchar[-1])
+        lk2, rk2, unproven = wrap(lk, rk)
+        pairs.append((lk2, rk2))
+        flags.append(unproven)
     lkeys = [p[0] for p in pairs]
     rkeys = [p[1] for p in pairs]
     if len(lkeys) == 1:
@@ -144,6 +142,14 @@ def join_key_exprs(
         fallback handles them via its 63-bit mask)."""
         widths = []
         for lk, rk in zip(lkeys, rkeys):
+            if any(isinstance(k, Call) and k.fn == "bytes_hash"
+                   for k in (lk, rk)):
+                # a 63-bit hash fills the whole pack budget statically:
+                # no runtime minmax readback can narrow it, and with
+                # any second key the ladder must end in the mix
+                # fallback anyway
+                widths.append(63)
+                continue
             mx = 0
             for side, env, key in ((0, lenv, lk), (1, renv, rk)):
                 iv = expr_interval(key, env) if use_stats else None

@@ -33,6 +33,7 @@ from jax.experimental import pallas as pl
 LANE_BITS = 8
 _MAJOR_ROWS = 1 << 23  # 255 * 2^23 < 2^31: int32-exact per major
 _SLOTS = 1024  # [8, 128] int32 output tile per major
+_I0 = np.int32(0)  # int32 index-map constant (x64: bare 0 would be i64)
 
 
 def _interpret() -> bool:
@@ -43,29 +44,103 @@ def _nlanes(bits: int) -> int:
     return max(1, -(-min(bits, 31) // LANE_BITS))
 
 
-def _block_rows(cap: int) -> int | None:
+_VMEM_BUDGET = 14 << 20  # scoped VMEM is 16M; leave headroom
+
+
+def _vmem_row_bytes(nl_total: int, nval: int, nmask: int) -> int:
+    """Per-row scoped-VMEM estimate: double-buffered input blocks plus
+    the int32 lane/mask intermediates the kernel materializes (measured
+    on v5e: a 13-lane block came to ~88 B/row; a 2^18 block OOM'd the
+    16M scoped limit)."""
+    in_bytes = 4 * nval + nmask + 4  # int32 values, int8 masks, gid
+    return 2 * in_bytes + 4 * (nl_total + nmask) + 8
+
+
+def _block_rows(cap: int, nl_total: int = 13, nval: int = 4,
+                nmask: int = 1) -> int | None:
+    per_row = _vmem_row_bytes(nl_total, nval, nmask)
     for b in (1 << 18, 1 << 17, 1 << 16):
-        if cap % b == 0:
+        if cap % b == 0 and b * per_row <= _VMEM_BUDGET:
             return b
     return None
 
 
-def supported(bits_list, num_slots: int, cap: int) -> bool:
+def supported(bits_list, num_slots: int, cap: int,
+              nval: int = 4, nmask: int = 1) -> bool:
     """Static eligibility for the fused kernel."""
+    nl_total = sum(_nlanes(b) for b in bits_list)
     return (
         all(b <= 31 for b in bits_list)
         and num_slots <= _SLOTS
-        and _block_rows(cap) is not None
+        and _block_rows(cap, nl_total, nval, nmask) is not None
     )
 
 
-def _kernel(nlanes_list, max_groups, spm, nval, nmask, *refs):
+# ---------------------------------------------------------------------------
+# Shared Mosaic/x64 scaffolding, used by this kernel and ops.pallas_q1.
+# Each workaround here was found on the live chip: weak Python-int
+# literals trace as i64 scalars whose rank-0 converts infinitely
+# recurse Mosaic's _convert_helper; jnp.sum to a scalar re-enters
+# jnp.sum without the dtype pin and promotes int32 -> int64; index
+# maps returning bare 0 emit i64 func.returns Mosaic rejects.
+# ---------------------------------------------------------------------------
+
+
+def rsum32(x):
+    """Full reduction of a (1, 8, B//8) block to (1, 1, 1) int32 via
+    per-axis keepdims sums — never a rank-0 reduce primitive."""
+    s = jnp.sum(x, axis=2, dtype=jnp.int32, keepdims=True)
+    return jnp.sum(s, axis=1, dtype=jnp.int32, keepdims=True)
+
+
+def emit_slots(o_ref, i, spm, scalars):
+    """Write the per-block (1,1,1) partials into the (1, 1, _SLOTS)
+    output tile: initialize on the first block of each output major,
+    accumulate otherwise."""
+    zero = _I0
+    vec = jnp.concatenate(scalars, axis=2)
+    vec = jnp.pad(vec, ((0, 0), (0, 0), (0, _SLOTS - vec.shape[2])),
+                  constant_values=zero)
+    spm = np.int32(spm)
+
+    @pl.when(i % spm == 0)
+    def _init():
+        o_ref[...] = vec
+
+    @pl.when(i % spm != 0)
+    def _acc():
+        o_ref[...] = o_ref[...] + vec
+
+
+def slots_pallas_call(kernel, args, cap, B, interpret=None):
+    """Run ``kernel`` on a (nblk,) grid over 1-D [cap] arrays reshaped
+    to (1, 8, B//8) blocks, accumulating (1, 1, _SLOTS) int32 tiles per
+    <= 2^23-row major; returns the int64 [_SLOTS] recombined totals."""
+    nblk = cap // B
+    spm = max(1, _MAJOR_ROWS // B)
+    nmajor = -(-nblk // spm)
+    args3d = [a.reshape(nblk, 8, B // 8) for a in args]
+    out = pl.pallas_call(
+        partial(kernel, spm),
+        grid=(nblk,),
+        in_specs=[pl.BlockSpec((1, 8, B // 8), lambda i: (i, _I0, _I0))
+                  for _ in args3d],
+        out_specs=pl.BlockSpec(
+            (1, 1, _SLOTS), lambda i: (i // np.int32(spm), _I0, _I0)),
+        out_shape=jax.ShapeDtypeStruct((nmajor, 1, _SLOTS), jnp.int32),
+        interpret=_interpret() if interpret is None else interpret,
+    )(*args3d)
+    return out.astype(jnp.int64).sum(axis=(0, 1)).reshape(_SLOTS)
+
+
+def _kernel(nlanes_list, max_groups, nval, nmask, spm, *refs):
     """Grid body: refs = [v_0..v_{nval-1}, m_0..m_{nmask-1}, gids, out].
 
     Values are int32 (dead rows already zeroed by the caller), masks
     int8, gids int32 with >= max_groups meaning "no group" (trash).
     """
     i = pl.program_id(0)
+    zero = _I0
     vals = [r[...] for r in refs[:nval]]
     masks = [r[...].astype(jnp.int32) for r in refs[nval:nval + nmask]]
     gid = refs[nval + nmask][...]
@@ -79,7 +154,7 @@ def _kernel(nlanes_list, max_groups, spm, nval, nmask, *refs):
         if bits < 31:
             # count violating rows (NOT sum of excess bits — that sum
             # could itself overflow int32 across a block)
-            viol = jnp.sum(((mag >> bits) != 0).astype(jnp.int32))
+            viol = rsum32(((mag >> bits) != 0).astype(jnp.int32))
             oflow = viol if oflow is None else oflow + viol
         for k in range(nl):
             lane = (mag >> (LANE_BITS * k)) & 255
@@ -87,22 +162,14 @@ def _kernel(nlanes_list, max_groups, spm, nval, nmask, *refs):
 
     scalars = []
     for g in range(max_groups):
-        m = gid == g
+        m = gid == np.int32(g)
         for lane in lanes:
-            scalars.append(jnp.sum(jnp.where(m, lane, 0)))
+            scalars.append(rsum32(jnp.where(m, lane, zero)))
         for mk in masks:
-            scalars.append(jnp.sum(jnp.where(m, mk, 0)))
-    scalars.append(oflow if oflow is not None else jnp.zeros((), jnp.int32))
-    vec = jnp.stack(scalars)
-    vec = jnp.pad(vec, (0, _SLOTS - vec.shape[0])).reshape(1, 8, 128)
-
-    @pl.when(i % spm == 0)
-    def _init():
-        o_ref[...] = vec
-
-    @pl.when(i % spm != 0)
-    def _acc():
-        o_ref[...] = o_ref[...] + vec
+            scalars.append(rsum32(jnp.where(m, mk, zero)))
+    scalars.append(oflow if oflow is not None
+                   else jnp.zeros((1, 1, 1), jnp.int32))
+    emit_slots(o_ref, i, spm, scalars)
 
 
 def fused_lane_sums(values, bits_list, count_masks, gids, max_groups: int,
@@ -119,33 +186,20 @@ def fused_lane_sums(values, bits_list, count_masks, gids, max_groups: int,
     mask; overflow True when a declared bound was violated.
     """
     cap = gids.shape[0]
-    B = block_rows if block_rows is not None else _block_rows(cap)
     nlanes_list = [(_nlanes(b), min(b, 31)) for b in bits_list]
     nl_total = sum(n for n, _ in nlanes_list)
-    num_slots = max_groups * (nl_total + len(count_masks)) + 1
-    if not supported(bits_list, num_slots, cap):
+    nval, nmask = len(values), len(count_masks)
+    B = (block_rows if block_rows is not None
+         else _block_rows(cap, nl_total, nval, nmask))
+    num_slots = max_groups * (nl_total + nmask) + 1
+    if not supported(bits_list, num_slots, cap, nval, nmask):
         raise ValueError("fused_lane_sums: ineligible shapes/bounds")
-    nblk = cap // B
-    spm = max(1, _MAJOR_ROWS // B)
-    nmajor = -(-nblk // spm)
-
-    def shape3(a, dt):
-        return a.astype(dt).reshape(nblk, 8, B // 8)
-
-    args = ([shape3(v, jnp.int32) for v in values]
-            + [shape3(m, jnp.int8) for m in count_masks]
-            + [shape3(jnp.minimum(gids, max_groups), jnp.int32)])
-    out = pl.pallas_call(
-        partial(_kernel, nlanes_list, max_groups, spm, len(values),
-                len(count_masks)),
-        grid=(nblk,),
-        in_specs=[pl.BlockSpec((1, 8, B // 8), lambda i: (i, 0, 0))
-                  for _ in args],
-        out_specs=pl.BlockSpec((1, 8, 128), lambda i: (i // spm, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((nmajor, 8, 128), jnp.int32),
-        interpret=_interpret(),
-    )(*args)
-    o = out.astype(jnp.int64).sum(axis=0).reshape(_SLOTS)
+    args = ([v.astype(jnp.int32) for v in values]
+            + [m.astype(jnp.int8) for m in count_masks]
+            + [jnp.minimum(gids, max_groups).astype(jnp.int32)])
+    o = slots_pallas_call(
+        partial(_kernel, nlanes_list, max_groups, nval, nmask),
+        args, cap, B)
 
     per_g = o[: max_groups * (nl_total + len(count_masks))].reshape(
         max_groups, nl_total + len(count_masks))
@@ -174,10 +228,13 @@ _PROBE_CACHE: dict = {}
 
 def probe_supported(bits_list, nmasks: int, max_groups: int, cap: int) -> bool:
     nlanes_list = tuple((_nlanes(b), min(b, 31)) for b in bits_list)
-    num_slots = max_groups * (sum(n for n, _ in nlanes_list) + nmasks) + 1
-    if not supported(bits_list, num_slots, cap):
+    nl_total = sum(n for n, _ in nlanes_list)
+    nval = len(bits_list)
+    num_slots = max_groups * (nl_total + nmasks) + 1
+    if not supported(bits_list, num_slots, cap, nval, nmasks):
         return False
-    key = (nlanes_list, nmasks, max_groups, _block_rows(cap))
+    B = _block_rows(cap, nl_total, nval, nmasks)
+    key = (nlanes_list, nmasks, max_groups, B)
     if key not in _PROBE_CACHE:
         if _interpret():
             _PROBE_CACHE[key] = True
@@ -189,7 +246,6 @@ def probe_supported(bits_list, nmasks: int, max_groups: int, cap: int) -> bool:
                 # blocks so the accumulate branch compiles too — the
                 # block is pinned explicitly, since _block_rows(2B)
                 # would otherwise pick a LARGER block for small B
-                B = _block_rows(cap)
                 c = 2 * B
                 vals = [jnp.ones(c, jnp.int32) for _ in bits_list]
                 masks = [jnp.ones(c, jnp.bool_) for _ in range(nmasks)]

@@ -107,6 +107,18 @@ def q1_fused_step(batch: Batch):
     G x lanes masked-reduction passes of round 2. ``value_overflow``
     guards the declared Q1_BITS bounds at runtime.
     """
+    from presto_tpu.ops import pallas_q1
+    from presto_tpu.ops.strings import use_pallas
+
+    if (use_pallas() and jax.default_backend() == "tpu"
+            and pallas_q1.supported(batch)
+            and pallas_q1.probe_supported(batch.capacity)):
+        # HandTpchQuery1 fast path: the whole fragment as one Pallas
+        # pass (predicate, gid, decimals, lane split, segment sums in
+        # VMEM — ops/pallas_q1.py). Narrow-storage TPU batches only;
+        # everything else takes the generic route below.
+        return pallas_q1.q1_step(batch)
+
     pred, disc_price, charge = q1_exprs()
     live = batch.live & evaluate_predicate(pred, batch)
     gids, _ = group_ids_direct(

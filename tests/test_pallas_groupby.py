@@ -71,7 +71,7 @@ def test_multi_major_accumulation(rng, monkeypatch):
     import presto_tpu.ops.pallas_groupby as PG
 
     monkeypatch.setattr(PG, "_MAJOR_ROWS", 1 << 17)
-    monkeypatch.setattr(PG, "_block_rows", lambda cap: 1 << 16)
+    monkeypatch.setattr(PG, "_block_rows", lambda cap, *a: 1 << 16)
     cap = 1 << 19
     gids, values, contribs = _data(rng, cap)
     zeroed = [jnp.where(c, v, 0).astype(jnp.int32)

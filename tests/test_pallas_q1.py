@@ -1,0 +1,96 @@
+"""The fully-fused Pallas Q1 kernel (ops/pallas_q1.py) vs the generic
+``q1_fused_step`` route, bit-for-bit, in interpret mode on CPU.
+
+On CPU the workloads router never takes the Pallas path (backend
+check), so ``q1_fused_step`` here is the independent generic
+reference; ``pallas_q1.q1_step`` runs the kernel under interpret.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from presto_tpu.batch import Batch, Column
+from presto_tpu.ops import pallas_q1
+from presto_tpu.types import BIGINT, DATE, decimal, varchar
+from presto_tpu.workloads import Q1_COLS, q1_fused_step
+
+CAP = 1 << 16
+
+
+def _narrow_batch(rng, cap=CAP, rows=None):
+    rows = cap if rows is None else rows
+    dec2 = decimal(12, 2)
+    mk = {
+        "l_shipdate": (np.int16, 9000, 11500, DATE),  # straddles cutoff
+        "l_returnflag": (np.int8, 0, 3, varchar()),
+        "l_linestatus": (np.int8, 0, 2, varchar()),
+        "l_quantity": (np.int16, 100, 5001, dec2),
+        "l_extendedprice": (np.int32, 90000, 10_500_000, dec2),
+        "l_discount": (np.int8, 0, 11, dec2),
+        "l_tax": (np.int8, 0, 9, dec2),
+    }
+    cols = {}
+    for name, (dt, lo, hi, typ) in mk.items():
+        cols[name] = Column(
+            jnp.asarray(rng.integers(lo, hi, cap).astype(dt)), None, typ)
+    live = np.zeros(cap, np.bool_)
+    live[:rows] = True
+    return Batch(cols, jnp.asarray(live))
+
+
+def _canonical(b: Batch) -> Batch:
+    cols = {n: Column(c.data.astype(jnp.int64), c.valid, c.dtype)
+            for n, c in b.columns.items()}
+    return Batch(cols, b.live)
+
+
+@pytest.mark.parametrize("rows", [CAP, CAP - 1371])
+def test_matches_generic_route(rng, rows):
+    b = _narrow_batch(rng, rows=rows)
+    want = jax.jit(q1_fused_step)(_canonical(b))
+    got = pallas_q1.q1_step(b)
+    for k in ("sum_qty", "sum_base_price", "sum_disc_price", "sum_charge",
+              "count_order"):
+        np.testing.assert_array_equal(
+            np.asarray(got[k]), np.asarray(want[k]), err_msg=k)
+    np.testing.assert_array_equal(
+        np.asarray(got["present"]), np.asarray(want["present"]))
+    assert bool(got["value_overflow"]) == bool(want["value_overflow"])
+    assert not bool(got["value_overflow"])
+
+
+def test_overflow_guard_fires_on_discount_range(rng):
+    b = _narrow_batch(rng)
+    disc = np.array(b["l_discount"].data)
+    disc[11] = -56  # dp = ep*156 could wrap int32 silently
+    ship = np.array(b["l_shipdate"].data)
+    ship[11] = 9100  # under the cutoff: the row must contribute
+    cols = dict(b.columns)
+    cols["l_discount"] = Column(jnp.asarray(disc), None, decimal(12, 2))
+    cols["l_shipdate"] = Column(jnp.asarray(ship), None, DATE)
+    got = pallas_q1.q1_step(Batch(cols, b.live))
+    assert bool(got["value_overflow"])
+
+
+def test_overflow_guard_fires(rng):
+    b = _narrow_batch(rng)
+    data = np.array(b["l_extendedprice"].data)
+    data[7] = 1 << 25  # beyond the 24-bit declared bound
+    ship = np.array(b["l_shipdate"].data)
+    ship[7] = 9100  # under the cutoff: the row must contribute
+    cols = dict(b.columns)
+    cols["l_extendedprice"] = Column(jnp.asarray(data), None, BIGINT)
+    cols["l_shipdate"] = Column(jnp.asarray(ship), None, DATE)
+    got = pallas_q1.q1_step(Batch(cols, b.live))
+    assert bool(got["value_overflow"])
+
+
+def test_eligibility():
+    rng = np.random.default_rng(0)
+    b = _narrow_batch(rng)
+    assert pallas_q1.supported(b)
+    assert not pallas_q1.supported(_canonical(b))  # int64 columns
+    assert pallas_q1._block_rows(CAP + 3) is None  # misaligned capacity
