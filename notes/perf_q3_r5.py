@@ -1,0 +1,147 @@
+"""Round-5 Q3 probe: stage isolation of the dense join probe on chip.
+
+Variants over the SF1 shapes (1.5M filtered orders build, 6M lineitem
+probe, resident x10 tiling to amortize the ~15 ms dispatch floor):
+
+  floor    read-only floor over the probe columns
+  dense    shipped probe_unique_dense (int32[6M] table gather)
+  dense32  same gather with int32 slot indices (skip the int64 widen)
+  bits     packed-bitmask existence table (int32[domain/32], 750KB):
+           word gather + bit test — existence only, no row payload
+  bits_vm  same, table donated into the kernel via jnp broadcast
+
+Run: python notes/perf_q3_r5.py [tile]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from bench import put_table  # noqa: E402
+from presto_tpu.connectors.tpch import TpchConnector  # noqa: E402
+
+TILE = int(sys.argv[1]) if len(sys.argv) > 1 else 10
+CUTOFF = 9204  # date '1995-03-15'
+
+dev = jax.devices()[0]
+print("device:", dev, flush=True)
+_ = int(jax.device_put(jnp.arange(4), dev).sum())
+
+conn = TpchConnector(sf=1.0, units_per_split=1 << 26)
+li = conn.table_numpy(
+    "lineitem", ["l_orderkey", "l_shipdate", "l_extendedprice", "l_discount"])
+o = conn.table_numpy("orders", ["o_orderkey", "o_orderdate"])
+n1 = len(li["l_orderkey"])
+lb, n = put_table("lineitem", li, dev, tile=TILE, narrow=True)
+ob, _ = put_table("orders", o, dev, narrow=True)
+domain = 6_000_001
+OCAP = ob.capacity
+print(f"probe rows={n} ocap={OCAP}", flush=True)
+
+# oracle
+m_o = o["o_orderdate"] < CUTOFF
+okeys = set(o["o_orderkey"][m_o].tolist())
+m_l = li["l_shipdate"] > CUTOFF
+sel = np.isin(li["l_orderkey"], o["o_orderkey"][m_o]) & m_l
+want_n = TILE * int(sel.sum())
+want_rev = TILE * int(
+    (li["l_extendedprice"][sel].astype(np.int64)
+     * (100 - li["l_discount"][sel])).sum())
+
+
+def timeit(tag, fn, *args):
+    r = jax.block_until_ready(jax.jit(fn)(*args))
+    t0 = time.perf_counter()
+    iters = 3
+    for _ in range(iters):
+        r = jax.block_until_ready(jax.jit(fn)(*args))
+    dt = (time.perf_counter() - t0) / iters
+    print(f"{tag:28s} {dt*1e3:9.2f} ms  {n/dt/1e9:6.3f} Grows/s", flush=True)
+    return r
+
+
+def check(tag, r):
+    nm, rev = int(r[0]), int(r[1])
+    assert nm == want_n, (tag, nm, want_n)
+    assert rev == want_rev, (tag, rev, want_rev)
+    print(f"  {tag}: EXACT", flush=True)
+
+
+def floor_fn(lb):
+    s = lb["l_orderkey"].data.astype(jnp.int64).sum()
+    s += lb["l_shipdate"].data.astype(jnp.int64).sum()
+    s += lb["l_extendedprice"].data.astype(jnp.int64).sum()
+    s += lb["l_discount"].data.astype(jnp.int64).sum()
+    return s, s
+
+
+def build_table(ob):
+    live = ob.live & (ob["o_orderdate"].data < CUTOFF)
+    keys = ob["o_orderkey"].data.astype(jnp.int64)
+    cap = keys.shape[0]
+    return (jnp.full(domain, cap, jnp.int32)
+            .at[jnp.where(live, keys, domain)]
+            .set(jnp.arange(cap, dtype=jnp.int32), mode="drop"))
+
+
+def build_bits(ob):
+    live = ob.live & (ob["o_orderdate"].data < CUTOFF)
+    keys = ob["o_orderkey"].data.astype(jnp.int64)
+    nw = (domain + 31) // 32
+    word = keys >> 5
+    bit = (jnp.int64(1) << (keys & 31)).astype(jnp.int32)
+    return (jnp.zeros(nw, jnp.int32)
+            .at[jnp.where(live, word, nw)]
+            .max(bit, mode="drop"))  # max as OR: single bit per key
+
+
+def rev_agg(lb, matched):
+    live = lb.live & (lb["l_shipdate"].data.astype(jnp.int32) > CUTOFF)
+    m = matched & live
+    ep = lb["l_extendedprice"].data.astype(jnp.int64)
+    disc = lb["l_discount"].data.astype(jnp.int64)
+    rev = jnp.where(m, ep * (100 - disc), 0)
+    return m.sum(), rev.sum()
+
+
+def dense_fn(table, lb):
+    keys = lb["l_orderkey"].data.astype(jnp.int64)
+    row = table[jnp.clip(keys, 0, domain - 1)]
+    matched = (row != jnp.int32(OCAP)) & (keys >= 0) & (keys < domain)
+    return rev_agg(lb, matched)
+
+
+def dense32_fn(table, lb):
+    keys = lb["l_orderkey"].data.astype(jnp.int32)
+    row = table[jnp.clip(keys, 0, domain - 1)]
+    matched = row != jnp.int32(OCAP)
+    return rev_agg(lb, matched)
+
+
+def bits_fn(words, lb):
+    keys = lb["l_orderkey"].data.astype(jnp.int32)
+    w = words[keys >> 5]
+    matched = ((w >> (keys & 31)) & 1) != 0
+    return rev_agg(lb, matched)
+
+
+table = jax.block_until_ready(jax.jit(build_table)(ob))
+words = jax.block_until_ready(jax.jit(build_bits)(ob))
+ws = int(np.asarray(words[:4]).sum())  # force sync
+
+timeit("floor (4-col read)", floor_fn, lb)
+r = timeit("dense (shipped, i64 idx)", dense_fn, table, lb)
+# shipped kernel marks matched-only rows; cap sentinel differs — check
+# via rev_agg parity instead of raw counts when cap mismatches
+check("dense", r)
+r = timeit("dense32 (i32 idx)", dense32_fn, table, lb)
+check("dense32", r)
+r = timeit("bits (packed bitmask)", bits_fn, words, lb)
+check("bits", r)

@@ -1,0 +1,144 @@
+"""Round-5 Q3 probe B: Pallas VMEM bitmask lookup via chained
+tpu.dynamic_gather.
+
+The XLA dense-table probe measured ~12 ns/element (733 ms / 60M) — the
+per-element HBM gather is the wall, independent of table size (a 750KB
+packed bitmask only bought 20%). Mosaic lowers jnp.take_along_axis to
+tpu.dynamic_gather (per-lane sublane select / per-sublane lane select);
+CHAINING the two addresses an arbitrary [S, 128] VMEM table:
+
+    z[s, l] = table[w_hi[s, l], w_lo[s, l]]
+    via y = take_along_axis(table, w_hi, axis=0)   # lane-batched
+        z = take_along_axis(y,     w_lo, axis=1)   # sublane-batched
+
+Constraint (mosaic/lowering.py:2483): the index block shape must EQUAL
+the operand shape, so the probe block is [2048, 128] = 2^18 rows and
+the bitmask table is padded to [2048, 128] int32 = 1 MB (domain 6M+1
+-> 187,591 words). Existence-only; counts matches per major.
+
+Run: python notes/perf_q3_r5b.py [tile]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+
+sys.path.insert(0, ".")
+
+from bench import put_table  # noqa: E402
+from presto_tpu.connectors.tpch import TpchConnector  # noqa: E402
+from presto_tpu.ops.pallas_groupby import (  # noqa: E402
+    _I0,
+    emit_slots,
+    rsum32,
+)
+
+TILE = int(sys.argv[1]) if len(sys.argv) > 1 else 10
+CUTOFF = 9204
+DOMAIN = 6_000_001
+S = 2048  # table sublanes; block = [S, 128] probe rows
+B = S * 128  # 2^18 rows/block
+_MAJOR = 1 << 23
+_SLOTS = 1024
+
+dev = jax.devices()[0]
+print("device:", dev, flush=True)
+_ = int(jax.device_put(jnp.arange(4), dev).sum())
+
+conn = TpchConnector(sf=1.0, units_per_split=1 << 26)
+li = conn.table_numpy("lineitem", ["l_orderkey", "l_shipdate"])
+o = conn.table_numpy("orders", ["o_orderkey", "o_orderdate"])
+lb, n = put_table("lineitem", li, dev, tile=TILE, narrow=True)
+ob, _ = put_table("orders", o, dev, narrow=True)
+cap = lb.capacity
+assert cap % B == 0, (cap, B)
+nblk = cap // B
+spm = max(1, _MAJOR // B)
+print(f"probe rows={n} cap={cap} nblk={nblk}", flush=True)
+
+m_o = o["o_orderdate"] < CUTOFF
+m_l = li["l_shipdate"] > CUTOFF
+sel = np.isin(li["l_orderkey"], o["o_orderkey"][m_o]) & m_l
+want_n = TILE * int(sel.sum())
+
+
+def build_bits(ob):
+    live = ob.live & (ob["o_orderdate"].data < CUTOFF)
+    keys = ob["o_orderkey"].data.astype(jnp.int64)
+    nw = S * 128
+    word = keys >> 5
+    bit = (jnp.int64(1) << (keys & 31)).astype(jnp.int32)
+    # o_orderkey is unique -> each (word, bit) lands once -> add == OR
+    flat = (jnp.zeros(nw, jnp.int32)
+            .at[jnp.where(live, word, nw)]
+            .add(bit, mode="drop"))
+    return flat.reshape(S, 128)
+
+
+def kernel(spm, table_ref, key_ref, ship_ref, live_ref, o_ref):
+    i = pl.program_id(0)
+    table = table_ref[...]  # [S, 128] int32, VMEM-resident
+    keys = key_ref[...]
+    live = ((live_ref[...] != 0)
+            & (ship_ref[...].astype(jnp.int32) > np.int32(CUTOFF)))
+    w = keys >> 5
+    w_hi = w >> 7
+    w_lo = w & 127
+    # lax.gather directly: jnp.take_along_axis promotes indices to
+    # int64 under x64, which Mosaic cannot lower. These dimension
+    # numbers are exactly the two forms mosaic/lowering.py accepts.
+    dn0 = lax.GatherDimensionNumbers(
+        offset_dims=(), collapsed_slice_dims=(0,), start_index_map=(0,),
+        operand_batching_dims=(1,), start_indices_batching_dims=(1,))
+    y = lax.gather(table, w_hi[..., None], dn0, (1, 1),
+                   mode=lax.GatherScatterMode.PROMISE_IN_BOUNDS)
+    dn1 = lax.GatherDimensionNumbers(
+        offset_dims=(), collapsed_slice_dims=(1,), start_index_map=(1,),
+        operand_batching_dims=(0,), start_indices_batching_dims=(0,))
+    z = lax.gather(y, w_lo[..., None], dn1, (1, 1),
+                   mode=lax.GatherScatterMode.PROMISE_IN_BOUNDS)
+    hit = ((z >> (keys & 31)) & 1) != 0
+    m = (hit & live).astype(jnp.int32)
+    cnt = jnp.sum(jnp.sum(m, axis=1, dtype=jnp.int32, keepdims=True),
+                  axis=0, dtype=jnp.int32, keepdims=True)  # [1, 1]
+    emit_slots(o_ref, i, spm, [cnt.reshape(1, 1, 1)])
+
+
+def probe(table, lb):
+    keys = lb["l_orderkey"].data.astype(jnp.int32)
+    args = [keys.reshape(nblk * S, 128),
+            lb["l_shipdate"].data.reshape(nblk * S, 128),
+            lb.live.astype(jnp.int8).reshape(nblk * S, 128)]
+    nmajor = -(-nblk // spm)
+    out = pl.pallas_call(
+        partial(kernel, spm),
+        grid=(nblk,),
+        in_specs=[pl.BlockSpec((S, 128), lambda i: (_I0, _I0))]
+        + [pl.BlockSpec((S, 128), lambda i: (i, _I0)) for _ in args],
+        out_specs=pl.BlockSpec(
+            (1, 1, _SLOTS), lambda i: (i // np.int32(spm), _I0, _I0)),
+        out_shape=jax.ShapeDtypeStruct((nmajor, 1, _SLOTS), jnp.int32),
+    )(table, *args)
+    return out.astype(jnp.int64).sum()
+
+
+table = jax.block_until_ready(jax.jit(build_bits)(ob))
+f = jax.jit(probe)
+r = int(jax.block_until_ready(f(table, lb)))
+print("matched:", r, "want:", want_n, "EXACT" if r == want_n else "WRONG",
+      flush=True)
+t0 = time.perf_counter()
+iters = 3
+for _ in range(iters):
+    jax.block_until_ready(f(table, lb))
+dt = (time.perf_counter() - t0) / iters
+print(f"pallas bitmask probe {dt*1e3:9.2f} ms  {n/dt/1e9:6.3f} Grows/s",
+      flush=True)

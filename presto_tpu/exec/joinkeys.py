@@ -162,8 +162,14 @@ def join_key_exprs(
             widths.append(max(1, int(mx).bit_length()))
         return widths
 
-    widths = key_widths(use_stats=True)
-    if widths is None or sum(widths) > 63:
+    # a bytes_hash component fills the whole 63-bit pack budget by
+    # itself, so with 2+ keys NO width ladder can succeed: skip both
+    # rungs (and their runtime minmax readbacks) straight to the mix
+    has_hash = any(
+        isinstance(k, Call) and k.fn == "bytes_hash"
+        for pair in zip(lkeys, rkeys) for k in pair)
+    widths = None if has_hash else key_widths(use_stats=True)
+    if not has_hash and (widths is None or sum(widths) > 63):
         # stats intervals can be loose (derived-column joins, deep
         # subtrees): retry with tight runtime minima/maxima — a device
         # readback per key, paid only in this rare case — before

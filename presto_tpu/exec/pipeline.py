@@ -67,10 +67,21 @@ class ScanSource:
 
 
 def prefetch_enabled() -> bool:
+    """Default: on when the host has CPU to spare, off on a 1-core
+    host — measured on the live chip (notes/PERF.md §8): with one
+    host core the worker thread only contends with generation under
+    the GIL (sf1 --stream: 439k rows/s prefetched vs 518k serial).
+    ``PRESTO_TPU_PREFETCH=1/0`` overrides either way."""
     import os
 
-    return os.environ.get("PRESTO_TPU_PREFETCH", "1").strip().lower() \
-        not in ("0", "false", "off", "no")
+    v = os.environ.get("PRESTO_TPU_PREFETCH", "").strip().lower()
+    if v:
+        return v not in ("0", "false", "off", "no")
+    try:
+        ncpu = len(os.sched_getaffinity(0))  # cgroup/taskset-aware
+    except AttributeError:  # non-Linux
+        ncpu = os.cpu_count() or 1
+    return ncpu > 1
 
 
 def prefetch_iter(load, items):

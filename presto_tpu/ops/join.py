@@ -232,11 +232,17 @@ def build_dense(keys, live, key_min: int, domain: int) -> DenseSide:
 
 
 def probe_unique_dense(dense: DenseSide, probe_keys, probe_live) -> UniqueProbe:
-    """FK->PK probe against a dense table: one gather, no sort."""
+    """FK->PK probe against a dense table: one gather, no sort.
+
+    The gather index is int32: the table materialized, so domain <
+    2^31, and int64 indices measurably slow the TPU gather (~12% on
+    the 60M-row Q3 probe — notes/perf_q3_r5.py; the gather itself is
+    the wall at ~11 ns/element regardless of table size)."""
     domain = dense.table.shape[0]
     slot = probe_keys.astype(jnp.int64) - dense.key_min
     inr = (slot >= 0) & (slot < domain) & probe_live
-    row = jnp.where(inr, dense.table[jnp.clip(slot, 0, domain - 1)], dense.sentinel)
+    idx = jnp.clip(slot, 0, domain - 1).astype(jnp.int32)
+    row = jnp.where(inr, dense.table[idx], dense.sentinel)
     matched = row != dense.sentinel
     return UniqueProbe(jnp.where(matched, row, dense.sentinel), matched)
 

@@ -73,9 +73,15 @@ def supported(batch) -> bool:
     for c in cols:
         if c not in batch.columns:
             return False
-        dt = batch[c].data.dtype
+        col = batch[c]
+        dt = col.data.dtype
         if not (jnp.issubdtype(dt, jnp.integer)
                 and jnp.iinfo(dt).bits <= 32):
+            return False
+        # the kernel reads raw data gated only by batch.live: a column
+        # with its own validity mask (NULLs) would aggregate sentinel
+        # values the generic route excludes
+        if col.valid is not None and col.valid is not batch.live:
             return False
     return _block_rows(batch.capacity) is not None
 

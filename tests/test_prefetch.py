@@ -45,7 +45,10 @@ def source():
     return rec, ScanSource(rec, "lineitem", ["l_quantity"], splits=splits)
 
 
-def test_prefetch_overlaps_consumer(source):
+def test_prefetch_overlaps_consumer(source, monkeypatch):
+    # force-enable: the default is off on a 1-core host (measured GIL
+    # contention — pipeline.prefetch_enabled)
+    monkeypatch.setenv("PRESTO_TPU_PREFETCH", "1")
     rec, src = source
     it = iter(src)
     b0 = next(it)
@@ -58,7 +61,8 @@ def test_prefetch_overlaps_consumer(source):
     assert 1 + len(rest) == len(src.splits)
 
 
-def test_prefetch_is_single_slot(source):
+def test_prefetch_is_single_slot(source, monkeypatch):
+    monkeypatch.setenv("PRESTO_TPU_PREFETCH", "1")
     rec, src = source
     it = iter(src)
     _ = next(it)
