@@ -119,6 +119,9 @@ def run_statement(session, stmt: str, max_rows: int = 100) -> bool:
     try:
         if low.startswith("explain analyze"):
             print(session.explain_analyze(s[len("explain analyze"):]))
+        elif low.startswith("explain (type distributed)"):
+            n = len("explain (type distributed)")
+            print(session.explain_distributed(s[n:]))
         elif low.startswith("explain"):
             print(session.explain(s[len("explain"):]))
         else:

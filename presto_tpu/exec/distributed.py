@@ -212,6 +212,11 @@ class DistributedExecutor:
 
         if not isinstance(plan, N.Output):
             raise ValueError("top-level plan must be an Output node")
+        from presto_tpu.plan.fragmenter import fragment_plan
+
+        self.fragment_info = fragment_plan(
+            plan, self.catalog, self.nworkers, self.broadcast_limit,
+            self.join_build_budget)
         scalars: dict[str, Any] = {}
         d = self._exec(plan.child, scalars)
         b = self._replicate(d).batch
@@ -239,7 +244,8 @@ class DistributedExecutor:
         rec.record(node, wall, rows)
         return out
 
-    def _replicate(self, d: DistBatch, guard: str | None = None) -> DistBatch:
+    def _replicate(self, d: DistBatch, guard: str | None = None,
+                   rows_hint: int | None = None) -> DistBatch:
         """Reshard rows -> fully replicated (the gather/broadcast
         exchange; XLA lowers the resharding copy to an all_gather).
 
@@ -252,7 +258,9 @@ class DistributedExecutor:
             return d
         b = d.batch
         if guard is not None:
-            rows = live_count(b)
+            # a plan-time sound row bound sizes the compaction without
+            # the blocking device sync (plan/fragmenter.py)
+            rows = rows_hint if rows_hint is not None else live_count(b)
             if rows > self.gather_limit:
                 raise CapacityOverflow(
                     f"{guard}: replicating {rows} rows to every device "
@@ -618,6 +626,20 @@ class DistributedExecutor:
             )
         from presto_tpu.runtime.memory import node_row_bytes
 
+        info = getattr(self, "fragment_info", None)
+        if (
+            info is not None
+            and info.join_strategy.get(id(node)) == "broadcast"
+            and info.join_fits_budget.get(id(node))
+            and left.sharded
+        ):
+            # plan-time proven (sound stats upper bound <= broadcast
+            # limit AND <= join budget): skip the live_count device
+            # sync and the budget readback entirely (plan/fragmenter.py)
+            return self._broadcast_join(node, left, right, lkey, rkey,
+                                        verify,
+                                        rows_hint=info.join_rows_ub.get(
+                                            id(node)))
         build_rows = live_count(right.batch)
         # budget on the ACTUAL materialized build size (the batch is in
         # hand — a stats overestimate must not force a host spill of a
@@ -667,7 +689,7 @@ class DistributedExecutor:
         return DistBatch(jax.jit(step)(d.batch, extra), sharded=True)
 
     def _broadcast_join(self, node, left: DistBatch, right: DistBatch,
-                        lkey, rkey, verify=()):
+                        lkey, rkey, verify=(), rows_hint=None):
         """REPLICATED distribution: all_gather the build side, probe
         stays sharded (probe's binary-search gathers hit the local
         replica — no collective in the probe step)."""
@@ -675,7 +697,8 @@ class DistributedExecutor:
         # when chosen because a side is unsharded (not because the build
         # is small), an oversized build must fail fast, not silently
         # multiply HBM by the mesh size
-        rb = self._replicate(right, guard="BroadcastJoinBuild").batch
+        rb = self._replicate(right, guard="BroadcastJoinBuild",
+                             rows_hint=rows_hint).batch
         build = JoinBuildOperator(rkey)
         build.process(rb)
         build.finish()

@@ -346,7 +346,9 @@ class LocalExecutor:
             return None
         domain = iv[1] - iv[0] + 1
         rows = sum(live_count(b) for b in right_batches)
-        if 0 < domain <= max(1 << 20, 16 * rows):
+        # < 2^31: the probe gathers with int32 indices (ops/join.py —
+        # a wider domain would wrap the index and silently mis-match)
+        if 0 < domain <= min(max(1 << 20, 16 * rows), (1 << 31) - 1):
             return (iv[0], int(domain))
         return None
 

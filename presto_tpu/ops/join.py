@@ -239,6 +239,7 @@ def probe_unique_dense(dense: DenseSide, probe_keys, probe_live) -> UniqueProbe:
     the 60M-row Q3 probe — notes/perf_q3_r5.py; the gather itself is
     the wall at ~11 ns/element regardless of table size)."""
     domain = dense.table.shape[0]
+    assert domain < (1 << 31), "dense domain must fit int32 gather indices"
     slot = probe_keys.astype(jnp.int64) - dense.key_min
     inr = (slot >= 0) & (slot < domain) & probe_live
     idx = jnp.clip(slot, 0, domain - 1).astype(jnp.int32)

@@ -155,6 +155,25 @@ class Session:
     def explain(self, sql: str) -> str:
         return plan_tree_str(self.plan(sql))
 
+    def explain_distributed(self, sql: str) -> str:
+        """Fragment/exchange rendering (reference: EXPLAIN (TYPE
+        DISTRIBUTED) via PlanFragmenter + PlanPrinter)."""
+        from presto_tpu.plan.fragmenter import fragment_plan
+
+        ex = self.executor
+        # local sessions render with the same session-property defaults
+        # a distributed executor would be built with — no duplicated
+        # literals that could drift from execution
+        fp = fragment_plan(
+            self.plan(sql), self.catalog,
+            getattr(ex, "nworkers", 1),
+            getattr(ex, "broadcast_limit",
+                    self.prop("broadcast_join_row_limit")),
+            getattr(ex, "join_build_budget",
+                    self.prop("join_build_budget_bytes")),
+        )
+        return fp.render()
+
     def explain_analyze(self, sql: str) -> str:
         """Execute and render the plan annotated with actuals
         (reference: EXPLAIN ANALYZE)."""

@@ -77,6 +77,9 @@ def test_prefetch_is_single_slot(source, monkeypatch):
 
 def test_prefetch_rows_match_serial(source, monkeypatch):
     rec, src = source
+    # force the prefetch path explicitly: the auto-default is serial on
+    # a 1-core host, which would compare serial against serial
+    monkeypatch.setenv("PRESTO_TPU_PREFETCH", "1")
     rows = sum(int(np.asarray(b.live).sum()) for b in src)
     monkeypatch.setenv("PRESTO_TPU_PREFETCH", "0")
     rows_serial = sum(int(np.asarray(b.live).sum()) for b in src)
