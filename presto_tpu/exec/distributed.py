@@ -215,7 +215,7 @@ class DistributedExecutor:
         from presto_tpu.plan.fragmenter import fragment_plan
 
         self.fragment_info = fragment_plan(
-            plan, self.catalog, self.nworkers, self.broadcast_limit,
+            plan, self.catalog, self.broadcast_limit,
             self.join_build_budget)
         scalars: dict[str, Any] = {}
         d = self._exec(plan.child, scalars)
@@ -631,6 +631,8 @@ class DistributedExecutor:
             info is not None
             and info.join_strategy.get(id(node)) == "broadcast"
             and info.join_fits_budget.get(id(node))
+            and info.join_rows_ub.get(id(node), 1 << 62)
+            <= self.gather_limit
             and left.sharded
         ):
             # plan-time proven (sound stats upper bound <= broadcast

@@ -75,6 +75,22 @@ def upper_bound_rows(node: N.PlanNode, catalog) -> int | None:
     return None
 
 
+def is_unfiltered(node: N.PlanNode) -> bool:
+    """True when ``upper_bound_rows`` is EXACT for this subtree — no
+    predicate anywhere, so the bound equals the actual row count. The
+    executor's plan-proven broadcast fast path requires this: with a
+    merely-loose bound, skipping the runtime ``live_count`` would size
+    the replication compaction (and check the gather guard) against
+    rows that are not really there."""
+    if isinstance(node, N.TableScan):
+        return node.predicate is None
+    if isinstance(node, (N.Project, N.BindScalars)):
+        return is_unfiltered(node.child)
+    if isinstance(node, (N.Values, N.ScalarValue)):
+        return True
+    return False
+
+
 @dataclass(frozen=True)
 class Exchange:
     """A fragment boundary: how the producer's rows reach the consumer."""
@@ -151,9 +167,8 @@ class FragmentPlan:
         return "\n".join(out)
 
 
-def fragment_plan(plan: N.PlanNode, catalog, nworkers: int,
-                  broadcast_limit: int, join_build_budget: int | None = None
-                  ) -> FragmentPlan:
+def fragment_plan(plan: N.PlanNode, catalog, broadcast_limit: int,
+                  join_build_budget: int | None = None) -> FragmentPlan:
     """Cut the logical plan at exchange boundaries and decide join
     distribution from sound stats bounds."""
     from presto_tpu.runtime.memory import node_row_bytes
@@ -183,9 +198,14 @@ def fragment_plan(plan: N.PlanNode, catalog, nworkers: int,
                 join_strategy[id(node)] = "auto"
                 ex = Exchange("hash", tuple(map(str, node.right_keys)))
                 part = "hash"
+            # the executor's sync-skipping fast path additionally
+            # requires the bound to be EXACT (no filtering below):
+            # a loose bound would mis-size the replication compaction
+            # and over-trip the gather guard
             join_fits[id(node)] = (
                 join_build_budget is not None and bytes_ub is not None
                 and bytes_ub <= join_build_budget
+                and is_unfiltered(node.right)
             )
             if ubr is not None:
                 join_rows_ub[id(node)] = ubr
@@ -214,16 +234,29 @@ def fragment_plan(plan: N.PlanNode, catalog, nworkers: int,
                 (cf.fid, Exchange("hash", tuple(n for n, _ in node.keys))))
             visit(node.child, cf)
             return
-        if isinstance(node, (N.Sort, N.TopN, N.Limit, N.Window,
-                             N.Aggregate)):
-            # global single-partition operators over a sharded child
-            if frag.partitioning != "single":
-                cf = new_fragment(
-                    node.children[0] if node.children else node, "source")
-                frag.consumes.append((cf.fid, Exchange("gather")))
-                for c in node.children:
-                    visit(c, cf)
+        single_ops = (N.Sort, N.TopN, N.Limit, N.Window)
+        if isinstance(node, single_ops) or (
+                isinstance(node, N.Aggregate)
+                and frag.partitioning != "single"):
+            # single-partition operators over a partitioned child: the
+            # gather happens below the INNERMOST such op (a chain like
+            # Limit over Sort gathers once). In the root [single]
+            # fragment the cut still renders — at runtime the executor
+            # replicates (gathers) before these operators.
+            child = node.children[0]
+            if isinstance(node, single_ops) and isinstance(
+                    child, single_ops):
+                visit(child, frag)
                 return
+            if isinstance(child, (N.Values, N.ScalarValue)):
+                visit(child, frag)
+                return
+            cf = new_fragment(child, "source")
+            frag.consumes.append((cf.fid, Exchange("gather")))
+            visit(child, cf)
+            for c in node.children[1:]:
+                visit(c, frag)
+            return
         for c in node.children:
             visit(c, frag)
 

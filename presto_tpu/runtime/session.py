@@ -166,7 +166,6 @@ class Session:
         # literals that could drift from execution
         fp = fragment_plan(
             self.plan(sql), self.catalog,
-            getattr(ex, "nworkers", 1),
             getattr(ex, "broadcast_limit",
                     self.prop("broadcast_join_row_limit")),
             getattr(ex, "join_build_budget",

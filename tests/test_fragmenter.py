@@ -60,12 +60,14 @@ def test_unique_join_bounds_by_probe_side(session):
 
 def test_q3_build_side_is_plan_time_broadcast(session):
     plan = session.plan(Q3ISH)
-    fp = fragment_plan(plan, session.catalog, nworkers=4,
-                       broadcast_limit=1 << 21,
+    fp = fragment_plan(plan, session.catalog, broadcast_limit=1 << 21,
                        join_build_budget=1 << 30)
     join = _the_join(plan)
     assert fp.join_strategy[id(join)] == "broadcast"
-    assert fp.join_fits_budget[id(join)]
+    # the build side is FILTERED (o_orderdate predicate), so the bound
+    # is loose: the sync-free fast path must NOT engage (it would
+    # mis-size the replication compaction) — runtime decides as before
+    assert not fp.join_fits_budget[id(join)]
     assert fp.join_rows_ub[id(join)] == \
         session.catalog.connector("tpch").row_count("orders")
     # the build side lives in its own replicated fragment
@@ -74,11 +76,30 @@ def test_q3_build_side_is_plan_time_broadcast(session):
     assert "hash" in kinds  # the grouped-aggregate exchange
 
 
+def test_unfiltered_dimension_build_takes_fast_path(session):
+    plan = session.plan(
+        "select count(*) from supplier join nation "
+        "on s_nationkey = n_nationkey")
+    fp = fragment_plan(plan, session.catalog, broadcast_limit=1 << 21,
+                       join_build_budget=1 << 30)
+    join = _the_join(plan)
+    assert fp.join_strategy[id(join)] == "broadcast"
+    assert fp.join_fits_budget[id(join)]  # unfiltered scan: exact bound
+
+
+def test_root_sort_renders_gather(session):
+    out = session.explain_distributed(
+        "select l_orderkey, l_quantity from lineitem "
+        "order by l_quantity limit 5")
+    assert "gather <- fragment" in out.replace("[", "").replace("]", "")
+    assert out.count("TableScan[tpch.lineitem]") == 1
+
+
 def test_large_build_is_auto(session):
     plan = session.plan(
         "select count(*) from lineitem join orders on l_orderkey = o_orderkey")
     join = _the_join(plan)
-    fp = fragment_plan(plan, session.catalog, nworkers=4,
+    fp = fragment_plan(plan, session.catalog,
                        broadcast_limit=10,  # force: orders exceed this
                        join_build_budget=1 << 30)
     assert fp.join_strategy[id(join)] == "auto"

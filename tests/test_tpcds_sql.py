@@ -52,7 +52,15 @@ def test_fact_nulls_flow_through(env):
     assert int(got["nd"][0]) < int(got["n"][0])  # NULLs actually present
 
 
-@pytest.mark.parametrize("name", sorted(QUERIES, key=lambda x: int(x[1:])))
+_HUGE = {"q14", "q23", "q24", "q54", "q64"}  # ~10-min fixtures each
+
+
+@pytest.mark.parametrize(
+    "name",
+    [pytest.param(n, marks=pytest.mark.huge) if n in _HUGE
+     else n
+     for n in sorted(QUERIES, key=lambda x: int(x[1:]))],
+)
 def test_tpcds_query_matches_oracle(env, name):
     session, tables = env
     got = session.sql(QUERIES[name])
