@@ -91,6 +91,26 @@ def is_unfiltered(node: N.PlanNode) -> bool:
     return False
 
 
+def output_partitioned(node: N.PlanNode) -> bool:
+    """Whether the node's OUTPUT is row-sharded at runtime. False for
+    producers the executor leaves single/replicated: literal rows,
+    global (keyless) aggregates (a plain-jit psum), and operators that
+    sit above their own gather (sort/topN/limit/window)."""
+    if isinstance(node, (N.Values, N.ScalarValue)):
+        return False
+    if isinstance(node, N.Aggregate):
+        return bool(node.keys)
+    if isinstance(node, (N.Sort, N.TopN, N.Limit, N.Window)):
+        return False
+    if isinstance(node, (N.Filter, N.Project, N.BindScalars, N.Output)):
+        return output_partitioned(node.children[0])
+    if isinstance(node, (N.Join, N.SemiJoin)):
+        return output_partitioned(node.left)
+    if isinstance(node, N.Union):
+        return any(output_partitioned(c) for c in node.inputs)
+    return True  # TableScan and anything unknown: assume sharded
+
+
 @dataclass(frozen=True)
 class Exchange:
     """A fragment boundary: how the producer's rows reach the consumer."""
@@ -238,24 +258,28 @@ def fragment_plan(plan: N.PlanNode, catalog, broadcast_limit: int,
         if isinstance(node, single_ops) or (
                 isinstance(node, N.Aggregate)
                 and frag.partitioning != "single"):
-            # single-partition operators over a partitioned child: the
+            # single-partition operators over a PARTITIONED child: the
             # gather happens below the INNERMOST such op (a chain like
-            # Limit over Sort gathers once). In the root [single]
-            # fragment the cut still renders — at runtime the executor
-            # replicates (gathers) before these operators.
+            # Limit over Sort gathers once). A child whose output is
+            # already single/replicated at runtime (Values, global
+            # aggregate, another single op) gets NO spurious exchange.
             child = node.children[0]
             if isinstance(node, single_ops) and isinstance(
                     child, single_ops):
                 visit(child, frag)
                 return
-            if isinstance(child, (N.Values, N.ScalarValue)):
+            if not output_partitioned(child):
                 visit(child, frag)
                 return
-            cf = new_fragment(child, "source")
+            producer = child
+            while isinstance(producer, (N.Project, N.Filter,
+                                        N.BindScalars)):
+                producer = producer.children[0]
+            part = ("hash" if isinstance(producer, N.Aggregate)
+                    and producer.keys else "source")
+            cf = new_fragment(child, part)
             frag.consumes.append((cf.fid, Exchange("gather")))
             visit(child, cf)
-            for c in node.children[1:]:
-                visit(c, frag)
             return
         for c in node.children:
             visit(c, frag)
