@@ -21,6 +21,7 @@ from typing import Mapping, Sequence
 import numpy as np
 
 from presto_tpu.batch import Batch, Dictionary
+from presto_tpu.runtime.errors import UserError
 from presto_tpu.spi import Split, batch_capacity, split_valids
 from presto_tpu.types import (
     BIGINT,
@@ -108,7 +109,7 @@ class MemorySink:
         df = (pd.concat(self.frames, ignore_index=True)
               if self.frames else None)
         if df is None:
-            raise ValueError("empty sink: nothing to commit")
+            raise UserError("empty sink: nothing to commit")
         self.connector._store(self.table, df)
         return len(df)
 
@@ -137,7 +138,7 @@ class MemoryConnector:
         t = self._tables[table]
         existing_df = t["df"]
         if list(df.columns) != list(existing_df.columns):
-            raise ValueError(
+            raise UserError(
                 f"insert schema {list(df.columns)} != table "
                 f"{list(existing_df.columns)}"
             )
@@ -167,7 +168,7 @@ class MemoryConnector:
             except TypeError:
                 widened = None
             if widened is None or widened.kind is not t_old.kind:
-                raise ValueError(
+                raise UserError(
                     f"insert type mismatch for {c!r}: {t_new.kind.value} "
                     f"into {t_old.kind.value}"
                 )

@@ -127,7 +127,9 @@ class LocalExecutor:
         import pandas as pd
 
         if not isinstance(plan, N.Output):
-            raise ValueError("top-level plan must be an Output node")
+            from presto_tpu.runtime.errors import InternalError
+
+            raise InternalError("top-level plan must be an Output node")
         batches, names = self.run_batches(plan)
         if not batches:
             return pd.DataFrame(columns=names)
@@ -137,17 +139,26 @@ class LocalExecutor:
         return pd.concat(dfs, ignore_index=True)[list(names)]
 
     def run_batches(self, plan: N.Output):
+        from presto_tpu.runtime.lifecycle import run_fragment
+
         scalars: dict[str, Any] = {}
         child = plan.child
         batches = self._exec(child, scalars)
-        # final rename/select to client names
-        out = []
-        for b in batches:
-            ren = b.select(list(plan.sources)).rename(
-                dict(zip(plan.sources, plan.names))
-            )
-            out.append(ren)
-        return out, list(plan.names)
+
+        # the sink drain is a fragment boundary too: in a streaming-only
+        # plan (no pipeline breaker) the lazy scan work happens HERE, so
+        # a retryable fault raised mid-drain must be retried here — the
+        # stream is replayable, a retry re-drains from the top
+        def drain():
+            out = []
+            for b in batches:
+                ren = b.select(list(plan.sources)).rename(
+                    dict(zip(plan.sources, plan.names))
+                )
+                out.append(ren)
+            return out
+
+        return run_fragment("fragment:Output", drain), list(plan.names)
 
     # ------------------------------------------------------------------
     def _exec(self, node: N.PlanNode, scalars: dict) -> BatchStream:
@@ -160,16 +171,25 @@ class LocalExecutor:
         counts stay exact (EXPLAIN ANALYZE trades the streaming memory
         bound for observability).
         """
+        from presto_tpu.runtime.lifecycle import run_fragment
+
         m = getattr(self, f"_exec_{type(node).__name__.lower()}", None)
         if m is None:
             raise NotImplementedError(f"no executor for {type(node).__name__}")
+        # the lifecycle boundary: deadline check + retryable-failure
+        # retry around the dispatch. Lazy nodes defer their work into
+        # the returned stream (drained by a pipeline-breaking ancestor
+        # or the sink), so a fault raised mid-drain surfaces at the
+        # DRAINING dispatch — which retries by re-running its subtree,
+        # replayable streams included.
+        label = f"fragment:{type(node).__name__}"
         rec = self.recorder
         if rec is None:
-            return m(node, scalars)
+            return run_fragment(label, lambda: m(node, scalars))
         import time as _time
 
         t0 = _time.perf_counter()
-        out = m(node, scalars)
+        out = run_fragment(label, lambda: m(node, scalars))
         rows = -1
         if rec.measure_rows and isinstance(out, BatchStream):
             batches = out.materialize()
@@ -197,7 +217,12 @@ class LocalExecutor:
         cap = batch_capacity(max(s.row_hint for s in splits))
 
         def make():
+            from presto_tpu.runtime.faults import fault_point
+            from presto_tpu.runtime.lifecycle import check_deadline
+
             for split in splits:
+                fault_point("scan")
+                check_deadline("scan")
                 b = conn.scan(split, src_cols, cap).rename(rename)
                 for op in ops:
                     b = op.process(b)[0]
@@ -223,6 +248,9 @@ class LocalExecutor:
         from presto_tpu.plan.bounds import agg_value_bits
 
         child = self._exec(node.child, scalars)
+        from presto_tpu.runtime.faults import fault_point
+
+        fault_point("aggregation")
         keys = [(n, bind_scalars(e, scalars)) for n, e in node.keys]
         pax = [(n, bind_scalars(e, scalars)) for n, e in node.passengers]
         # stats-derived |value| bounds cut the fused segment-sum's lane
@@ -730,7 +758,9 @@ class LocalExecutor:
             if n == 0:
                 continue
             if n > 1:
-                raise ValueError("scalar subquery returned more than one row")
+                from presto_tpu.runtime.errors import UserError
+
+                raise UserError("scalar subquery returned more than one row")
             col = b[names[0] if names[0] in b else b.names[0]]
             live = np.asarray(b.live)
             idx = int(np.nonzero(live)[0][0])

@@ -44,6 +44,7 @@ from presto_tpu.ops.groupby import (
     segment_agg,
 )
 from presto_tpu.ops.sort import sort_indices, top_n_indices
+from presto_tpu.runtime.errors import InternalError, ResourceExhausted
 from presto_tpu.types import BIGINT, DOUBLE, DataType, TypeKind
 
 
@@ -64,9 +65,14 @@ class NullGroupKeys(RuntimeError):
     the sort strategy (which groups NULL as its own key value)."""
 
 
-class CapacityOverflow(RuntimeError):
+class CapacityOverflow(ResourceExhausted):
     """An operator's static output capacity was exceeded; the host
-    re-plans with a larger bucket (SURVEY §7.4 hard part #1)."""
+    re-plans with a larger bucket (SURVEY §7.4 hard part #1).
+
+    Part of the error taxonomy (runtime/errors.py) as a
+    ResourceExhausted: NOT lifecycle-retryable — replaying the same
+    step hits the same capacity; recovery is the owning operator's
+    doubling loop, and exhaustion of THAT is a genuine resource wall."""
 
     def __init__(self, op: str, capacity: int, needed: int | None = None):
         super().__init__(f"{op}: capacity {capacity} exceeded"
@@ -210,7 +216,7 @@ class HashAggregationOperator(Operator):
         self._key_types: dict[str, DataType] = {n: e.dtype for n, e in self.group_keys}
         if isinstance(strategy, DirectStrategy):
             if self.passengers:
-                raise ValueError("passenger keys need the sort strategy")
+                raise InternalError("passenger keys need the sort strategy")
             self._update = jax.jit(self._direct_update)
         else:
             self._update = jax.jit(self._sort_update)
@@ -224,7 +230,7 @@ class HashAggregationOperator(Operator):
         if dtype.kind is TypeKind.BYTES:
             w = dtype.width
             if w > 7:
-                raise ValueError("cannot sort-group wide BYTES keys")
+                raise InternalError("cannot sort-group wide BYTES keys")
             data = jnp.where(data == 0, jnp.uint8(32), data)
             out = jnp.zeros(data.shape[0], jnp.int64)
             for i in range(w):
@@ -869,7 +875,7 @@ class WindowOperator(CollectingOperator):
         self.funcs = list(funcs)
         self.frame = frame
         if frame not in ("range", "rows", "full"):
-            raise ValueError(f"unsupported window frame {frame!r}")
+            raise InternalError(f"unsupported window frame {frame!r}")
         ranked = [
             f for f in funcs
             if f.kind in ("row_number", "rank", "dense_rank",

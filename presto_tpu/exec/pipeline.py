@@ -61,6 +61,9 @@ class ScanSource:
 
     def __iter__(self) -> Iterator[Batch]:
         def load(split):
+            from presto_tpu.runtime.faults import fault_point
+
+            fault_point("scan")
             return self.connector.scan(split, self.columns, self.capacity)
 
         return prefetch_iter(load, self.splits)
@@ -161,6 +164,8 @@ class Pipeline:
         self.stats = [OperatorStats(type(op).__name__) for op in self.operators]
 
     def run(self) -> list[Batch]:
+        from presto_tpu.runtime.lifecycle import check_deadline
+
         outputs: list[Batch] = []
 
         def push(i: int, batch: Batch):
@@ -176,10 +181,17 @@ class Pipeline:
                 st.output_batches += 1
                 push(i + 1, b)
 
+        # the driver-loop deadline boundary: one check per morsel (a
+        # compiled step in flight runs to completion; the NEXT push is
+        # what an expired query_max_run_time stops)
         for batch in self.source:
+            check_deadline("driver-loop")
             push(0, batch)
-        # finish cascade
+        # finish cascade — checked per finish() step, not once: for
+        # sort/window/topN plans the heavy work happens HERE, so an
+        # expired deadline must stop the remaining collecting operators
         for i, op in enumerate(self.operators):
+            check_deadline("driver-finish")
             t0 = time.perf_counter()
             tail = op.finish()
             self.stats[i].wall_s += time.perf_counter() - t0

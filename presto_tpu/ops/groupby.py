@@ -28,6 +28,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from presto_tpu.runtime.errors import InternalError
+
 
 def gather_padded(arr, idx, fill):
     """arr[idx] with out-of-range idx (>= len) producing ``fill``."""
@@ -399,4 +401,4 @@ def segment_agg(
     if kind == "max":
         vals = jnp.where(contrib, values, _identity("max", values.dtype))
         return jax.ops.segment_max(vals, g, num_segments=nseg)[:max_groups]
-    raise ValueError(f"unknown aggregate kind {kind!r}")
+    raise InternalError(f"unknown aggregate kind {kind!r}")

@@ -28,6 +28,8 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from presto_tpu.runtime.errors import InternalError
 from jax.experimental import pallas as pl
 
 LANE_BITS = 8
@@ -193,7 +195,7 @@ def fused_lane_sums(values, bits_list, count_masks, gids, max_groups: int,
          else _block_rows(cap, nl_total, nval, nmask))
     num_slots = max_groups * (nl_total + nmask) + 1
     if not supported(bits_list, num_slots, cap, nval, nmask):
-        raise ValueError("fused_lane_sums: ineligible shapes/bounds")
+        raise InternalError("fused_lane_sums: ineligible shapes/bounds")
     args = ([v.astype(jnp.int32) for v in values]
             + [m.astype(jnp.int8) for m in count_masks]
             + [jnp.minimum(gids, max_groups).astype(jnp.int32)])

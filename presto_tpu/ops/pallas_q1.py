@@ -16,8 +16,10 @@ Exactness: dp = ep*(100-disc) fits int32 when ep fits its declared 24
 bits and disc is in [0, 100] (both guarded in-kernel). charge =
 (dp*(100+tax) + 50)//100 would overflow int32, so it runs as
 q*t + round(r*t/100) on the int32 divmod split dp = 100q + r, with the
-divmod done in f32 reciprocal + two correction rounds and round(x/100)
-as (x*5243)>>19 — both proven exact over their full domains
+divmod done in f32 reciprocal + two correction rounds (exact for dp up
+to the reachable (2^24-1)*100 ≈ 1.678e9) and round(x/100) as
+(x*5243)>>19 (exact for x <= 43698; the reachable r*t + 50 tops out at
+12623) — both verified over their full domains
 (notes/perf_q1_r5*.py); q*t itself fits int32 because the guard also
 pins tax <= 27 (2^24 * 127 + 12700 < 2^31). Per-group lane partials
 stay int32-exact because each output major covers <= 2^23 rows
@@ -87,7 +89,11 @@ def supported(batch) -> bool:
 
 
 def _divmod100(dp):
-    """Exact (dp // 100, dp % 100) for 0 <= dp < 1.1e9, int32/f32 only."""
+    """Exact (dp // 100, dp % 100) over the kernel's full reachable
+    domain 0 <= dp <= (2^24 - 1) * 100 ≈ 1.678e9 (ep guarded to 24
+    bits, disc to [0, 100]), int32/f32 only: the f32 reciprocal floor
+    lands within +-2 of the true quotient everywhere below 2^31, and
+    the two correction rounds absorb that margin."""
     q = jnp.floor(dp.astype(jnp.float32) * np.float32(0.01)).astype(jnp.int32)
     r = dp - 100 * q
     for _ in range(2):
@@ -106,11 +112,9 @@ def _kernel(spm, ship_ref, rf_ref, ls_ref, qty_ref, ep_ref, disc_ref,
     zero = _I0
 
     live = (live_ref[...] != 0) & (ship_ref[...].astype(jnp.int32) <= _CUTOFF)
-    gid = jnp.where(
-        live,
-        rf_ref[...].astype(jnp.int32) * 2 + ls_ref[...].astype(jnp.int32),
-        np.int32(G),
-    )
+    rf = rf_ref[...].astype(jnp.int32)
+    ls = ls_ref[...].astype(jnp.int32)
+    gid = jnp.where(live, rf * 2 + ls, np.int32(G))
     qty = jnp.where(live, qty_ref[...].astype(jnp.int32), zero)
     ep = jnp.where(live, ep_ref[...].astype(jnp.int32), zero)
     disc = disc_ref[...].astype(jnp.int32)
@@ -119,7 +123,9 @@ def _kernel(spm, ship_ref, rf_ref, ls_ref, qty_ref, ep_ref, disc_ref,
     t = 100 + tax
     q, r = _divmod100(dp)
     # charge = (dp*t + 50)//100 = q*t + (r*t + 50)//100; the latter via
-    # the verified magic multiply (range of r*t + 50 <= 10742 < 2^19/5243)
+    # the verified magic multiply: r <= 99 and t = 100 + tax <= 127
+    # (tax guarded to [0, 27]) give r*t + 50 <= 12623, well inside the
+    # (x*5243)>>19 == x//100 exactness domain (x <= 43698)
     ch = q * t + (((r * t + 50) * 5243) >> 19)
 
     lanes = []
@@ -142,9 +148,14 @@ def _kernel(spm, ship_ref, rf_ref, ls_ref, qty_ref, ep_ref, disc_ref,
     # kernel flags rather than risk it — possibly flagging rows whose
     # int64 result would still have fit 31 bits (loud, never silent;
     # TPC-H data has disc <= 10, tax <= 8, so never in practice).
-    bad_dt = ((disc < 0) | (disc > 100) | (tax < 0)
-              | (tax > 27)).astype(jnp.int32)
-    ov = rsum32(jnp.where(live, (qty >> 13) | (ep >> 24) | bad_dt, zero))
+    # The group-id domain is guarded the same way: gid = rf*2 + ls is
+    # neither clipped nor range-checked, so an out-of-domain
+    # returnflag/linestatus code would silently vanish from every
+    # group AND from count_order (the generic route clips into the
+    # domain instead); flag it loudly like the other violations.
+    bad = ((disc < 0) | (disc > 100) | (tax < 0) | (tax > 27)
+           | (rf < 0) | (rf > 2) | (ls < 0) | (ls > 1)).astype(jnp.int32)
+    ov = rsum32(jnp.where(live, (qty >> 13) | (ep >> 24) | bad, zero))
     scalars.append(ov)
     emit_slots(o_ref, i, spm, scalars)
 

@@ -27,12 +27,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from presto_tpu.runtime.errors import InternalError
+
 
 def change_flags(cols, valids=None) -> jnp.ndarray:
     """True where row i differs from row i-1 on any column (row 0 is
     always True). ``valids`` compares null flags as part of the value."""
     if not cols:
-        raise ValueError("change_flags needs at least one column")
+        raise InternalError("change_flags needs at least one column")
     n = cols[0].shape[0]
     first = jnp.zeros(n, jnp.bool_).at[0].set(True)
     diff = jnp.zeros(n - 1, jnp.bool_)
@@ -70,7 +72,7 @@ def seg_scan(vals: jnp.ndarray, reset: jnp.ndarray, kind: str) -> jnp.ndarray:
     elif kind == "max":
         op = jnp.maximum
     else:
-        raise ValueError(f"unknown scan kind {kind!r}")
+        raise InternalError(f"unknown scan kind {kind!r}")
 
     def combine(a, b):
         av, af = a

@@ -226,7 +226,7 @@ def make_shuffle_step(mesh, num_partitions: int, quota: int):
     The building block the ICI-shuffle GB/s microbench times
     (BASELINE metric: ici_shuffle_gbps).
     """
-    from jax import shard_map
+    from presto_tpu.parallel.mesh import shard_map
     from jax.sharding import PartitionSpec as P
 
     axes = worker_axes(mesh)
@@ -252,7 +252,7 @@ def make_multiround_shuffle_step(
     using the skew-aware multi-round exchange: a zipfian key stream
     completes at a small fixed wire quota instead of forcing the host
     to double-and-recompile (SURVEY §7.4 #4)."""
-    from jax import shard_map
+    from presto_tpu.parallel.mesh import shard_map
     from jax.sharding import PartitionSpec as P
 
     axes = worker_axes(mesh)
@@ -275,7 +275,7 @@ def make_multiround_shuffle_step(
 
 def make_broadcast_step(mesh):
     """jitted sharded Batch -> replicated Batch (all rows everywhere)."""
-    from jax import shard_map
+    from presto_tpu.parallel.mesh import shard_map
     from jax.sharding import PartitionSpec as P
 
     axes = worker_axes(mesh)

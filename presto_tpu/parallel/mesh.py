@@ -26,6 +26,22 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from presto_tpu.runtime.errors import UserError
+
+try:  # jax >= 0.6: top-level export, ``check_vma`` kwarg
+    from jax import shard_map
+except ImportError:  # jax 0.4/0.5: experimental module, ``check_rep`` kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map_exp
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True, **kw):
+        """Compat wrapper: the engine's shard_map call shape (the
+        modern ``check_vma`` signature) on older jax releases."""
+        return _shard_map_exp(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=check_vma, **kw,
+        )
+
+
 WORKERS = "workers"
 DCN = "dcn"
 ICI = "ici"
@@ -35,7 +51,7 @@ def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
     devs = list(devices) if devices is not None else jax.devices()
     if n_devices is not None:
         if len(devs) < n_devices:
-            raise ValueError(f"need {n_devices} devices, have {len(devs)}")
+            raise UserError(f"need {n_devices} devices, have {len(devs)}")
         devs = devs[:n_devices]
     return Mesh(np.array(devs), (WORKERS,))
 
@@ -50,11 +66,11 @@ def make_dcn_mesh(n_hosts: int, per_host: int | None = None, devices=None) -> Me
     devs.sort(key=lambda d: (d.process_index, d.id))
     if per_host is None:
         if len(devs) % n_hosts:
-            raise ValueError(f"{len(devs)} devices not divisible by {n_hosts}")
+            raise UserError(f"{len(devs)} devices not divisible by {n_hosts}")
         per_host = len(devs) // n_hosts
     need = n_hosts * per_host
     if len(devs) < need:
-        raise ValueError(f"need {need} devices, have {len(devs)}")
+        raise UserError(f"need {need} devices, have {len(devs)}")
     return Mesh(np.array(devs[:need]).reshape(n_hosts, per_host), (DCN, ICI))
 
 

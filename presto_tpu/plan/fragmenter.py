@@ -55,7 +55,13 @@ def upper_bound_rows(node: N.PlanNode, catalog) -> int | None:
     if isinstance(node, N.Values):
         return 1
     if isinstance(node, N.Aggregate):
-        return ub(node.child, catalog)  # one row per group <= input rows
+        c = ub(node.child, catalog)
+        if not node.keys:
+            # a keyless (global) aggregate emits one row even over an
+            # empty input, so a child bound of 0 (or unknown) would
+            # violate the SOUND-upper-bound contract
+            return 1 if c is None else max(1, c)
+        return c  # one row per group <= input rows
     if isinstance(node, N.Join):
         if node.unique and node.kind in ("inner", "left"):
             # each probe row matches at most one build row; LEFT adds
@@ -158,6 +164,13 @@ class FragmentPlan:
                 return f"{t}[keys={[k for k, _ in n.keys]}]"
             if isinstance(n, N.Join):
                 strat = self.join_strategy.get(id(n))
+                # an unproven broadcast (row UB fits the broadcast limit
+                # but the byte budget is not plan-time proven) can still
+                # take the grouped-spill path at runtime; render it as
+                # tentative so EXPLAIN doesn't overstate the strategy
+                if strat == "broadcast" and not self.join_fits_budget.get(
+                        id(n)):
+                    strat = "broadcast?"
                 extra = f", dist={strat}" if strat else ""
                 return f"{t}[{n.kind}{extra}]"
             return t

@@ -1,0 +1,115 @@
+"""Typed error taxonomy — failure as a first-class state.
+
+Reference parity: ``StandardErrorCode`` + ``PrestoException`` — every
+failure carries a typed error code partitioned into USER_ERROR /
+INSUFFICIENT_RESOURCES / EXTERNAL / INTERNAL_ERROR classes, and the
+coordinator's retry policy keys off the class, not the message
+[SURVEY §5.3; reference tree unavailable, paths reconstructed]. The
+robust-hybrid-hash-join literature (PAPERS.md) makes the same point at
+the operator level: robustness to misestimates has to be designed into
+the execution path, which starts with failures the runtime can
+*classify*.
+
+Design rules:
+
+- Every engine raise-site uses a taxonomy class (or an existing typed
+  refusal like ``NotImplementedError``, which stays: a refusal is a
+  permanent "cannot", not a failure state to recover from).
+- ``UserError`` subclasses ``ValueError`` and the resource classes
+  subclass ``RuntimeError`` so pre-taxonomy callers (and tests)
+  catching the stdlib types keep working — migration is additive.
+- ``retryable`` is a property of the CLASS (overridable per instance):
+  only failures that are plausibly transient (injected faults, device
+  loss) are retryable; deterministic failures (bad SQL, a capacity
+  that WILL overflow again, an expired deadline) are not — retrying
+  them burns the retry budget to reproduce the same failure.
+"""
+
+from __future__ import annotations
+
+
+class PrestoError(Exception):
+    """Base of the taxonomy: a typed error code plus a retry class."""
+
+    #: stable machine-readable code (QueryInfo.error_code, events)
+    error_code: str = "GENERIC_INTERNAL_ERROR"
+    #: whether a retry of the same work could plausibly succeed
+    retryable: bool = False
+
+    def __init__(self, message: str, *, retryable: bool | None = None):
+        super().__init__(message)
+        if retryable is not None:
+            self.retryable = retryable
+
+
+class UserError(PrestoError, ValueError):
+    """The query (or its session/config input) is at fault: syntax
+    errors, unknown tables/columns/properties, DDL misuse, scalar
+    subqueries with more than one row. Never retryable — the same
+    statement fails the same way."""
+
+    error_code = "USER_ERROR"
+    retryable = False
+
+
+class ResourceExhausted(PrestoError, RuntimeError):
+    """The query needs more of a bounded resource than the engine will
+    grant: admission-control rejections, gather-guard refusals,
+    capacity-retry exhaustion. Not retryable — the resource demand is
+    a property of the query, so a retry hits the same wall (the fix is
+    a session property or a smaller query)."""
+
+    error_code = "RESOURCE_EXHAUSTED"
+    retryable = False
+
+
+class ExceededTimeLimit(PrestoError, RuntimeError):
+    """The per-query wall-clock deadline (``query_max_run_time``)
+    expired. Not retryable within the query — a retry starts from zero
+    against the same limit."""
+
+    error_code = "EXCEEDED_TIME_LIMIT"
+    retryable = False
+
+
+class TransientFailure(PrestoError, RuntimeError):
+    """A plausibly-transient fault: an injected fault, a lost device,
+    a flaky interconnect step. Retryable — the fragment retry loop and
+    the distributed->local degradation path both key off this class."""
+
+    error_code = "TRANSIENT_FAILURE"
+    retryable = True
+
+
+class InternalError(PrestoError, RuntimeError):
+    """An engine invariant broke (not the user's fault, not a resource
+    wall). Not retryable by default: a broken invariant usually
+    reproduces."""
+
+    error_code = "GENERIC_INTERNAL_ERROR"
+    retryable = False
+
+
+def is_retryable(exc: BaseException) -> bool:
+    """Retry class of ANY exception: taxonomy errors carry their own
+    flag; foreign exceptions are conservatively non-retryable (query-
+    level ``query_retries`` still re-runs them — that knob predates
+    the taxonomy and deliberately retries everything)."""
+    return bool(getattr(exc, "retryable", False))
+
+
+def error_code(exc: BaseException) -> str:
+    """Stable code for ANY exception (foreign ones are classified by
+    their stdlib ancestry, the pre-taxonomy raise-sites' contract)."""
+    code = getattr(exc, "error_code", None)
+    if code is not None:
+        return code
+    if isinstance(exc, NotImplementedError):
+        return "NOT_SUPPORTED"
+    if isinstance(exc, ValueError):
+        return "USER_ERROR"
+    if isinstance(exc, (TimeoutError,)):
+        return "EXCEEDED_TIME_LIMIT"
+    if isinstance(exc, MemoryError):
+        return "RESOURCE_EXHAUSTED"
+    return "GENERIC_INTERNAL_ERROR"

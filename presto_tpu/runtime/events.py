@@ -19,9 +19,16 @@ log = logging.getLogger("presto_tpu.events")
 
 
 class EventListener(Protocol):
+    """Listeners implement any subset of these (missing methods are
+    skipped); all receive the tracker's live QueryInfo."""
+
     def query_created(self, info: QueryInfo) -> None: ...
 
     def query_completed(self, info: QueryInfo) -> None: ...
+
+    def query_failed(self, info: QueryInfo) -> None: ...
+
+    def fragment_retried(self, info: QueryInfo) -> None: ...
 
 
 class EventDispatcher:
@@ -46,3 +53,14 @@ class EventDispatcher:
 
     def query_completed(self, info: QueryInfo):
         self._fire("query_completed", info)
+
+    def query_failed(self, info: QueryInfo):
+        """Fired on the FAILED transition, before query_completed
+        (which fires for every terminal state, like the reference's
+        QueryCompletedEvent carrying the failure info)."""
+        self._fire("query_failed", info)
+
+    def fragment_retried(self, info: QueryInfo):
+        """Fired on each fragment retry; ``info.fragment_retries`` has
+        already been incremented when listeners see it."""
+        self._fire("fragment_retried", info)

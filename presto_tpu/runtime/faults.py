@@ -1,0 +1,138 @@
+"""Deterministic fault injection — the testable half of robustness.
+
+Reference parity: the reference proves its failure handling with an
+in-process ``DistributedQueryRunner`` plus induced task failures; a
+single-controller engine has no separate worker process to kill, so
+faults inject at the host-side *hook points* instead: connector scans,
+exchange steps, and aggregation steps call ``fault_point(site)`` right
+before dispatching device work, and an installed :class:`FaultInjector`
+decides — deterministically — whether that call raises.
+
+Determinism rules (tests must replay exactly):
+
+- ``times=N`` faults fire on the first N matching calls, then go
+  silent — the shape retry tests need ("fail twice, then succeed").
+- ``probability=p`` faults draw from the injector's OWN seeded
+  ``random.Random`` stream, in call order; same seed + same call
+  sequence = same fault sequence.
+- Sites are dot-separated names (``"exchange.join"``); a spec for a
+  prefix (``"exchange"``) matches every descendant site.
+
+Hook points are no-ops (one module attribute read) when no injector is
+installed, so production paths pay nothing.
+"""
+
+from __future__ import annotations
+
+import random
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+from presto_tpu.runtime.errors import TransientFailure
+
+#: canonical hook-point sites (descendants are fair game too)
+SITES = (
+    "scan",  # connector scan loops (both execution tiers)
+    "exchange.aggregate",  # partial->all_to_all->final agg step
+    "exchange.join",  # repartition-join all_to_all step
+    "exchange.gather",  # replicate/broadcast all_gather
+    "exchange.window",  # partitioned-window shuffle
+    "exchange.sort",  # range-partition sort shuffle
+    "aggregation",  # aggregation dispatch (local + distributed)
+)
+
+
+@dataclass
+class FaultSpec:
+    """One armed fault: where it fires, what it raises, how often."""
+
+    site: str
+    error: type = TransientFailure
+    #: fire on the first N matching calls (None = every matching call)
+    times: int | None = 1
+    probability: float = 1.0
+    message: str = ""
+    fired: int = 0
+
+    def matches(self, site: str) -> bool:
+        return site == self.site or site.startswith(self.site + ".")
+
+
+@dataclass
+class FaultInjector:
+    """Seedable registry of armed faults (install via :func:`injected`
+    or :func:`install`)."""
+
+    seed: int = 0
+    specs: list[FaultSpec] = field(default_factory=list)
+
+    def __post_init__(self):
+        self._rng = random.Random(self.seed)
+
+    def inject(
+        self,
+        site: str,
+        error: type = TransientFailure,
+        times: int | None = 1,
+        probability: float = 1.0,
+        message: str = "",
+    ) -> FaultSpec:
+        """Arm a fault at ``site`` (or any descendant ``site.*``)."""
+        spec = FaultSpec(site, error, times, probability, message)
+        self.specs.append(spec)
+        return spec
+
+    def fired(self, site: str | None = None) -> int:
+        """Total fires, optionally restricted to one armed site."""
+        return sum(
+            s.fired for s in self.specs if site is None or s.site == site
+        )
+
+    def check(self, site: str) -> None:
+        """Raise the first armed fault matching ``site`` (hook-point
+        body; engine code calls :func:`fault_point` instead)."""
+        for spec in self.specs:
+            if not spec.matches(site):
+                continue
+            if spec.times is not None and spec.fired >= spec.times:
+                continue
+            if spec.probability < 1.0 and (
+                self._rng.random() >= spec.probability
+            ):
+                continue
+            spec.fired += 1
+            msg = spec.message or (
+                f"injected fault at {site!r} (fire #{spec.fired})"
+            )
+            raise spec.error(msg)
+
+
+#: the installed injector; None (the default) makes every hook a no-op
+_ACTIVE: FaultInjector | None = None
+
+
+def install(injector: FaultInjector | None) -> None:
+    """Install (or, with None, clear) the process-wide injector."""
+    global _ACTIVE
+    _ACTIVE = injector
+
+
+def active() -> FaultInjector | None:
+    return _ACTIVE
+
+
+@contextmanager
+def injected(injector: FaultInjector):
+    """Scoped install — the test-suite idiom."""
+    prev = _ACTIVE
+    install(injector)
+    try:
+        yield injector
+    finally:
+        install(prev)
+
+
+def fault_point(site: str) -> None:
+    """Engine hook point: raises iff an installed injector says so."""
+    if _ACTIVE is not None:
+        _ACTIVE.check(site)

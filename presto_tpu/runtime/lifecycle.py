@@ -1,0 +1,277 @@
+"""Query lifecycle management: deadlines, admission, retry, degradation.
+
+Reference parity: ``QueryManager`` + ``SqlStageExecution`` — the tier
+that treats failure as a first-class state: ``query.max-run-time``
+deadlines enforced by the coordinator, memory-pool admission before a
+query may start, and per-stage retry policy [SURVEY §3.1, §5.3;
+reference tree unavailable, paths reconstructed]. The robust-hash-join
+design argument (PAPERS.md) applies verbatim: the static estimates in
+``plan/bounds.py`` WILL be wrong sometimes, so the lifecycle layer —
+not the operators — must own what happens when they are.
+
+Single-controller mapping:
+
+- **Deadline** (``query_max_run_time``): there is no watchdog thread to
+  cancel a running XLA program, so the deadline is checked at the
+  host-side *boundaries* — every fragment dispatch in both executors
+  and every driver-loop push in ``exec/pipeline.py``. A single compiled
+  step runs to completion; the check fires before the next one starts.
+- **Admission** (``query_max_memory_bytes``): the peak stats-estimated
+  node materialization (``runtime/memory.estimate_node_bytes``) is
+  compared against the limit BEFORE launch, rejecting with
+  ``ResourceExhausted`` instead of OOMing mid-flight. The default limit
+  is a loose multiple of the device budget: estimates are sound-ish,
+  not exact, and the grouped/streaming tiers bound true residency well
+  below the naive estimate — admission is the backstop for queries no
+  tier can save.
+- **Fragment retry** (``retry_count`` / ``retry_backoff_s``): a
+  fragment dispatch failing with a *retryable* error re-runs after
+  exponential backoff. Re-running a fragment re-executes its subtree —
+  the engine is deterministic and side-effect-free below the sink, so
+  a replay is safe (same property the capacity-overflow retries rely
+  on). Exhausted retries mark the error so ancestor dispatches don't
+  multiply the retry budget.
+- **Degradation**: a distributed query whose retries are exhausted on a
+  retryable error re-plans onto the single-device local pipeline
+  (``degrade_to_local``) — the last resort when the mesh itself is the
+  unreliable component.
+
+The active :class:`QueryContext` travels via a ``ContextVar`` so the
+driver loop and both executors see it without threading a parameter
+through every operator signature (and nested queries from event
+listeners get their own context).
+"""
+
+from __future__ import annotations
+
+import time
+from contextvars import ContextVar
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from presto_tpu.runtime.errors import (
+    ExceededTimeLimit,
+    ResourceExhausted,
+    is_retryable,
+)
+from presto_tpu.runtime.metrics import REGISTRY
+
+#: admission headroom over the device budget when no explicit
+#: ``query_max_memory_bytes`` is set: node estimates are loose upper
+#: shapes, and the grouped/streaming tiers keep true residency far
+#: below them — the default only rejects queries that would dwarf the
+#: device by any execution strategy
+DEFAULT_ADMISSION_HEADROOM = 64
+
+#: cap on one exponential-backoff sleep (a retry loop must never turn
+#: a deadline miss into a multi-minute hang)
+MAX_BACKOFF_S = 5.0
+
+_CURRENT: ContextVar[Optional["QueryContext"]] = ContextVar(
+    "presto_tpu_query_context", default=None
+)
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    count: int = 0
+    backoff_s: float = 0.01
+
+
+class QueryContext:
+    """Per-query lifecycle state visible at every execution boundary."""
+
+    def __init__(
+        self,
+        deadline_s: float | None = None,
+        retry: RetryPolicy = RetryPolicy(),
+        on_retry: Callable[[str, BaseException], None] | None = None,
+    ):
+        self.deadline = (
+            None if deadline_s is None else time.monotonic() + deadline_s
+        )
+        self.deadline_s = deadline_s
+        self.retry = retry
+        self.on_retry = on_retry
+        self.fragment_retries = 0
+
+    def check_deadline(self, where: str = "driver") -> None:
+        if self.deadline is not None and time.monotonic() > self.deadline:
+            REGISTRY.counter("query.deadline_exceeded").add()
+            raise ExceededTimeLimit(
+                f"query exceeded query_max_run_time="
+                f"{self.deadline_s}s (checked at {where})"
+            )
+
+    def record_retry(self, site: str, exc: BaseException) -> None:
+        self.fragment_retries += 1
+        REGISTRY.counter("fragment.retried").add()
+        if self.on_retry is not None:
+            self.on_retry(site, exc)
+
+
+def current_context() -> QueryContext | None:
+    return _CURRENT.get()
+
+
+def check_deadline(where: str = "driver") -> None:
+    """Boundary hook: enforce the active query deadline, if any."""
+    ctx = _CURRENT.get()
+    if ctx is not None:
+        ctx.check_deadline(where)
+
+
+def run_fragment(label: str, fn: Callable[[], object]):
+    """Execute one fragment dispatch under the active lifecycle: the
+    deadline is checked at entry and between attempts, and retryable
+    failures re-run with exponential backoff up to ``retry.count``
+    times. Exceptions that exhausted their retries here are tagged
+    (``_presto_retries_exhausted``) so every ancestor dispatch — whose
+    body re-invokes this fragment — re-raises instead of multiplying
+    the retry budget by the plan depth."""
+    ctx = _CURRENT.get()
+    if ctx is None:
+        return fn()
+    ctx.check_deadline(label)
+    attempts = max(0, ctx.retry.count)
+    for attempt in range(attempts + 1):
+        try:
+            return fn()
+        except Exception as e:
+            exhausted = getattr(e, "_presto_retries_exhausted", False)
+            if not is_retryable(e) or exhausted or attempt == attempts:
+                if is_retryable(e):
+                    e._presto_retries_exhausted = True
+                raise
+            ctx.record_retry(label, e)
+            sleep_s = min(ctx.retry.backoff_s * (2**attempt), MAX_BACKOFF_S)
+            if ctx.deadline is not None:
+                # never sleep past the deadline: the backoff must not
+                # extend the query beyond query_max_run_time
+                sleep_s = min(
+                    sleep_s, max(0.0, ctx.deadline - time.monotonic())
+                )
+            time.sleep(sleep_s)
+            ctx.check_deadline(label)
+    raise AssertionError("unreachable")  # pragma: no cover
+
+
+def peak_estimate_bytes(plan, catalog) -> tuple[int, str]:
+    """Max stats-estimated materialized bytes over all plan nodes (the
+    admission-control operand) and the offending node's type name."""
+    from presto_tpu.runtime.memory import estimate_node_bytes
+
+    worst, worst_node = 0, "?"
+
+    def walk(node):
+        nonlocal worst, worst_node
+        try:
+            est = estimate_node_bytes(node, catalog)
+        except Exception:  # noqa: BLE001 — stats gaps never block a query
+            est = 0
+        if est > worst:
+            worst, worst_node = est, type(node).__name__
+        for c in node.children:
+            walk(c)
+
+    walk(plan)
+    return worst, worst_node
+
+
+class QueryManager:
+    """Owns one session's query lifecycle mechanics (the Session keeps
+    the client surface and the QUEUED/RUNNING/FINISHED state machine;
+    this class owns admission, deadline scope, and degradation)."""
+
+    def __init__(self, session):
+        self.session = session
+
+    # -- admission ------------------------------------------------------
+    def admission_limit(self) -> int:
+        limit = self.session.prop("query_max_memory_bytes")
+        if limit is not None:
+            return int(limit)
+        from presto_tpu.runtime.memory import device_budget_bytes
+
+        return device_budget_bytes() * DEFAULT_ADMISSION_HEADROOM
+
+    def admit(self, plan) -> None:
+        """Reject (ResourceExhausted) before launch when the plan's
+        peak estimated materialization exceeds the admission limit."""
+        limit = self.admission_limit()
+        peak, node = peak_estimate_bytes(plan, self.session.catalog)
+        if peak > limit:
+            REGISTRY.counter("query.admission_rejected").add()
+            raise ResourceExhausted(
+                f"admission control: {node} is estimated to materialize "
+                f"{peak} bytes, over the limit of {limit} bytes (set the "
+                "query_max_memory_bytes session property to raise it)"
+            )
+
+    # -- execution scope ------------------------------------------------
+    def _context(self, info) -> QueryContext:
+        events = self.session.events
+        ctx = QueryContext(
+            deadline_s=self.session.prop("query_max_run_time"),
+            retry=RetryPolicy(
+                count=self.session.prop("retry_count"),
+                backoff_s=self.session.prop("retry_backoff_s"),
+            ),
+        )
+
+        def on_retry(site: str, exc: BaseException):
+            # ctx.fragment_retries is the single writer (record_retry
+            # increments it before calling here); info only mirrors it,
+            # so listeners see the up-to-date count on the QueryInfo
+            info.fragment_retries = ctx.fragment_retries
+            events.fragment_retried(info)
+
+        ctx.on_retry = on_retry
+        return ctx
+
+    def run_plan(self, executor, plan, info, recorder):
+        """Run a plan under the full lifecycle: admission, deadline
+        scope, fragment retry (enforced at the executors' dispatch
+        boundaries via the context), and distributed->local
+        degradation as the last resort."""
+        self.admit(plan)
+        ctx = self._context(info)
+        token = _CURRENT.set(ctx)
+        try:
+            try:
+                return executor.run(plan)
+            except Exception as e:
+                if (
+                    is_retryable(e)
+                    and getattr(executor, "mesh", None) is not None
+                    and self.session.prop("degrade_to_local")
+                ):
+                    return self._degrade(plan, info, recorder)
+                raise
+        finally:
+            info.fragment_retries = ctx.fragment_retries
+            _CURRENT.reset(token)
+
+    def _degrade(self, plan, info, recorder):
+        """Re-plan a failed distributed query onto the single-device
+        local pipeline (graceful degradation; the deadline keeps
+        running — the retry context stays installed, and if the local
+        run fails too, implicit ``__context__`` chaining preserves the
+        original distributed failure)."""
+        from presto_tpu.exec.local_planner import LocalExecutor
+
+        REGISTRY.counter("query.degraded_to_local").add()
+        info.degraded = True
+        local = LocalExecutor(
+            self.session.catalog,
+            join_build_budget=self.session.prop("join_build_budget_bytes"),
+            direct_group_limit=self.session.prop("direct_group_limit"),
+        )
+        if recorder is not None:
+            # stats from the failed distributed attempt must not leak
+            # into (or double-count in) the degraded run's QueryInfo —
+            # the same invariant query-level retries keep by making a
+            # fresh recorder per attempt
+            recorder.nodes.clear()
+        local.recorder = recorder
+        return local.run(plan)

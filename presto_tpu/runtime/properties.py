@@ -18,6 +18,7 @@ from dataclasses import dataclass
 from typing import Any, Callable, Optional
 
 from presto_tpu.exec.local_planner import DIRECT_LIMIT
+from presto_tpu.runtime.errors import UserError
 
 
 @dataclass(frozen=True)
@@ -46,7 +47,7 @@ class PropertyDef:
                     elif s in ("false", "0", "off", "no"):
                         v = False
                     else:
-                        raise ValueError(s)
+                        raise UserError(s)
                 else:
                     v = bool(value)
             elif self.py_type is int:
@@ -56,14 +57,14 @@ class PropertyDef:
             else:
                 v = self.py_type(value)
         except (TypeError, ValueError):
-            raise ValueError(
+            raise UserError(
                 f"session property {self.name}: cannot interpret "
                 f"{value!r} as {self.py_type.__name__}"
             ) from None
         if self.check is not None:
             problem = self.check(v)
             if problem:
-                raise ValueError(f"session property {self.name}: {problem}")
+                raise UserError(f"session property {self.name}: {problem}")
         return v
 
 
@@ -122,6 +123,50 @@ SESSION_PROPERTIES: dict[str, PropertyDef] = {
             _non_negative,
         ),
         PropertyDef(
+            "query_max_run_time", float, None,
+            "Per-query wall-clock deadline in seconds. Checked at every "
+            "fragment-dispatch and driver-loop boundary (a single "
+            "compiled XLA step runs to completion; the check fires "
+            "before the next one starts). Expiry raises "
+            "ExceededTimeLimit, recorded as error_code "
+            "EXCEEDED_TIME_LIMIT on the QueryInfo. None: no deadline.",
+            _positive,
+        ),
+        PropertyDef(
+            "query_max_memory_bytes", int, None,
+            "Admission-control limit: a query whose peak stats-"
+            "estimated node materialization "
+            "(runtime/memory.estimate_node_bytes) exceeds this is "
+            "rejected with ResourceExhausted BEFORE launch instead of "
+            "OOMing mid-flight. None: 64x the device budget (a loose "
+            "backstop — estimates are coarse and the grouped/streaming "
+            "tiers keep true residency far below them).",
+            _positive,
+        ),
+        PropertyDef(
+            "retry_count", int, 0,
+            "Fragment-level retries for RETRYABLE failures (injected "
+            "faults, transient device loss — see runtime/errors.py): a "
+            "failing fragment dispatch re-runs its subtree up to this "
+            "many extra times with exponential backoff. Deterministic "
+            "failures (user errors, resource walls, deadline expiry) "
+            "are never retried. 0 disables fragment retry.",
+            _non_negative,
+        ),
+        PropertyDef(
+            "retry_backoff_s", float, 0.01,
+            "Base of the exponential fragment-retry backoff: attempt k "
+            "sleeps retry_backoff_s * 2^k seconds (capped at 5s).",
+            _non_negative,
+        ),
+        PropertyDef(
+            "degrade_to_local", bool, True,
+            "Graceful degradation: a distributed query that fails with "
+            "a retryable error after its fragment retries are exhausted "
+            "re-plans onto the single-device local pipeline as a last "
+            "resort (QueryInfo.degraded marks it).",
+        ),
+        PropertyDef(
             "profile_dir", str, None,
             "When set, every query executes under jax.profiler.trace "
             "writing an XLA op-level timeline (TensorBoard/xprof) to "
@@ -146,7 +191,7 @@ def validate_properties(props: dict) -> dict:
         d = SESSION_PROPERTIES.get(name)
         if d is None:
             known = ", ".join(sorted(SESSION_PROPERTIES))
-            raise ValueError(
+            raise UserError(
                 f"unknown session property {name!r} (known: {known})"
             )
         out[name] = d.coerce(value)

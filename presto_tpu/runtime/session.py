@@ -25,7 +25,9 @@ from presto_tpu.exec.local_planner import LocalExecutor
 from presto_tpu.plan.catalog import Catalog
 from presto_tpu.plan.nodes import PlanNode, plan_tree_str
 from presto_tpu.plan.prune import prune
+from presto_tpu.runtime.errors import UserError, error_code, is_retryable
 from presto_tpu.runtime.events import EventDispatcher
+from presto_tpu.runtime.lifecycle import QueryManager
 from presto_tpu.runtime.metrics import REGISTRY
 from presto_tpu.runtime.stats import (
     QueryInfo,
@@ -62,6 +64,9 @@ class Session:
         self.trace_token = trace_token
         self.events = EventDispatcher()
         self.query_history: list[QueryInfo] = []
+        #: lifecycle mechanics: admission control, deadlines, fragment
+        #: retry, distributed->local degradation (runtime/lifecycle.py)
+        self.query_manager = QueryManager(self)
 
     # ------------------------------------------------------------------
     def prop(self, name: str):
@@ -146,7 +151,7 @@ class Session:
 
         ast = parse(sql)
         if isinstance(ast, (A.CreateTableAs, A.InsertInto, A.DropTable)):
-            raise ValueError(
+            raise UserError(
                 "DDL statements execute via Session.sql(), not plan()/explain()"
             )
         logical = self.analyzer.analyze(ast)
@@ -220,24 +225,24 @@ class Session:
             if owner == "memory":
                 mem.drop_table(stmt.name)
             elif owner is not None:
-                raise ValueError(
+                raise UserError(
                     f"cannot drop {stmt.name}: it belongs to the read-only "
                     f"{owner!r} catalog"
                 )
             elif not stmt.if_exists:
-                raise ValueError(f"table not found in memory catalog: {stmt.name}")
+                raise UserError(f"table not found in memory catalog: {stmt.name}")
             self.catalog.invalidate(stmt.name)
             return pd.DataFrame({"dropped": [stmt.name]})
         # existence checks BEFORE running the (possibly expensive) query
         if isinstance(stmt, A.CreateTableAs) and owner is not None:
-            raise ValueError(
+            raise UserError(
                 f"table already exists in catalog {owner!r}: {stmt.name}"
             )
         if isinstance(stmt, A.InsertInto):
             if owner is None:
-                raise ValueError(f"table not found: {stmt.name}")
+                raise UserError(f"table not found: {stmt.name}")
             if owner != "memory":
-                raise ValueError(
+                raise UserError(
                     f"cannot insert into {stmt.name}: the {owner!r} catalog "
                     "is read-only"
                 )
@@ -288,14 +293,18 @@ class Session:
         executor.recorder = recorder
         try:
             with REGISTRY.timer("query.execution").time(), self._profiled():
-                df = executor.run(plan)
+                df = self.query_manager.run_plan(executor, plan, info,
+                                                 recorder)
             info.state = "FINISHED"
             info.output_rows = len(df)
             REGISTRY.counter("query.completed").add()
         except Exception as e:
             info.state = "FAILED"
             info.error = f"{type(e).__name__}: {e}"
+            info.error_code = error_code(e)
+            info.retryable = is_retryable(e)
             REGISTRY.counter("query.failed").add()
+            self.events.query_failed(info)
             raise
         finally:
             info.finished_at = time.time()

@@ -79,6 +79,14 @@ class QueryInfo:
     started_at: Optional[float] = None
     finished_at: Optional[float] = None
     error: Optional[str] = None
+    #: taxonomy code (runtime/errors.py), set on FAILED transitions
+    error_code: Optional[str] = None
+    #: retry class of the failure (None while not failed)
+    retryable: Optional[bool] = None
+    #: fragment-level retries performed during execution
+    fragment_retries: int = 0
+    #: True when a failed distributed run degraded to the local pipeline
+    degraded: bool = False
     output_rows: int = -1
     node_stats: list = field(default_factory=list)  # list[NodeStats.to_dict()]
 
@@ -101,6 +109,10 @@ class QueryInfo:
                 "finishedAt": self.finished_at,
                 "elapsedS": round(self.elapsed_s, 6),
                 "error": self.error,
+                "errorCode": self.error_code,
+                "retryable": self.retryable,
+                "fragmentRetries": self.fragment_retries,
+                "degraded": self.degraded,
                 "outputRows": self.output_rows,
                 "nodeStats": self.node_stats,
             }

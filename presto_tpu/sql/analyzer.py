@@ -34,6 +34,7 @@ from presto_tpu.exec.operators import AggSpec, SortKey
 from presto_tpu.expr import Call, Expr, InputRef, Literal, Unbound, result_type, substr_fn
 from presto_tpu.plan import nodes as N
 from presto_tpu.plan.catalog import Catalog, TableMeta
+from presto_tpu.runtime.errors import UserError
 from presto_tpu.sql import ast as A
 from presto_tpu.types import (
     BIGINT,
@@ -54,8 +55,9 @@ _CMP_OPS = {"=": "eq", "<>": "ne", "<": "lt", "<=": "le", ">": "gt", ">=": "ge"}
 _ARITH_OPS = {"+": "add", "-": "sub", "*": "mul", "/": "div", "%": "mod"}
 
 
-class AnalysisError(ValueError):
-    pass
+class AnalysisError(UserError):
+    """Semantic errors — unknown tables/columns, type mismatches
+    (taxonomy: USER_ERROR; ValueError ancestry preserved)."""
 
 
 @dataclass(frozen=True)

@@ -12,6 +12,7 @@ from __future__ import annotations
 import dataclasses
 
 from presto_tpu.sql import ast as A
+from presto_tpu.runtime.errors import UserError
 from presto_tpu.sql.lexer import Token, tokenize
 
 
@@ -19,7 +20,10 @@ from presto_tpu.sql.lexer import Token, tokenize
 _SET_OP_WORDS = ("intersect", "except")
 
 
-class ParseError(ValueError):
+class ParseError(UserError):
+    """Syntax errors (taxonomy: USER_ERROR via UserError, which keeps
+    the pre-taxonomy ValueError ancestry)."""
+
     def __init__(self, msg: str, tok: Token):
         super().__init__(f"{msg} at line {tok.line}:{tok.col} (near {tok.text!r})")
 

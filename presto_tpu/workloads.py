@@ -167,7 +167,7 @@ def q1_distributed_step(mesh):
     the axes — the degenerate (6-group) case of the
     partitioned-exchange final aggregation.
     """
-    from jax import shard_map
+    from presto_tpu.parallel.mesh import shard_map
     from jax.sharding import PartitionSpec as P
 
     from presto_tpu.parallel.mesh import worker_axes

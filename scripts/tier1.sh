@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# Tier-1 verify — the checked-in form of the ROADMAP.md command.
+#
+# Two gates, cheapest first:
+#   1. `python -m compileall` over the package: a syntax/static gate
+#      that fails in seconds instead of letting a typo ride to the
+#      middle of the pytest run.
+#   2. The tier-1 pytest suite on the CPU backend (virtual-device
+#      distributed tests included; `slow` marks excluded), with the
+#      same flags and timeout the driver uses.
+#
+# Exit status is the pytest status (or the compileall status when the
+# static gate fails); DOTS_PASSED echoes the passed-test count the
+# driver greps for.
+set -o pipefail
+cd "$(dirname "$0")/.."
+
+python -m compileall -q presto_tpu || exit $?
+
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+  -m 'not slow' --continue-on-collection-errors \
+  -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+exit $rc

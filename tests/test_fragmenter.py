@@ -51,6 +51,16 @@ def test_upper_bound_is_sound_not_estimated(session):
     assert upper_bound_rows(plan, session.catalog) == rows
 
 
+def test_keyless_aggregate_bounds_at_least_one_row(session):
+    # a keyless aggregate emits exactly one row even over an EMPTY
+    # input, so a child bound of 0 (limit 0) must not propagate — a
+    # 0-row bound would let a consumer size a buffer with no room for
+    # the row that always arrives
+    plan = session.plan(
+        "select count(*) c from (select * from nation limit 0) t")
+    assert upper_bound_rows(plan, session.catalog) == 1
+
+
 def test_unique_join_bounds_by_probe_side(session):
     plan = session.plan(
         "select count(*) from lineitem join orders on l_orderkey = o_orderkey")
@@ -103,6 +113,26 @@ def test_large_build_is_auto(session):
                        broadcast_limit=10,  # force: orders exceed this
                        join_build_budget=1 << 30)
     assert fp.join_strategy[id(join)] == "auto"
+
+
+def test_unproven_broadcast_renders_tentative(session):
+    """A join whose row UB fits the broadcast limit but whose byte
+    budget is NOT plan-time proven can still spill at runtime: EXPLAIN
+    must render it dist=broadcast? (tentative), not dist=broadcast."""
+    plan = session.plan(
+        "select count(*) from lineitem join orders on l_orderkey = o_orderkey")
+    join = _the_join(plan)
+    fp = fragment_plan(plan, session.catalog, broadcast_limit=1 << 21,
+                       join_build_budget=1)  # nothing fits one byte
+    assert fp.join_strategy[id(join)] == "broadcast"
+    assert not fp.join_fits_budget[id(join)]
+    assert "dist=broadcast?" in fp.render()
+    # the proven case still renders plainly
+    fp2 = fragment_plan(plan, session.catalog, broadcast_limit=1 << 21,
+                        join_build_budget=1 << 40)
+    assert fp2.join_fits_budget[id(join)]
+    out2 = fp2.render()
+    assert "dist=broadcast" in out2 and "dist=broadcast?" not in out2
 
 
 def test_render_mentions_every_fragment_once(session):

@@ -88,6 +88,25 @@ def test_overflow_guard_fires(rng):
     assert bool(got["value_overflow"])
 
 
+def test_overflow_guard_fires_on_group_domain(rng):
+    """An out-of-domain returnflag/linestatus code must flag loudly:
+    gid = rf*2 + ls is neither clipped nor range-checked, so without
+    the guard the row would silently vanish from every group AND from
+    count_order (the generic route clips into the domain instead)."""
+    b = _narrow_batch(rng)
+    rf = np.array(b["l_returnflag"].data)
+    rf[3] = 5  # gid = 10 >= G: outside every group
+    ship = np.array(b["l_shipdate"].data)
+    ship[3] = 9100  # under the cutoff: the row must contribute
+    cols = dict(b.columns)
+    from presto_tpu.types import varchar
+
+    cols["l_returnflag"] = Column(jnp.asarray(rf), None, varchar())
+    cols["l_shipdate"] = Column(jnp.asarray(ship), None, DATE)
+    got = pallas_q1.q1_step(Batch(cols, b.live))
+    assert bool(got["value_overflow"])
+
+
 def test_eligibility():
     rng = np.random.default_rng(0)
     b = _narrow_batch(rng)
