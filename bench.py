@@ -342,6 +342,58 @@ def put_table(table, arrays, dev, tile: int = 1, narrow: bool = False):
     return batch, n
 
 
+def bench_cache_warm(extra: dict) -> None:
+    """Engine-level cold-vs-warm (cache subsystem, ISSUE-2): one small
+    TPC-H aggregation twice through a Session, reporting the warm run's
+    cache hit-rate and speedup in ``extra``. A second session with the
+    result cache disabled measures the executable-cache tier alone —
+    the XLA trace+compile the warm path skips."""
+    import time as _t
+
+    from presto_tpu.connectors.tpch import TpchConnector
+    from presto_tpu.runtime.metrics import REGISTRY
+    from presto_tpu.runtime.session import Session
+
+    conn = TpchConnector(sf=0.001)
+    q = ("select l_returnflag, count(*) c, sum(l_quantity) q "
+         "from lineitem group by l_returnflag order by l_returnflag")
+
+    def snap():
+        return REGISTRY.snapshot()
+
+    def delta(a, b, name):
+        return b.get(name, 0.0) - a.get(name, 0.0)
+
+    s = Session({"tpch": conn})
+    t0 = _t.perf_counter()
+    s.sql(q)
+    cold_s = _t.perf_counter() - t0
+    before = snap()
+    t0 = _t.perf_counter()
+    s.sql(q)
+    warm_s = _t.perf_counter() - t0
+    after = snap()
+    hits = delta(before, after, "result_cache.hit") + delta(
+        before, after, "exec_cache.hit")
+    misses = delta(before, after, "result_cache.miss") + delta(
+        before, after, "exec_cache.miss")
+    extra["cache_warm_hit_rate"] = round(
+        hits / (hits + misses), 3) if hits + misses else 0.0
+    extra["cache_warm_speedup"] = (
+        round(cold_s / warm_s, 1) if warm_s > 0 else None)
+    # executable-cache tier alone (result cache off, fresh session)
+    s2 = Session({"tpch": conn}, properties={"result_cache_enabled": False})
+    before = snap()
+    s2.sql(q)
+    after = snap()
+    eh = delta(before, after, "exec_cache.hit")
+    em = delta(before, after, "exec_cache.miss")
+    extra["exec_cache_warm_hit_rate"] = round(
+        eh / (eh + em), 3) if eh + em else 0.0
+    extra["exec_cache_warm_retraces"] = int(delta(before, after,
+                                                 "exec.traces"))
+
+
 def bench_q1(li_batch, n_rows, li_df):
     import jax
     import numpy as np
@@ -849,6 +901,10 @@ def _run(sf: float, stream_mode: bool) -> None:
                         extra["ici_shuffle_gbps"] = round(bench_shuffle(devices), 2)
                     else:
                         extra["note"] = "shuffle skipped: budget exhausted"
+                if _remaining() > 15:
+                    # cache subsystem hit-rate (tiny SF; a few compiles)
+                    _phase("extras: cache cold-vs-warm")
+                    bench_cache_warm(extra)
                 _phase("extras done")
             except _ExtrasTimeout:
                 extra["note"] = "remaining extras skipped: wall-clock budget exhausted"

@@ -1,0 +1,53 @@
+"""Query caching subsystem.
+
+Reference parity: the reuse tier Presto grows piecemeal — prepared-
+statement plan reuse, fragment-result caching (Alluxio/RaptorX), and
+the worker-side expression-compiler caches keyed by canonical
+RowExpression [SURVEY §2.1 session row; reference tree unavailable] —
+collapsed into three explicit layers for the single-controller engine.
+"Partial Partial Aggregates" (PAPERS.md) motivates the same move at
+the subplan level: work recurring across overlapping queries should be
+paid once.
+
+Three layers, coarse to fine:
+
+- :mod:`presto_tpu.cache.fingerprint` — canonical content-based hashes
+  of plans, fragments, and expressions. Everything below keys on
+  these; nothing keys on object identity (the ``id()``-keyed caches
+  this subsystem replaces missed equal-but-distinct plans and could
+  never survive a query).
+- :mod:`presto_tpu.cache.exec_cache` — a bounded LRU of *jitted step
+  functions* keyed by step-config fingerprint. The engine builds
+  operators per query (per-query state must not be shared), but the
+  traced computation is pure config: reusing the jitted callable lets
+  ``jax.jit``'s own signature cache skip trace+compile entirely on a
+  repeated query.
+- :mod:`presto_tpu.cache.result_cache` — a byte-budgeted LRU of final
+  query results keyed by plan fingerprint, invalidated through the
+  catalog's per-table version counters (bumped on CTAS/DROP/INSERT).
+
+Plus :mod:`presto_tpu.cache.stats_cache`: cross-query reuse of the
+runtime join-key min/max readbacks (a device round trip per key), the
+promoted form of the per-call ``_minmax_cache`` in ``exec/joinkeys.py``.
+"""
+
+from presto_tpu.cache.exec_cache import EXEC_CACHE, ExecutableCache
+from presto_tpu.cache.fingerprint import (
+    expr_fingerprint,
+    fingerprint,
+    plan_fingerprint,
+    referenced_tables,
+    try_fingerprint,
+)
+from presto_tpu.cache.result_cache import ResultCache
+
+__all__ = [
+    "EXEC_CACHE",
+    "ExecutableCache",
+    "ResultCache",
+    "expr_fingerprint",
+    "fingerprint",
+    "plan_fingerprint",
+    "referenced_tables",
+    "try_fingerprint",
+]

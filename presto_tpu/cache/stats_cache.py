@@ -1,0 +1,93 @@
+"""Cross-query runtime-statistics cache (join-key min/max readbacks).
+
+The multi-key join planner (``exec/joinkeys.py``) needs tight (min,
+max) bounds per key to bit-pack several keys into one int64. When
+connector stats do not cover a key it falls back to a *runtime* probe:
+a device reduction plus host readback per (side, key) — one of the few
+synchronous device round trips in the whole plan phase. The seed kept
+a per-call dict keyed by ``id(expr)``, so equal-but-distinct exprs
+missed and nothing survived the call, let alone the query.
+
+This cache promotes those readbacks to cross-query scope, keyed by
+CONTENT: (catalog token, subtree fingerprint, key-expr fingerprint,
+referenced-table versions). The subtree fingerprint pins exactly which
+rows flowed into the reduction (scan predicates and joins included);
+the table versions invalidate on DDL; the catalog token isolates
+sessions (two sessions' memory tables may share names and versions
+while holding different data).
+
+Bounded FIFO-ish LRU; values are two ints, so the bound is about
+entry-count hygiene, not bytes. Counters: ``stats_cache.hit`` /
+``stats_cache.miss``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import OrderedDict
+from typing import Callable, Optional
+
+from presto_tpu.cache.fingerprint import referenced_tables, try_fingerprint
+from presto_tpu.runtime.metrics import REGISTRY
+
+MAX_ENTRIES = 4096
+
+_entries: "OrderedDict[str, tuple[int, int]]" = OrderedDict()
+
+
+def _has_unbound(obj) -> bool:
+    """Does the subtree contain an Unbound scalar-subquery slot? Those
+    are bound from a SIBLING subplan at execution, so the rows flowing
+    into a probe depend on values the subtree fingerprint cannot see —
+    caching across bindings would reuse stale min/max bounds and
+    silently mis-pack join keys."""
+    from presto_tpu.expr import Unbound
+
+    if isinstance(obj, Unbound):
+        return True
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return any(
+            _has_unbound(getattr(obj, f.name))
+            for f in dataclasses.fields(obj)
+        )
+    if isinstance(obj, (tuple, list)):
+        return any(_has_unbound(x) for x in obj)
+    return False
+
+
+def minmax_key(catalog, node, key_expr) -> Optional[str]:
+    """Content key for one runtime min/max probe; None = uncacheable
+    (the caller then probes per query, the seed behavior)."""
+    if _has_unbound(node) or _has_unbound(key_expr):
+        return None
+    try:
+        versions = tuple(
+            (t, catalog.version(t)) for _c, t in referenced_tables(node)
+        )
+    except Exception:
+        return None
+    return try_fingerprint(
+        ("minmax", catalog.cache_token(), node, key_expr, versions)
+    )
+
+
+def cached_minmax(key: Optional[str],
+                  compute: Callable[[], "tuple[int, int]"]):
+    """The (min, max) for ``key``, computing (and storing) on miss."""
+    if key is not None:
+        hit = _entries.get(key)
+        if hit is not None:
+            _entries.move_to_end(key)
+            REGISTRY.counter("stats_cache.hit").add()
+            return hit
+    REGISTRY.counter("stats_cache.miss").add()
+    value = compute()
+    if key is not None:
+        _entries[key] = value
+        while len(_entries) > MAX_ENTRIES:
+            _entries.popitem(last=False)
+    return value
+
+
+def clear() -> None:
+    _entries.clear()

@@ -122,6 +122,35 @@ class MemoryConnector:
     def __init__(self, units_per_split: int | None = None):
         self.units_per_split = units_per_split or self.DEFAULT_UNITS_PER_SPLIT
         self._tables: dict[str, dict] = {}
+        #: fired with the table name on EVERY write-path mutation
+        #: (CTAS store, INSERT commit, DROP). The session wires
+        #: ``Catalog.invalidate`` here so metadata- and result-cache
+        #: invalidation cannot be bypassed by a direct Python-API
+        #: write that skips the SQL DDL path. Held weakly: a connector
+        #: shared across many short-lived sessions must not pin each
+        #: dead session's catalog (and its result-cache frames).
+        self._ddl_listeners: list = []
+
+    def add_ddl_listener(self, cb) -> None:
+        import weakref
+
+        # bound methods are held weakly — a connector shared across
+        # sessions must not pin dead sessions' catalogs. Anything else
+        # (lambda, local closure) is held strongly: a weakref to it
+        # would die at the next GC and invalidation would silently stop.
+        if hasattr(cb, "__self__"):
+            self._ddl_listeners.append(weakref.WeakMethod(cb))
+        else:
+            self._ddl_listeners.append(lambda _cb=cb: _cb)
+
+    def _notify_ddl(self, table: str) -> None:
+        live = []
+        for ref in self._ddl_listeners:
+            cb = ref()
+            if cb is not None:
+                live.append(ref)
+                cb(table)
+        self._ddl_listeners = live
 
     # ---- write path -----------------------------------------------------
     def create_table(self, table: str, df) -> int:
@@ -175,6 +204,7 @@ class MemoryConnector:
 
     def drop_table(self, table: str) -> None:
         del self._tables[table]
+        self._notify_ddl(table)
 
     def _store(self, table: str, df) -> None:
         cols: dict[str, np.ndarray] = {}
@@ -194,6 +224,7 @@ class MemoryConnector:
             "arrays": cols, "types": types, "dicts": dicts, "rows": len(df),
             "df": df.reset_index(drop=True),
         }
+        self._notify_ddl(table)
 
     # ---- metadata -------------------------------------------------------
     def tables(self) -> Sequence[str]:

@@ -53,6 +53,11 @@ class SystemConnector:
 
     name = "system"
 
+    #: system tables reflect live engine state — results are never
+    #: reusable, so the result cache skips any plan that scans them
+    #: (cache/fingerprint.plan_is_deterministic)
+    volatile = True
+
     def __init__(self, session):
         self._session = session
 

@@ -187,11 +187,16 @@ class DistributedExecutor:
 
             join_build_budget = device_budget_bytes() // 4
         self.join_build_budget = join_build_budget
-        #: compiled-step caches for grouped execution: every bucket pass
-        #: shares one XLA program per distinct capacity tuple (SURVEY
-        #: §7.4 #6 — compile economy under retry doubling)
-        self._repart_step_cache: dict = {}
-        self._agg_step_cache: dict = {}
+        #: compiled fragment steps live in the process-wide executable
+        #: cache keyed by CONTENT (exprs + capacities + mesh layout) —
+        #: grouped-execution bucket passes share one XLA program per
+        #: distinct capacity tuple (SURVEY §7.4 #6), and repeated
+        #: queries across executors skip trace+compile entirely
+        #: (cache/exec_cache.py; the seed's per-executor id()-keyed
+        #: dicts could never survive the query)
+        from presto_tpu.cache.fingerprint import _mesh_shape
+
+        self._mesh_fp = _mesh_shape(mesh)
         #: mesh axis names carrying the worker role: ("workers",) on a
         #: 1-D mesh, ("dcn", "ici") on a multi-host mesh — every
         #: collective/spec below uses the tuple
@@ -492,16 +497,21 @@ class DistributedExecutor:
         mg_partial = batch_capacity(cap_dev, minimum=64)
         quota = batch_capacity(-(-mg_partial // Pn), minimum=64)
 
+        from presto_tpu.cache.exec_cache import EXEC_CACHE
+
         mg_final = batch_capacity(Pn * quota, minimum=64)
         for _ in range(MAX_RETRIES):
-            # cached per (plan lists, capacities): grouped-execution
-            # bucket passes reuse one compiled step (SURVEY §7.4 #6)
-            ck = (id(keys), id(aggs), id(pax), mg_partial, quota, mg_final)
-            step = self._agg_step_cache.get(ck)
-            if step is None:
-                step = self._make_agg_step(keys, aggs, pax, mg_partial, quota,
-                                           mg_final)
-                self._agg_step_cache[ck] = step
+            # content-keyed in the executable cache: grouped-execution
+            # bucket passes share one XLA program per capacity tuple
+            # (SURVEY §7.4 #6), and a repeated query reuses the step
+            # across executors (cache/exec_cache.py)
+            mgf = mg_final
+            step = EXEC_CACHE.get_or_build(
+                EXEC_CACHE.key_of("dist_agg", keys, aggs, pax, mg_partial,
+                                  quota, mgf, self._mesh_fp),
+                lambda: self._make_agg_step(keys, aggs, pax, mg_partial,
+                                            quota, mgf),
+            )
             out, overflow = step(b)
             if not bool(overflow):
                 return DistBatch(out, sharded=True)
@@ -511,7 +521,12 @@ class DistributedExecutor:
     def _make_agg_step(self, keys, aggs, pax, mg: int, quota: int, mgf: int):
         Pn = self.nworkers
         mesh = self.mesh
+        # the step lives in the process-wide executable cache: close
+        # over the axes tuple, never over ``self`` (a cached step must
+        # not pin this executor and its per-query state)
+        axes = self.axes
 
+        from presto_tpu.cache.exec_cache import trace_probe
         from presto_tpu.exec.operators import null_safe_key
 
         def partial_phase(b: Batch):
@@ -592,17 +607,18 @@ class DistributedExecutor:
 
         @partial(
             shard_map, mesh=mesh,
-            in_specs=(P(self.axes),), out_specs=(P(self.axes), P()),
+            in_specs=(P(axes),), out_specs=(P(axes), P()),
             check_vma=False,
         )
         def step(b: Batch):
+            trace_probe()
             part, ovf1 = partial_phase(b)
             key_sort = [c for n, _ in keys for c in _sortables(part[n])]
             pids = partition_ids(key_sort, Pn)
             exch, ovf2 = exchange_multiround(part, pids, Pn, quota, mgf,
-                                             axes=self.axes)
+                                             axes=axes)
             out, ovf3 = final_phase(exch)
-            return out, any_flag(ovf1 | ovf2 | ovf3, self.axes)
+            return out, any_flag(ovf1 | ovf2 | ovf3, axes)
 
         return jax.jit(step)
 
@@ -697,19 +713,29 @@ class DistributedExecutor:
         extra = extra.select(names)
         if not d.sharded:
             return DistBatch(concat_batches([d.batch, extra]), sharded=False)
+        from presto_tpu.cache.exec_cache import EXEC_CACHE
+
         Pn = self.nworkers
         extra = _pad_rows(extra, -(-extra.capacity // Pn) * Pn)
         extra = self._shard(extra)
+        mesh, axes = self.mesh, self.axes
 
-        @partial(
-            shard_map, mesh=self.mesh,
-            in_specs=(P(self.axes), P(self.axes)), out_specs=P(self.axes),
-            check_vma=False,
+        def make_step():
+            @partial(
+                shard_map, mesh=mesh,
+                in_specs=(P(axes), P(axes)), out_specs=P(axes),
+                check_vma=False,
+            )
+            def step(a: Batch, b: Batch):
+                return concat_batches([a.select(names), b])
+
+            return jax.jit(step)
+
+        step = EXEC_CACHE.get_or_build(
+            EXEC_CACHE.key_of("dist_concat2", tuple(names), self._mesh_fp),
+            make_step,
         )
-        def step(a: Batch, b: Batch):
-            return concat_batches([a.select(names), b])
-
-        return DistBatch(jax.jit(step)(d.batch, extra), sharded=True)
+        return DistBatch(step(d.batch, extra), sharded=True)
 
     def _broadcast_join(self, node, left: DistBatch, right: DistBatch,
                         lkey, rkey, verify=(), rows_hint=None):
@@ -822,22 +848,29 @@ class DistributedExecutor:
         if expand:
             out_cap = batch_capacity(max(Pn * lquota, 1024))
 
+        from presto_tpu.cache.exec_cache import EXEC_CACHE
+
         # skew-aware: wire quotas stay fixed (one round when balanced);
         # retries double the receive/build/output capacities only
         for _ in range(MAX_RETRIES):
-            # cache the compiled step per (plan node, key exprs, caps):
-            # grouped execution replays the same join across buckets and
-            # every bucket with the same capacity tuple reuses one XLA
-            # program (SURVEY §7.4 #6)
-            ck = (id(node), id(lkey), id(rkey), lquota, rquota, lrecv,
-                  rrecv, out_cap, id(verify) if verify else 0)
-            step = self._repart_step_cache.get(ck)
-            if step is None:
-                step = self._make_repartition_join_step(
-                    node, lkey, rkey, lquota, rquota, lrecv, rrecv, out_cap,
-                    verify,
-                )
-                self._repart_step_cache[ck] = step
+            # content-keyed in the executable cache: grouped execution
+            # replays the same join across buckets and every bucket
+            # with the same capacity tuple reuses one XLA program
+            # (SURVEY §7.4 #6); repeated queries skip trace+compile.
+            # The key carries every value the closure bakes in — key
+            # exprs, verify pairs, build outputs, kind/unique, all
+            # capacities, and the mesh layout.
+            caps = (lquota, rquota, lrecv, rrecv, out_cap)
+            step = EXEC_CACHE.get_or_build(
+                EXEC_CACHE.key_of(
+                    "dist_repart_join", lkey, rkey, tuple(verify),
+                    tuple(node.output_right), node.kind, node.unique,
+                    caps, self._mesh_fp,
+                ),
+                lambda: self._make_repartition_join_step(
+                    node, lkey, rkey, *caps, verify,
+                ),
+            )
             out, overflow, flags = step(left.batch, right.batch)
             long_runs, sentinel = (bool(x) for x in np.asarray(flags))
             if long_runs:
@@ -872,7 +905,10 @@ class DistributedExecutor:
         outs = [BuildOutput(n, n) for n in node.output_right]
         kind = node.kind
         unique = node.unique
+        # cached step: close over the axes tuple, not ``self``
+        axes = self.axes
 
+        from presto_tpu.cache.exec_cache import trace_probe
         from presto_tpu.exec.joins import full_tail_batch
 
         def full_tail_local(le: Batch, re: Batch, flags) -> Batch:
@@ -883,11 +919,12 @@ class DistributedExecutor:
 
         @partial(
             shard_map, mesh=self.mesh,
-            in_specs=(P(self.axes), P(self.axes)),
-            out_specs=(P(self.axes), P(), P()),
+            in_specs=(P(axes), P(axes)),
+            out_specs=(P(axes), P(), P()),
             check_vma=False,
         )
         def step(lb: Batch, rb: Batch):
+            trace_probe()
             from presto_tpu.exec.operators import concat_batches
 
             lv = evaluate(lkey, lb)
@@ -895,9 +932,9 @@ class DistributedExecutor:
             lpids = partition_ids([lv.data.astype(jnp.int64)], Pn)
             rpids = partition_ids([rv.data.astype(jnp.int64)], Pn)
             le, ovf1 = exchange_multiround(lb, lpids, Pn, lquota, lrecv,
-                                           axes=self.axes)
+                                           axes=axes)
             re, ovf2 = exchange_multiround(rb, rpids, Pn, rquota, rrecv,
-                                           axes=self.axes)
+                                           axes=axes)
             bv = evaluate(rkey, re)
             build_cap = re.capacity
             side = build_lookup(bv.data, re.live & bv.valid, build_cap)
@@ -916,12 +953,12 @@ class DistributedExecutor:
             # refusal flags: [0] hash-collision run exceeds the verified
             # probe window, [1] a live build key equals the reserved
             # int64 dead-slot sentinel (host raises per flag)
-            longrun = jnp.stack([any_flag(longrun, self.axes),
-                                 any_flag(side.sentinel_hit, self.axes)])
+            longrun = jnp.stack([any_flag(longrun, axes),
+                                 any_flag(side.sentinel_hit, axes)])
             if kind in ("semi", "anti"):
                 exists = probe_exists(side, pv.data, pvalid)
                 keep = exists if kind == "semi" else le.live & ~exists
-                return (le.with_live(le.live & keep), any_flag(ovf, self.axes),
+                return (le.with_live(le.live & keep), any_flag(ovf, axes),
                         longrun)
             if unique:
                 if verify:
@@ -939,7 +976,7 @@ class DistributedExecutor:
                 live = le.live & res.matched if kind == "inner" else le.live
                 pout = Batch(cols, live)
                 if kind != "full":
-                    return pout, any_flag(ovf, self.axes), longrun
+                    return pout, any_flag(ovf, axes), longrun
                 flags = (
                     jnp.zeros(re.capacity, jnp.bool_)
                     .at[jnp.where(res.matched, res.build_row, re.capacity)]
@@ -948,7 +985,7 @@ class DistributedExecutor:
                 tail = full_tail_local(le, re, flags)
                 return (
                     concat_batches([pout, tail]),
-                    any_flag(ovf, self.axes),
+                    any_flag(ovf, axes),
                     longrun,
                 )
             res = probe_expand(
@@ -975,7 +1012,7 @@ class DistributedExecutor:
                 )
             pout = Batch(cols, live)
             if kind != "full":
-                return pout, any_flag(ovf | res.overflow, self.axes), longrun
+                return pout, any_flag(ovf | res.overflow, axes), longrun
             flags = (
                 jnp.zeros(re.capacity, jnp.bool_)
                 .at[res.build_row]
@@ -984,7 +1021,7 @@ class DistributedExecutor:
             tail = full_tail_local(le, re, flags)
             return (
                 concat_batches([pout, tail]),
-                any_flag(ovf | res.overflow, self.axes),
+                any_flag(ovf | res.overflow, axes),
                 longrun,
             )
 
@@ -1014,12 +1051,21 @@ class DistributedExecutor:
             )
         b = d.batch
 
-        @jax.jit
-        def bids_step(bb: Batch):
-            v = evaluate(key, bb)
-            data = jnp.where(bb.live & v.valid, v.data.astype(jnp.int64), 0)
-            return bucket_ids([data], nbuckets)
+        from presto_tpu.cache.exec_cache import EXEC_CACHE
 
+        def make_bids_step():
+            @jax.jit
+            def bids_step(bb: Batch):
+                v = evaluate(key, bb)
+                data = jnp.where(bb.live & v.valid, v.data.astype(jnp.int64), 0)
+                return bucket_ids([data], nbuckets)
+
+            return bids_step
+
+        bids_step = EXEC_CACHE.get_or_build(
+            EXEC_CACHE.key_of("dist_spill_bids", key, nbuckets),
+            make_bids_step,
+        )
         bids = np.asarray(bids_step(b))
         live = np.asarray(b.live)
         cols = {
@@ -1080,15 +1126,27 @@ class DistributedExecutor:
         if len(parts) == 1:
             return DistBatch(parts[0], sharded=True)
 
-        @partial(
-            shard_map, mesh=self.mesh,
-            in_specs=tuple(P(self.axes) for _ in parts),
-            out_specs=P(self.axes), check_vma=False,
-        )
-        def step(*bs):
-            return concat_batches(list(bs))
+        from presto_tpu.cache.exec_cache import EXEC_CACHE
 
-        out = jax.jit(step)(*parts)
+        mesh, axes, nparts = self.mesh, self.axes, len(parts)
+
+        def make_step():
+            @partial(
+                shard_map, mesh=mesh,
+                in_specs=tuple(P(axes) for _ in range(nparts)),
+                out_specs=P(axes), check_vma=False,
+            )
+            def step(*bs):
+                return concat_batches(list(bs))
+
+            return jax.jit(step)
+
+        step = EXEC_CACHE.get_or_build(
+            EXEC_CACHE.key_of("dist_concat_many", tuple(names), nparts,
+                              self._mesh_fp),
+            make_step,
+        )
+        out = step(*parts)
         cols = {}
         for n in names:
             dic = next(
@@ -1155,35 +1213,52 @@ class DistributedExecutor:
                 for c in (s.astype(jnp.int64) for s in _sortables(v))
             ]
 
+        from presto_tpu.cache.exec_cache import EXEC_CACHE
+
+        mesh, axes = self.mesh, self.axes
+
         # ONE dispatch computes per-row bucket ids and the per-device
         # per-bucket live counts; the bids array is then an operand of
         # every filter pass (key evaluation + hashing run once, not
         # once per bucket)
-        @partial(
-            shard_map, mesh=self.mesh,
-            in_specs=(P(self.axes),), out_specs=(P(self.axes), P(self.axes)),
-            check_vma=False,
-        )
-        def bids_step(local: Batch):
-            bids = bucket_ids(key_sortables(local), nbuckets)
-            onehot = (bids[:, None] == jnp.arange(nbuckets)) & local.live[:, None]
-            counts = jnp.sum(onehot, axis=0, dtype=jnp.int32)[None, :]
-            return bids, counts
+        def make_bids_step():
+            @partial(
+                shard_map, mesh=mesh,
+                in_specs=(P(axes),), out_specs=(P(axes), P(axes)),
+                check_vma=False,
+            )
+            def bids_step(local: Batch):
+                bids = bucket_ids(key_sortables(local), nbuckets)
+                onehot = (bids[:, None] == jnp.arange(nbuckets)) & local.live[:, None]
+                counts = jnp.sum(onehot, axis=0, dtype=jnp.int32)[None, :]
+                return bids, counts
 
-        bids, counts = jax.jit(bids_step)(b)
+            return jax.jit(bids_step)
+
+        bids, counts = EXEC_CACHE.get_or_build(
+            EXEC_CACHE.key_of("dist_bucket_ids", keys, nbuckets,
+                              self._mesh_fp),
+            make_bids_step,
+        )(b)
         counts = np.asarray(counts)  # [P, B]
         cap_pass = batch_capacity(max(int(counts.max()), 16), minimum=64)
 
-        @partial(
-            shard_map, mesh=self.mesh,
-            in_specs=(P(self.axes), P(self.axes), P()),
-            out_specs=P(self.axes), check_vma=False,
-        )
-        def filter_step(local: Batch, lbids, bkv):
-            keep = local.live & (lbids == bkv)
-            return _compact_local(local.with_live(keep), cap_pass)
+        def make_filter_step():
+            @partial(
+                shard_map, mesh=mesh,
+                in_specs=(P(axes), P(axes), P()),
+                out_specs=P(axes), check_vma=False,
+            )
+            def filter_step(local: Batch, lbids, bkv):
+                keep = local.live & (lbids == bkv)
+                return _compact_local(local.with_live(keep), cap_pass)
 
-        fstep = jax.jit(filter_step)
+            return jax.jit(filter_step)
+
+        fstep = EXEC_CACHE.get_or_build(
+            EXEC_CACHE.key_of("dist_bucket_filter", cap_pass, self._mesh_fp),
+            make_filter_step,
+        )
         outs = []
         for bk in range(nbuckets):
             fb = fstep(b, bids, jnp.asarray(bk, jnp.int32))
@@ -1271,8 +1346,18 @@ class DistributedExecutor:
         cap_dev = max(b.capacity // Pn, 1)
         quota = batch_capacity(-(-cap_dev // Pn), minimum=64)
         recv_cap = batch_capacity(2 * cap_dev, minimum=64)
+        from presto_tpu.cache.exec_cache import EXEC_CACHE
+
         for _ in range(MAX_RETRIES):
-            step = self._make_window_step(part_exprs, op, quota, recv_cap)
+            rc = recv_cap
+            step = EXEC_CACHE.get_or_build(
+                EXEC_CACHE.key_of(
+                    "dist_window", tuple(part_exprs), op.partition_by,
+                    op.order_keys, op.funcs, op.frame, quota, rc,
+                    self._mesh_fp,
+                ),
+                lambda: self._make_window_step(part_exprs, op, quota, rc),
+            )
             out, overflow = step(b)
             if not bool(overflow):
                 return DistBatch(out, sharded=True)
@@ -1280,10 +1365,14 @@ class DistributedExecutor:
         raise CapacityOverflow("PartitionedWindow", recv_cap)
 
     def _make_window_step(self, part_exprs, op, quota: int, recv_cap: int):
+        from presto_tpu.cache.exec_cache import trace_probe
         from presto_tpu.ops.sort import bytes_sort_chunks
 
         Pn = self.nworkers
-        window_body = op._make_step()
+        axes = self.axes  # cached step: never close over ``self``
+        # the template (not the live op): the cached closure must not
+        # pin a per-query operator and whatever it buffers
+        window_body = op._template()._make_step()
 
         def hash_cols(local: Batch):
             """int64 hash inputs per partition key: the null flag plus
@@ -1303,15 +1392,16 @@ class DistributedExecutor:
 
         @partial(
             shard_map, mesh=self.mesh,
-            in_specs=(P(self.axes),), out_specs=(P(self.axes), P()),
+            in_specs=(P(axes),), out_specs=(P(axes), P()),
             check_vma=False,
         )
         def step(local: Batch):
+            trace_probe()
             pids = partition_ids(hash_cols(local), Pn)
             exch, ovf = exchange_multiround(local, pids, Pn, quota, recv_cap,
-                                            axes=self.axes)
+                                            axes=axes)
             out = window_body(exch)
-            return out, any_flag(ovf, self.axes)
+            return out, any_flag(ovf, axes)
 
         return jax.jit(step)
 
@@ -1378,35 +1468,46 @@ class DistributedExecutor:
         # need not be a power of two, so the bucket rounding could
         # otherwise overshoot it)
         cap_out = min(cap_dev, batch_capacity(min(n, cap_dev), minimum=16))
+        from presto_tpu.cache.exec_cache import EXEC_CACHE
 
-        @partial(
-            shard_map, mesh=self.mesh,
-            in_specs=(P(self.axes),), out_specs=P(self.axes),
-            check_vma=False,
-        )
-        def step(local: Batch):
-            vals = [evaluate(k.expr, local) for k in keys]
-            order = sort_indices(
-                [v.data for v in vals],
-                [k.descending for k in keys],
-                local.live,
-                nulls_first=[k.nulls_first for k in keys],
-                valids=[v.valid for v in vals],
+        mesh, axes = self.mesh, self.axes
+
+        def make_step():
+            @partial(
+                shard_map, mesh=mesh,
+                in_specs=(P(axes),), out_specs=P(axes),
+                check_vma=False,
             )
-            take = order[:cap_out]
-            cols = {
-                nm: Column(
-                    gather_rows(c.data, take, 0),
-                    gather_padded(c.valid, take, False),
-                    c.dtype, c.dictionary,
+            def step(local: Batch):
+                vals = [evaluate(k.expr, local) for k in keys]
+                order = sort_indices(
+                    [v.data for v in vals],
+                    [k.descending for k in keys],
+                    local.live,
+                    nulls_first=[k.nulls_first for k in keys],
+                    valids=[v.valid for v in vals],
                 )
-                for nm, c in local.columns.items()
-            }
-            live = gather_padded(local.live, take, False)
-            live = live & (jnp.arange(cap_out) < n)
-            return Batch(cols, live)
+                take = order[:cap_out]
+                cols = {
+                    nm: Column(
+                        gather_rows(c.data, take, 0),
+                        gather_padded(c.valid, take, False),
+                        c.dtype, c.dictionary,
+                    )
+                    for nm, c in local.columns.items()
+                }
+                live = gather_padded(local.live, take, False)
+                live = live & (jnp.arange(cap_out) < n)
+                return Batch(cols, live)
 
-        return DistBatch(jax.jit(step)(b), sharded=True)
+            return jax.jit(step)
+
+        step = EXEC_CACHE.get_or_build(
+            EXEC_CACHE.key_of("dist_local_topn", tuple(keys), n, cap_out,
+                              self._mesh_fp),
+            make_step,
+        )
+        return DistBatch(step(b), sharded=True)
 
     def _local_limit(self, d: DistBatch, n: int) -> DistBatch:
         from presto_tpu.ops.compact import compact_indices
@@ -1414,27 +1515,37 @@ class DistributedExecutor:
         b = d.batch
         cap_dev = max(b.capacity // self.nworkers, 1)
         cap_out = min(cap_dev, batch_capacity(min(n, cap_dev), minimum=16))
+        from presto_tpu.cache.exec_cache import EXEC_CACHE
 
-        @partial(
-            shard_map, mesh=self.mesh,
-            in_specs=(P(self.axes),), out_specs=P(self.axes),
-            check_vma=False,
+        mesh, axes = self.mesh, self.axes
+
+        def make_step():
+            @partial(
+                shard_map, mesh=mesh,
+                in_specs=(P(axes),), out_specs=P(axes),
+                check_vma=False,
+            )
+            def step(local: Batch):
+                live_rank = jnp.cumsum(local.live.astype(jnp.int64))
+                keep = local.live & (live_rank <= n)
+                idx, _, _ = compact_indices(keep, cap_out)
+                cols = {
+                    nm: Column(
+                        gather_rows(c.data, idx, 0),
+                        gather_padded(c.valid, idx, False),
+                        c.dtype, c.dictionary,
+                    )
+                    for nm, c in local.columns.items()
+                }
+                return Batch(cols, gather_padded(local.live, idx, False))
+
+            return jax.jit(step)
+
+        step = EXEC_CACHE.get_or_build(
+            EXEC_CACHE.key_of("dist_local_limit", n, cap_out, self._mesh_fp),
+            make_step,
         )
-        def step(local: Batch):
-            live_rank = jnp.cumsum(local.live.astype(jnp.int64))
-            keep = local.live & (live_rank <= n)
-            idx, _, _ = compact_indices(keep, cap_out)
-            cols = {
-                nm: Column(
-                    gather_rows(c.data, idx, 0),
-                    gather_padded(c.valid, idx, False),
-                    c.dtype, c.dictionary,
-                )
-                for nm, c in local.columns.items()
-            }
-            return Batch(cols, gather_padded(local.live, idx, False))
-
-        return DistBatch(jax.jit(step)(b), sharded=True)
+        return DistBatch(step(b), sharded=True)
 
     # -- range-partition distributed sort ----------------------------------
     @staticmethod
@@ -1468,25 +1579,37 @@ class DistributedExecutor:
         nsamples = min(64, cap_dev)
         k0 = keys[0]
 
+        from presto_tpu.cache.exec_cache import EXEC_CACHE
         from presto_tpu.parallel.exchange import _ag
 
-        @partial(
-            shard_map, mesh=self.mesh,
-            in_specs=(P(self.axes),), out_specs=(P(), P()),
-            check_vma=False,
-        )
-        def sample_step(local: Batch):
-            cmp = self._sort_cmp(k0, local)
-            order = sort_indices([cmp], [False], local.live)
-            cnt = jnp.sum(local.live.astype(jnp.int64))
-            pos = (jnp.arange(nsamples) * jnp.maximum(cnt, 1)) // nsamples
-            samp = gather_padded(cmp[order], pos, 0)
-            ok = jnp.arange(nsamples) < cnt
-            # gather to every device so the host reads a fully
-            # addressable (replicated) array in multi-process runs
-            return _ag(samp, self.axes), _ag(ok, self.axes)
+        mesh, axes = self.mesh, self.axes
+        sort_cmp = self._sort_cmp  # staticmethod: no ``self`` pinned
 
-        samp, ok = jax.jit(sample_step)(b)
+        def make_sample_step():
+            @partial(
+                shard_map, mesh=mesh,
+                in_specs=(P(axes),), out_specs=(P(), P()),
+                check_vma=False,
+            )
+            def sample_step(local: Batch):
+                cmp = sort_cmp(k0, local)
+                order = sort_indices([cmp], [False], local.live)
+                cnt = jnp.sum(local.live.astype(jnp.int64))
+                pos = (jnp.arange(nsamples) * jnp.maximum(cnt, 1)) // nsamples
+                samp = gather_padded(cmp[order], pos, 0)
+                ok = jnp.arange(nsamples) < cnt
+                # gather to every device so the host reads a fully
+                # addressable (replicated) array in multi-process runs
+                return _ag(samp, axes), _ag(ok, axes)
+
+            return jax.jit(sample_step)
+
+        sample = EXEC_CACHE.get_or_build(
+            EXEC_CACHE.key_of("dist_sort_sample", k0, nsamples,
+                              self._mesh_fp),
+            make_sample_step,
+        )
+        samp, ok = sample(b)
         samp = np.asarray(samp).reshape(-1)
         ok = np.asarray(ok).reshape(-1)
         pool = np.sort(samp[ok])
@@ -1499,27 +1622,40 @@ class DistributedExecutor:
         quota = batch_capacity(-(-cap_dev // Pn), minimum=64)
         recv_cap = batch_capacity(2 * cap_dev, minimum=64)
         for _ in range(MAX_RETRIES):
-            step = self._make_range_sort_step(keys, splitters, quota, recv_cap)
-            out, overflow = step(b)
+            rc = recv_cap
+            # splitters are DATA (sampled per input), so they ride in
+            # as an operand rather than baking into the closure — the
+            # compiled step is reusable across inputs and queries
+            step = EXEC_CACHE.get_or_build(
+                EXEC_CACHE.key_of("dist_range_sort", tuple(keys), quota, rc,
+                                  self._mesh_fp),
+                lambda: self._make_range_sort_step(keys, quota, rc),
+            )
+            out, overflow = step(b, splitters)
             if not bool(overflow):
                 return DistBatch(out, sharded=True)
             recv_cap *= 2
         raise CapacityOverflow("RangePartitionSort", recv_cap)
 
-    def _make_range_sort_step(self, keys, splitters, quota: int, recv_cap: int):
+    def _make_range_sort_step(self, keys, quota: int, recv_cap: int):
+        from presto_tpu.cache.exec_cache import trace_probe
+
         Pn = self.nworkers
         k0 = keys[0]
+        axes = self.axes  # cached step: never close over ``self``
+        sort_cmp = self._sort_cmp
 
         @partial(
             shard_map, mesh=self.mesh,
-            in_specs=(P(self.axes),), out_specs=(P(self.axes), P()),
+            in_specs=(P(axes), P()), out_specs=(P(axes), P()),
             check_vma=False,
         )
-        def step(local: Batch):
-            cmp = self._sort_cmp(k0, local)
+        def step(local: Batch, splitters):
+            trace_probe()
+            cmp = sort_cmp(k0, local)
             pids = jnp.searchsorted(splitters, cmp, side="right").astype(jnp.int32)
             exch, ovf = exchange_multiround(local, pids, Pn, quota, recv_cap,
-                                            axes=self.axes)
+                                            axes=axes)
             vals = [evaluate(k.expr, exch) for k in keys]
             order = sort_indices(
                 [v.data for v in vals],
@@ -1537,7 +1673,7 @@ class DistributedExecutor:
                 for nm, c in exch.columns.items()
             }
             out = Batch(cols, gather_padded(exch.live, order, False))
-            return out, any_flag(ovf, self.axes)
+            return out, any_flag(ovf, axes)
 
         return jax.jit(step)
 

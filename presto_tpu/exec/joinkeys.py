@@ -127,13 +127,23 @@ def join_key_exprs(
     lenv = node_intervals(lnode, catalog)
     renv = node_intervals(rnode, catalog)
 
+    from presto_tpu.cache import stats_cache
+
     _minmax_cache: dict = {}
 
     def cached_minmax(side, key):
-        # one device readback per (side, key) across the width ladder
+        # per-call memo (one fingerprint + readback per (side, key)
+        # across the width ladder) in front of the CROSS-QUERY stats
+        # cache, which keys by content fingerprint + table versions —
+        # the seed's id()-keyed dict missed equal-but-distinct exprs
+        # and nothing survived the call (cache/stats_cache.py)
         k = (side, id(key))
         if k not in _minmax_cache:
-            _minmax_cache[k] = runtime_minmax(side, key)
+            node = lnode if side == 0 else rnode
+            ck = stats_cache.minmax_key(catalog, node, key)
+            _minmax_cache[k] = stats_cache.cached_minmax(
+                ck, lambda: runtime_minmax(side, key)
+            )
         return _minmax_cache[k]
 
     def key_widths(use_stats: bool):

@@ -163,19 +163,33 @@ class JoinBuildOperator(CollectingOperator):
             if int(self.key_max).bit_length() + pb <= 62:
                 self.pack_bits = pb
 
-        @jax.jit
-        def build(b: Batch):
-            v = evaluate(self.key, b)
-            live = b.live & v.valid
-            side = build_lookup(v.data, live, cap, pack_bits=self.pack_bits)
-            dense = build_dense(v.data, live, dd[0], dd[1]) if dd else None
-            # key-run length > VERIFY_CANDIDATES detector: hash-key
-            # probes scan a fixed candidate window per probe row, so a
-            # longer collision run (>= 5 distinct strings sharing one
-            # 63-bit hash — astronomically unlikely) must be refused,
-            # not silently mis-probed
-            return side, dense, long_dup_runs_flag(side.sorted_keys)
+        from presto_tpu.cache.exec_cache import EXEC_CACHE, trace_probe
 
+        key_expr, pack_bits = self.key, self.pack_bits
+
+        def make_build():
+            @jax.jit
+            def build(b: Batch):
+                trace_probe()
+                v = evaluate(key_expr, b)
+                live = b.live & v.valid
+                side = build_lookup(v.data, live, cap, pack_bits=pack_bits)
+                dense = build_dense(v.data, live, dd[0], dd[1]) if dd else None
+                # key-run length > VERIFY_CANDIDATES detector: hash-key
+                # probes scan a fixed candidate window per probe row, so a
+                # longer collision run (>= 5 distinct strings sharing one
+                # 63-bit hash — astronomically unlikely) must be refused,
+                # not silently mis-probed
+                return side, dense, long_dup_runs_flag(side.sorted_keys)
+
+            return build
+
+        # shared across queries: the closure bakes in only (key expr,
+        # capacity, dense domain, pack bits) — all in the content key
+        build = EXEC_CACHE.get_or_build(
+            EXEC_CACHE.key_of("join_build", key_expr, cap, dd, pack_bits),
+            make_build,
+        )
         side, dense, long_runs = build(batch)
         if bool(side.overflow):
             raise CapacityOverflow("JoinBuild", cap, int(side.n_rows))
@@ -251,72 +265,109 @@ class LookupJoinOperator(Operator):
         self._step = None
         self._full_step = None
 
-    def _unique_probe(self, side, payload: Batch, batch: Batch, use_dense):
-        """Probe-aligned unique lookup: (build_row, matched).
+    def _make_unique_probe(self, use_dense: bool):
+        """Probe-aligned unique lookup closure: (build_row, matched).
+
+        Closes over LOCALS only (key expr, verify pairs, pack bits) so
+        the steps embedding it can be shared across queries through the
+        executable cache without pinning this operator.
 
         Without verify pairs this is the plain 1-candidate probe. With
         verify pairs (hash keys) it is the collision-run scanning
         ``verified_unique_probe`` below."""
         key = self.probe_key
-        if not self.verify:
+        verify = tuple(self.verify)
+        pack_bits = self.build.pack_bits
+        if verify:
+            assert not use_dense, "dense sides never carry hash verify keys"
+
+            def probe(side, payload: Batch, batch: Batch):
+                return verified_unique_probe(side, key, verify, payload,
+                                             batch)
+
+            return probe
+
+        def probe(side, payload: Batch, batch: Batch):
             v = evaluate(key, batch)
             if use_dense:
                 return probe_unique_dense(side, v.data, batch.live & v.valid)
             return probe_unique(side, v.data, batch.live & v.valid,
-                                pack_bits=self.build.pack_bits)
-        assert not use_dense, "dense sides never carry hash verify keys"
-        return verified_unique_probe(side, key, self.verify, payload, batch)
+                                pack_bits=pack_bits)
+
+        return probe
 
     def _ensure_step(self):
+        from presto_tpu.cache.exec_cache import EXEC_CACHE, trace_probe
+
         if self._step is not None:
             return
         jt, unique = self.join_type, self.unique
-        outs = self.build_outputs
+        outs = tuple(self.build_outputs)
         key = self.probe_key
+        verify = tuple(self.verify)
         # the dense direct-address probe (one gather, no probe sort)
         # applies whenever the build published a dense side; trace-time
-        # choice, so each compiled step contains exactly one kernel
+        # choice, so each compiled step contains exactly one kernel —
+        # and use_dense/pack_bits are part of the cache key, so a
+        # shared step always embeds the right kernel
         use_dense = self.build.dense_side is not None
+        pack_bits = self.build.pack_bits
 
         if jt in ("semi", "anti"):
-            assert not self.verify, (
+            assert not verify, (
                 "hash-key verification requires unique probes; the "
                 "planner must not route wide-key semi joins here"
             )
 
-            @jax.jit
-            def step(side, payload: Batch, batch: Batch) -> Batch:
-                v = evaluate(key, batch)
-                probe = probe_exists_dense if use_dense else probe_exists
-                exists = probe(side, v.data, batch.live & v.valid)
-                keep = exists if jt == "semi" else batch.live & ~exists
-                return batch.with_live(batch.live & keep)
+            def make_semi():
+                @jax.jit
+                def step(side, payload: Batch, batch: Batch) -> Batch:
+                    trace_probe()
+                    v = evaluate(key, batch)
+                    probe = probe_exists_dense if use_dense else probe_exists
+                    exists = probe(side, v.data, batch.live & v.valid)
+                    keep = exists if jt == "semi" else batch.live & ~exists
+                    return batch.with_live(batch.live & keep)
 
-            self._step = step
+                return step
+
+            self._step = EXEC_CACHE.get_or_build(
+                EXEC_CACHE.key_of("lookup_semi", key, jt, use_dense),
+                make_semi,
+            )
             return
 
         if unique:
-            if self.verify and self.build.long_dup_runs:
+            if verify and self.build.long_dup_runs:
                 raise NotImplementedError(
                     "hash-key collision run exceeds the verified probe's "
                     f"candidate window ({VERIFY_CANDIDATES})"
                 )
+            unique_probe = self._make_unique_probe(use_dense)
 
-            @jax.jit
-            def step(side, payload: Batch, batch: Batch) -> Batch:
-                res = self._unique_probe(side, payload, batch, use_dense)
-                matched = res.matched
-                cols = dict(batch.columns)
-                for bo in outs:
-                    src = payload[bo.source]
-                    data = gather_rows(src.data, res.build_row, 0)
-                    valid = gather_padded(src.valid, res.build_row, False)
-                    cols[bo.name] = Column(data, valid & matched, src.dtype,
-                                           src.dictionary)
-                live = batch.live & matched if jt == "inner" else batch.live
-                return Batch(cols, live)
+            def make_unique():
+                @jax.jit
+                def step(side, payload: Batch, batch: Batch) -> Batch:
+                    trace_probe()
+                    res = unique_probe(side, payload, batch)
+                    matched = res.matched
+                    cols = dict(batch.columns)
+                    for bo in outs:
+                        src = payload[bo.source]
+                        data = gather_rows(src.data, res.build_row, 0)
+                        valid = gather_padded(src.valid, res.build_row, False)
+                        cols[bo.name] = Column(data, valid & matched,
+                                               src.dtype, src.dictionary)
+                    live = batch.live & matched if jt == "inner" else batch.live
+                    return Batch(cols, live)
 
-            self._step = step
+                return step
+
+            self._step = EXEC_CACHE.get_or_build(
+                EXEC_CACHE.key_of("lookup_unique", key, outs, jt, verify,
+                                  use_dense, pack_bits),
+                make_unique,
+            )
             return
 
         out_cap = self.out_capacity
@@ -325,38 +376,45 @@ class LookupJoinOperator(Operator):
         # collision adds a spurious pair that the equality check drops;
         # under LEFT semantics an all-collision probe row would need to
         # become a null-extended row instead (not implemented)
-        assert not (self.verify and jt != "inner"), (
+        assert not (verify and jt != "inner"), (
             "hash-key verification on expansion joins is inner-only"
         )
         left = jt == "left"
-        verify = self.verify
 
-        def step(side: BuildSide, payload: Batch, batch: Batch):
-            v = evaluate(key, batch)
-            res = probe_expand(side, v.data, batch.live & v.valid, out_cap,
-                               left=left, emit_live=batch.live)
-            live = verify_mask(verify, batch, payload, res.build_row,
-                               probe_row=res.probe_row, init=res.live)
-            cols = {}
-            for name in batch.names:
-                src = batch[name]
-                cols[name] = Column(
-                    gather_rows(src.data, res.probe_row, 0),
-                    gather_padded(src.valid, res.probe_row, False),
-                    src.dtype,
-                    src.dictionary,
-                )
-            for bo in outs:
-                src = payload[bo.source]
-                cols[bo.name] = Column(
-                    gather_rows(src.data, res.build_row, 0),
-                    gather_padded(src.valid, res.build_row, False),
-                    src.dtype,
-                    src.dictionary,
-                )
-            return Batch(cols, live), res.overflow
+        def make_expand():
+            def step(side: BuildSide, payload: Batch, batch: Batch):
+                trace_probe()
+                v = evaluate(key, batch)
+                res = probe_expand(side, v.data, batch.live & v.valid, out_cap,
+                                   left=left, emit_live=batch.live)
+                live = verify_mask(verify, batch, payload, res.build_row,
+                                   probe_row=res.probe_row, init=res.live)
+                cols = {}
+                for name in batch.names:
+                    src = batch[name]
+                    cols[name] = Column(
+                        gather_rows(src.data, res.probe_row, 0),
+                        gather_padded(src.valid, res.probe_row, False),
+                        src.dtype,
+                        src.dictionary,
+                    )
+                for bo in outs:
+                    src = payload[bo.source]
+                    cols[bo.name] = Column(
+                        gather_rows(src.data, res.build_row, 0),
+                        gather_padded(src.valid, res.build_row, False),
+                        src.dtype,
+                        src.dictionary,
+                    )
+                return Batch(cols, live), res.overflow
 
-        self._step = jax.jit(step)
+            return jax.jit(step)
+
+        self._step = EXEC_CACHE.get_or_build(
+            EXEC_CACHE.key_of("lookup_expand", key, outs, jt, verify,
+                              out_cap, left),
+            make_expand,
+        )
 
     def _check_probe_dict(self, batch: Batch):
         """Runtime backstop for dictionary-encoded keys the planner
@@ -401,75 +459,95 @@ class LookupJoinOperator(Operator):
     # partial update (the scatter is idempotent).
 
     def _ensure_full_step(self):
+        from presto_tpu.cache.exec_cache import EXEC_CACHE, trace_probe
+
         if self._full_step is not None:
             return
-        outs = self.build_outputs
+        outs = tuple(self.build_outputs)
         key = self.probe_key
+        verify = tuple(self.verify)
         use_dense = self.build.dense_side is not None
+        pack_bits = self.build.pack_bits
 
         if self.unique:
-            if self.verify and self.build.long_dup_runs:
+            if verify and self.build.long_dup_runs:
                 raise NotImplementedError(
                     "hash-key collision run exceeds the verified probe's "
                     f"candidate window ({VERIFY_CANDIDATES})"
                 )
+            unique_probe = self._make_unique_probe(use_dense)
 
-            @jax.jit
-            def step(side, payload: Batch, flags, batch: Batch):
-                res = self._unique_probe(side, payload, batch, use_dense)
-                matched = res.matched
-                cols = dict(batch.columns)
-                for bo in outs:
-                    src = payload[bo.source]
-                    data = gather_rows(src.data, res.build_row, 0)
-                    valid = gather_padded(src.valid, res.build_row, False)
-                    cols[bo.name] = Column(data, valid & matched, src.dtype,
-                                           src.dictionary)
-                # miss rows carry build_row == capacity -> dropped; a
-                # hash collision is a miss, so gate the scatter on the
-                # verified mask
-                cap = payload.capacity
-                rows = jnp.where(matched, res.build_row, cap)
-                flags = flags.at[rows].set(True, mode="drop")
-                return Batch(cols, batch.live), flags
+            def make_full_unique():
+                @jax.jit
+                def step(side, payload: Batch, flags, batch: Batch):
+                    trace_probe()
+                    res = unique_probe(side, payload, batch)
+                    matched = res.matched
+                    cols = dict(batch.columns)
+                    for bo in outs:
+                        src = payload[bo.source]
+                        data = gather_rows(src.data, res.build_row, 0)
+                        valid = gather_padded(src.valid, res.build_row, False)
+                        cols[bo.name] = Column(data, valid & matched,
+                                               src.dtype, src.dictionary)
+                    # miss rows carry build_row == capacity -> dropped; a
+                    # hash collision is a miss, so gate the scatter on the
+                    # verified mask
+                    cap = payload.capacity
+                    rows = jnp.where(matched, res.build_row, cap)
+                    flags = flags.at[rows].set(True, mode="drop")
+                    return Batch(cols, batch.live), flags
 
-            self._full_step = step
+                return step
+
+            self._full_step = EXEC_CACHE.get_or_build(
+                EXEC_CACHE.key_of("lookup_full_unique", key, outs, verify,
+                                  use_dense, pack_bits),
+                make_full_unique,
+            )
             return
 
         out_cap = self.out_capacity
         assert out_cap is not None, "expansion join requires out_capacity"
-        assert not self.verify, (
+        assert not verify, (
             "hash-key verification on expansion FULL OUTER is unsupported "
             "(an all-collision probe row cannot re-synthesize its "
             "null-extended output row)"
         )
 
-        @jax.jit
-        def step(side: BuildSide, payload: Batch, flags, batch: Batch):
-            v = evaluate(key, batch)
-            res = probe_expand(side, v.data, batch.live & v.valid, out_cap,
-                               left=True, emit_live=batch.live)
-            cols = {}
-            for name in batch.names:
-                src = batch[name]
-                cols[name] = Column(
-                    gather_rows(src.data, res.probe_row, 0),
-                    gather_padded(src.valid, res.probe_row, False),
-                    src.dtype,
-                    src.dictionary,
-                )
-            for bo in outs:
-                src = payload[bo.source]
-                cols[bo.name] = Column(
-                    gather_rows(src.data, res.build_row, 0),
-                    gather_padded(src.valid, res.build_row, False),
-                    src.dtype,
-                    src.dictionary,
-                )
-            flags = flags.at[res.build_row].set(True, mode="drop")
-            return Batch(cols, res.live), flags, res.overflow
+        def make_full_expand():
+            @jax.jit
+            def step(side: BuildSide, payload: Batch, flags, batch: Batch):
+                trace_probe()
+                v = evaluate(key, batch)
+                res = probe_expand(side, v.data, batch.live & v.valid, out_cap,
+                                   left=True, emit_live=batch.live)
+                cols = {}
+                for name in batch.names:
+                    src = batch[name]
+                    cols[name] = Column(
+                        gather_rows(src.data, res.probe_row, 0),
+                        gather_padded(src.valid, res.probe_row, False),
+                        src.dtype,
+                        src.dictionary,
+                    )
+                for bo in outs:
+                    src = payload[bo.source]
+                    cols[bo.name] = Column(
+                        gather_rows(src.data, res.build_row, 0),
+                        gather_padded(src.valid, res.build_row, False),
+                        src.dtype,
+                        src.dictionary,
+                    )
+                flags = flags.at[res.build_row].set(True, mode="drop")
+                return Batch(cols, res.live), flags, res.overflow
 
-        self._full_step = step
+            return step
+
+        self._full_step = EXEC_CACHE.get_or_build(
+            EXEC_CACHE.key_of("lookup_full_expand", key, outs, out_cap),
+            make_full_expand,
+        )
 
     def process_full(self, batch: Batch, flags):
         """One FULL OUTER probe step: returns (out_batch, new_flags).

@@ -104,14 +104,26 @@ class FilterProjectOperator(Operator):
     """
 
     def __init__(self, predicate: Expr | None, projections: dict[str, Expr] | None):
+        from presto_tpu.cache.exec_cache import EXEC_CACHE
+
         self.predicate = predicate
         self.projections = projections
-        self._step = jax.jit(self._make_step())
+        # jitted steps are shared across queries through the compiled-
+        # executable cache, keyed by expression CONTENT: the closure
+        # bakes in nothing but the exprs, so equal configs trace equal
+        # programs (cache/exec_cache.py)
+        self._step = EXEC_CACHE.get_or_build(
+            EXEC_CACHE.key_of("filter_project", predicate, projections),
+            lambda: jax.jit(self._make_step()),
+        )
 
     def _make_step(self):
+        from presto_tpu.cache.exec_cache import trace_probe
+
         pred, projs = self.predicate, self.projections
 
         def step(batch: Batch) -> Batch:
+            trace_probe()
             live = batch.live
             if pred is not None:
                 live = live & evaluate_predicate(pred, batch)
@@ -206,20 +218,59 @@ class HashAggregationOperator(Operator):
         phase: str = "single",  # single | partial | final
         passengers: Sequence[tuple[str, Expr]] = (),
     ):
+        from presto_tpu.cache.exec_cache import EXEC_CACHE
+
         self.group_keys = list(group_keys)
         self.aggs = list(aggs)
         self.strategy = strategy
         self.phase = phase
         self.passengers = list(passengers)
         self.state: dict[str, Any] | None = None
-        self._dicts: dict[str, Dictionary | None] = {}
         self._key_types: dict[str, DataType] = {n: e.dtype for n, e in self.group_keys}
-        if isinstance(strategy, DirectStrategy):
-            if self.passengers:
-                raise InternalError("passenger keys need the sort strategy")
-            self._update = jax.jit(self._direct_update)
-        else:
-            self._update = jax.jit(self._sort_update)
+        if isinstance(strategy, DirectStrategy) and self.passengers:
+            raise InternalError("passenger keys need the sort strategy")
+        # the jitted update is shared across queries via the executable
+        # cache. The traced closure reads only step CONFIG off its
+        # operator, so the cache builds a state-less TEMPLATE instance
+        # to bind it to (a cached bound method of a live operator would
+        # pin that operator's device-resident state forever). The
+        # dictionaries the traced update sees ride back in the update's
+        # OUTPUT pytree aux (a zero-length Column per key/passenger):
+        # jax stores the output treedef per argument signature, so a
+        # signature-cache hit hands each operator the dictionaries of
+        # ITS trace — a shared side-dict would leak another query's
+        # dictionary into finish() whenever a hit skips the body.
+        self._dicts: dict[str, Dictionary | None] = {}
+        key = EXEC_CACHE.key_of(
+            "hash_agg", self.group_keys, self.aggs, strategy, phase,
+            self.passengers,
+        )
+        self._update = EXEC_CACHE.get_or_build(key, self._build_update)
+
+    def _build_update(self):
+        tmpl = HashAggregationOperator.__new__(HashAggregationOperator)
+        tmpl.group_keys = list(self.group_keys)
+        tmpl.aggs = list(self.aggs)
+        tmpl.strategy = self.strategy
+        tmpl.phase = self.phase
+        tmpl.passengers = list(self.passengers)
+        tmpl.state = None
+        tmpl._dicts = {}
+        tmpl._key_types = dict(self._key_types)
+        if isinstance(self.strategy, DirectStrategy):
+            return jax.jit(tmpl._direct_update)
+        return jax.jit(tmpl._sort_update)
+
+    def _dict_carrier(self, kvals, pvals=()):
+        """Zero-length Columns whose aux carries each key/passenger
+        dictionary out of the traced update (see __init__)."""
+        empty = jnp.zeros(0, jnp.int32), jnp.zeros(0, jnp.bool_)
+        return {
+            name: Column(*empty, e.dtype, v.dictionary)
+            for pairs, vals in ((self.group_keys, kvals),
+                                (self.passengers, pvals))
+            for (name, e), v in zip(pairs, vals)
+        }
 
     @staticmethod
     def _sortable(v):
@@ -281,27 +332,11 @@ class HashAggregationOperator(Operator):
         return out
 
     def _eval_keys(self, batch: Batch):
-        """Key Vals (dictionaries captured at trace time)."""
-        out = []
-        for name, e in self.group_keys:
-            v = evaluate(e, batch)
-            if v.dictionary is not None:
-                self._dicts[name] = v.dictionary
-            else:
-                self._dicts.setdefault(name, None)
-            out.append(v)
-        return out
+        """Key Vals (dictionaries leave via the update's dict carrier)."""
+        return [evaluate(e, batch) for _name, e in self.group_keys]
 
     def _eval_passengers(self, batch: Batch):
-        out = []
-        for name, e in self.passengers:
-            v = evaluate(e, batch)
-            if v.dictionary is not None:
-                self._dicts[name] = v.dictionary
-            else:
-                self._dicts.setdefault(name, None)
-            out.append(v)
-        return out
+        return [evaluate(e, batch) for _name, e in self.passengers]
 
     # -- direct-addressed path -------------------------------------------
 
@@ -314,6 +349,9 @@ class HashAggregationOperator(Operator):
         reductions). Only min/max and float sums take the per-aggregate
         masked-reduction path.
         """
+        from presto_tpu.cache.exec_cache import trace_probe
+
+        trace_probe()
         st: DirectStrategy = self.strategy
         kvals = self._eval_keys(batch)
         nk = state["null_key"]
@@ -381,7 +419,7 @@ class HashAggregationOperator(Operator):
                 new[a.name] = jnp.maximum(prev, part)
         for a, cnt in zip(self.aggs, counts):
             new[a.name + "$n"] = state[a.name + "$n"] + cnt
-        return new
+        return new, self._dict_carrier(kvals)
 
     def _direct_init(self):
         st: DirectStrategy = self.strategy
@@ -406,6 +444,9 @@ class HashAggregationOperator(Operator):
         """Fold a batch into the state by concatenating the state rows
         (as a pseudo-batch) with the batch's rows, then re-grouping —
         bounded memory, one multi-key sort per batch."""
+        from presto_tpu.cache.exec_cache import trace_probe
+
+        trace_probe()
         st: SortStrategy = self.strategy
         g = st.max_groups
         kvals = self._eval_keys(batch)
@@ -480,7 +521,7 @@ class HashAggregationOperator(Operator):
             new[a.name] = agg
             new[a.name + "$n"] = ncnt
             new[a.name + "$has"] = ncnt > 0
-        return new
+        return new, self._dict_carrier(kvals, pvals)
 
     def _sort_init(self):
         st: SortStrategy = self.strategy
@@ -520,8 +561,11 @@ class HashAggregationOperator(Operator):
                 self.state = self._direct_init()
             else:
                 self.state = self._sort_init()
-        # key-column dictionaries are discovered at trace time
-        self.state = self._update(self.state, batch)
+        # the carrier hands back the dictionaries THIS trace signature
+        # saw (correct even when jit's signature cache skipped the
+        # body — the output treedef is stored per signature)
+        self.state, carrier = self._update(self.state, batch)
+        self._dicts = {n: c.dictionary for n, c in carrier.items()}
         return []
 
     def finish(self) -> list[Batch]:
@@ -608,12 +652,30 @@ class GlobalAggregationOperator(Operator):
     """Aggregation without GROUP BY (reference: AggregationOperator)."""
 
     def __init__(self, aggs: Sequence[AggSpec], phase: str = "single"):
+        from presto_tpu.cache.exec_cache import EXEC_CACHE
+
         self.aggs = list(aggs)
         self.phase = phase
         self.state = None
-        self._update = jax.jit(self._step)
+        # shared across queries via a state-less template (see
+        # HashAggregationOperator: a cached bound method of a live
+        # operator would pin its final device state)
+        self._update = EXEC_CACHE.get_or_build(
+            EXEC_CACHE.key_of("global_agg", self.aggs, phase),
+            self._build_update,
+        )
+
+    def _build_update(self):
+        tmpl = GlobalAggregationOperator.__new__(GlobalAggregationOperator)
+        tmpl.aggs = list(self.aggs)
+        tmpl.phase = self.phase
+        tmpl.state = None
+        return jax.jit(tmpl._step)
 
     def _step(self, state, batch: Batch):
+        from presto_tpu.cache.exec_cache import trace_probe
+
+        trace_probe()
         new = dict(state)
         for a in self.aggs:
             if self.phase == "final":
@@ -883,9 +945,37 @@ class WindowOperator(CollectingOperator):
         ]
         if ranked and not self.order_keys:
             raise ValueError(f"{ranked[0].kind}() requires ORDER BY in its window")
-        self._step = jax.jit(self._make_step())
+        from presto_tpu.cache.exec_cache import EXEC_CACHE
+
+        # the step closure reads only window CONFIG off its operator;
+        # cache it bound to a state-less template (the buffered batches
+        # of a cached live operator must not outlive their query)
+        self._step = EXEC_CACHE.get_or_build(
+            EXEC_CACHE.key_of(
+                "window", self.partition_by, self.order_keys, self.funcs,
+                frame,
+            ),
+            self._build_step,
+        )
+
+    def _template(self) -> "WindowOperator":
+        """State-less clone for cache-shared traced bodies: a cached
+        closure must never pin a live operator (and its buffered
+        batches). Also used by the distributed window step builder."""
+        tmpl = WindowOperator.__new__(WindowOperator)
+        tmpl.batches = []
+        tmpl.partition_by = list(self.partition_by)
+        tmpl.order_keys = list(self.order_keys)
+        tmpl.funcs = list(self.funcs)
+        tmpl.frame = self.frame
+        return tmpl
+
+    def _build_step(self):
+        return jax.jit(self._template()._make_step())
 
     def _make_step(self):
+        from presto_tpu.cache.exec_cache import trace_probe
+
         from presto_tpu.ops.window import (
             change_flags,
             rank_values,
@@ -904,6 +994,7 @@ class WindowOperator(CollectingOperator):
             return [sortable(v)]
 
         def step(batch: Batch) -> Batch:
+            trace_probe()
             cap = batch.capacity
             # ---- sort keys: partition keys (nulls as a group), then
             # order keys with SQL null placement

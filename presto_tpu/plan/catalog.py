@@ -7,6 +7,7 @@ reference tree unavailable, paths reconstructed].
 
 from __future__ import annotations
 
+import itertools
 from dataclasses import dataclass
 from typing import Mapping
 
@@ -38,18 +39,53 @@ class TableMeta:
     func_deps: Mapping[str, tuple[str, ...]] = None
 
 
+#: process-unique tokens distinguishing Catalog instances in shared
+#: (process-wide) caches: two sessions' memory tables may share names
+#: and versions while holding different data
+_catalog_seq = itertools.count(1)
+
+
 class Catalog:
     def __init__(self, connectors: Mapping[str, object], default: str = "tpch"):
         self.connectors = dict(connectors)
         self.default = default
         self._meta_cache: dict[str, TableMeta] = {}
+        #: per-table DDL version counters — the caching subsystem's
+        #: invalidation clock: CTAS/DROP/INSERT bump the table's
+        #: version via invalidate(), and every plan fingerprint /
+        #: result-cache entry folds the versions it read, so stale
+        #: reuse is structurally impossible (cache/fingerprint.py)
+        self._versions: dict[str, int] = {}
+        #: callbacks fired on each invalidate (the session's result
+        #: cache registers its eager-drop hook here)
+        self._invalidation_listeners: list = []
+        self._token = f"cat{next(_catalog_seq)}"
 
     def connector(self, name: str):
         return self.connectors[name]
 
+    def cache_token(self) -> str:
+        """Stable identity of THIS catalog instance for process-wide
+        caches (never reused within a process, unlike ``id()``)."""
+        return self._token
+
+    def version(self, table: str) -> int:
+        """Monotonic DDL version of a table (0 until first DDL)."""
+        return self._versions.get(table, 0)
+
+    def add_invalidation_listener(self, cb) -> None:
+        self._invalidation_listeners.append(cb)
+
     def invalidate(self, table: str) -> None:
-        """Drop cached metadata after DDL (CTAS/DROP) changes a table."""
+        """Drop cached metadata after DDL (CTAS/DROP/INSERT) changes a
+        table, bump its version counter, and notify listeners. Every
+        DDL path MUST route here — the regression test in
+        tests/test_cache.py asserts a stale-metadata read after CTAS
+        is impossible."""
         self._meta_cache.pop(table, None)
+        self._versions[table] = self._versions.get(table, 0) + 1
+        for cb in self._invalidation_listeners:
+            cb(table)
 
     def resolve(self, table: str) -> TableMeta:
         cached = self._meta_cache.get(table)

@@ -28,6 +28,8 @@ class EventListener(Protocol):
 
     def query_failed(self, info: QueryInfo) -> None: ...
 
+    def query_cached(self, info: QueryInfo) -> None: ...
+
     def fragment_retried(self, info: QueryInfo) -> None: ...
 
 
@@ -59,6 +61,12 @@ class EventDispatcher:
         (which fires for every terminal state, like the reference's
         QueryCompletedEvent carrying the failure info)."""
         self._fire("query_failed", info)
+
+    def query_cached(self, info: QueryInfo):
+        """Fired when a query is answered from the result cache
+        (``info.cache_hit`` is already True); query_completed still
+        follows, like every terminal state."""
+        self._fire("query_cached", info)
 
     def fragment_retried(self, info: QueryInfo):
         """Fired on each fragment retry; ``info.fragment_retries`` has

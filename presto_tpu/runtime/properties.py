@@ -167,6 +167,32 @@ SESSION_PROPERTIES: dict[str, PropertyDef] = {
             "resort (QueryInfo.degraded marks it).",
         ),
         PropertyDef(
+            "result_cache_enabled", bool, True,
+            "Serve a repeated identical query from the session's "
+            "versioned result cache (keyed by plan fingerprint + "
+            "referenced-table catalog versions; see README 'Caching'). "
+            "Volatile plans (system tables, nondeterministic "
+            "functions), fault-injected runs, and failed queries never "
+            "populate or hit regardless of this switch.",
+        ),
+        PropertyDef(
+            "result_cache_max_bytes", int, 256 << 20,
+            "Byte budget of the per-session result cache (pandas deep "
+            "memory usage); eviction is LRU-first, and a single result "
+            "larger than the whole budget is skipped, not stored.",
+            _positive,
+        ),
+        PropertyDef(
+            "exec_cache_max_entries", int, 256,
+            "Entry bound of the compiled-executable cache (jitted "
+            "operator step functions keyed by step-config fingerprint); "
+            "a repeated identical query skips XLA trace+compile "
+            "entirely. LRU eviction. The cache is PROCESS-wide: setting "
+            "this explicitly resizes it for every session; leaving it "
+            "unset leaves the process bound untouched.",
+            _positive,
+        ),
+        PropertyDef(
             "profile_dir", str, None,
             "When set, every query executes under jax.profiler.trace "
             "writing an XLA op-level timeline (TensorBoard/xprof) to "

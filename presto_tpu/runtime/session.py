@@ -67,6 +67,24 @@ class Session:
         #: lifecycle mechanics: admission control, deadlines, fragment
         #: retry, distributed->local degradation (runtime/lifecycle.py)
         self.query_manager = QueryManager(self)
+        #: versioned result cache (cache/result_cache.py) — per session:
+        #: sessions own private memory catalogs, so equal fingerprints
+        #: across sessions do not imply equal data. DDL drops entries
+        #: eagerly through the catalog's invalidation listener.
+        from presto_tpu.cache.result_cache import ResultCache
+
+        self.result_cache = ResultCache(self.prop("result_cache_max_bytes"))
+        self.catalog.add_invalidation_listener(
+            self.result_cache.invalidate_table
+        )
+        # every memory-connector write (CTAS store / INSERT commit /
+        # DROP) bumps the catalog version even when issued through the
+        # Python API rather than SQL DDL — stale metadata or cached
+        # results after a direct write are structurally impossible
+        mem = conns["memory"]
+        self._mem_ddl_hooked = hasattr(mem, "add_ddl_listener")
+        if self._mem_ddl_hooked:
+            mem.add_ddl_listener(self.catalog.invalidate)
 
     # ------------------------------------------------------------------
     def prop(self, name: str):
@@ -104,6 +122,13 @@ class Session:
         (reference parity: per-query SqlQueryExecution objects)."""
         import os
 
+        from presto_tpu.cache.exec_cache import EXEC_CACHE
+
+        # the executable cache is PROCESS-wide: only an explicit
+        # per-session override mutates its bound — a session that never
+        # touched the knob must not evict other sessions' compiled steps
+        if "exec_cache_max_entries" in self.properties:
+            EXEC_CACHE.set_max_entries(self.prop("exec_cache_max_entries"))
         pallas = self.prop("pallas_strings")
         if pallas is not None:
             # the string-kernel probe reads the env at trace time;
@@ -180,11 +205,16 @@ class Session:
 
     def explain_analyze(self, sql: str) -> str:
         """Execute and render the plan annotated with actuals
-        (reference: EXPLAIN ANALYZE)."""
+        (reference: EXPLAIN ANALYZE). A result-cache hit is reported
+        in a header line — no execution happened, so node actuals
+        render as not-executed."""
         recorder = StatsRecorder()
         plan = self.plan(sql)
-        self._run_tracked(sql, plan, recorder)
-        return render_analyzed_plan(plan, recorder)
+        _df, info = self._run_tracked(sql, plan, recorder)
+        rendered = render_analyzed_plan(plan, recorder)
+        if info.cache_hit:
+            return "result cache: HIT (no execution)\n" + rendered
+        return rendered
 
     def sql(self, sql: str):
         """Execute and return a pandas DataFrame. DDL/DML statements
@@ -231,7 +261,11 @@ class Session:
                 )
             elif not stmt.if_exists:
                 raise UserError(f"table not found in memory catalog: {stmt.name}")
-            self.catalog.invalidate(stmt.name)
+            if not self._mem_ddl_hooked:
+                # connectors with the DDL-listener API already bumped
+                # the version from inside drop_table — invalidating
+                # again would double-count versions and listener fires
+                self.catalog.invalidate(stmt.name)
             return pd.DataFrame({"dropped": [stmt.name]})
         # existence checks BEFORE running the (possibly expensive) query
         if isinstance(stmt, A.CreateTableAs) and owner is not None:
@@ -252,7 +286,8 @@ class Session:
             rows = mem.create_table(stmt.name, df)
         else:
             rows = mem.insert(stmt.name, df)
-        self.catalog.invalidate(stmt.name)
+        if not self._mem_ddl_hooked:
+            self.catalog.invalidate(stmt.name)  # see the drop path
         return pd.DataFrame({"rows": [rows]})
 
     def execute(self, sql: str):
@@ -289,6 +324,34 @@ class Session:
         self.events.query_created(info)
         info.state = "RUNNING"
         info.started_at = time.time()
+        # ---- versioned result cache (cache/result_cache.py) ----------
+        # the fingerprint folds in plan content, referenced-table
+        # catalog versions, mesh shape, and codegen session properties;
+        # admission excludes volatile plans and fault-injected runs.
+        # Failed queries never populate: the put sits on the FINISHED
+        # path only.
+        from presto_tpu.cache.fingerprint import (
+            plan_fingerprint,
+            table_versions,
+        )
+        from presto_tpu.cache.result_cache import ResultCache
+
+        fp = None
+        if self.prop("result_cache_enabled") and ResultCache.admissible(
+            plan, self.catalog
+        ):
+            fp = plan_fingerprint(plan, self.catalog, self.properties,
+                                  self.mesh)
+            cached = self.result_cache.get(fp, self.catalog)
+            if cached is not None:
+                info.state = "FINISHED"
+                info.cache_hit = True
+                info.output_rows = len(cached)
+                info.finished_at = time.time()
+                REGISTRY.counter("query.completed").add()
+                self.events.query_cached(info)
+                self.events.query_completed(info)
+                return cached, info
         executor = self._make_executor()
         executor.recorder = recorder
         try:
@@ -298,6 +361,13 @@ class Session:
             info.state = "FINISHED"
             info.output_rows = len(df)
             REGISTRY.counter("query.completed").add()
+            # fp is only non-None when admission passed at lookup, and
+            # nothing in this synchronous path can change admissibility
+            if fp is not None:
+                self.result_cache.put(
+                    fp, df, table_versions(plan, self.catalog),
+                    max_bytes=self.prop("result_cache_max_bytes"),
+                )
         except Exception as e:
             info.state = "FAILED"
             info.error = f"{type(e).__name__}: {e}"

@@ -87,6 +87,9 @@ class QueryInfo:
     fragment_retries: int = 0
     #: True when a failed distributed run degraded to the local pipeline
     degraded: bool = False
+    #: True when the result was served from the versioned result cache
+    #: (no execution happened; node_stats stay empty)
+    cache_hit: bool = False
     output_rows: int = -1
     node_stats: list = field(default_factory=list)  # list[NodeStats.to_dict()]
 
@@ -113,6 +116,7 @@ class QueryInfo:
                 "retryable": self.retryable,
                 "fragmentRetries": self.fragment_retries,
                 "degraded": self.degraded,
+                "cacheHit": self.cache_hit,
                 "outputRows": self.output_rows,
                 "nodeStats": self.node_stats,
             }

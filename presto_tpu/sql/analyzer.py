@@ -291,6 +291,11 @@ class Analyzer:
         return f"{base}${self._uniq}"
 
     def analyze(self, query: A.Node) -> N.PlanNode:
+        # the gensym counter restarts per statement: names need only be
+        # unique WITHIN one plan, and a session-lifetime counter would
+        # make identical SQL produce alpha-equivalent-but-unequal plans
+        # — defeating every content-keyed cache (cache/fingerprint.py)
+        self._uniq = 0
         plan, _scope = self._analyze_any(query, outer=None, ctes={})
         return plan
 

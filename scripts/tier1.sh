@@ -1,11 +1,15 @@
 #!/usr/bin/env bash
 # Tier-1 verify — the checked-in form of the ROADMAP.md command.
 #
-# Two gates, cheapest first:
+# Three gates, cheapest first:
 #   1. `python -m compileall` over the package: a syntax/static gate
 #      that fails in seconds instead of letting a typo ride to the
 #      middle of the pytest run.
-#   2. The tier-1 pytest suite on the CPU backend (virtual-device
+#   2. Cache cold-vs-warm smoke: one TPC-H aggregation twice in one
+#      session, then once more in a fresh session — the warm runs must
+#      hit the result cache and the executable cache with ZERO
+#      re-traces and identical rows (ISSUE-2 acceptance).
+#   3. The tier-1 pytest suite on the CPU backend (virtual-device
 #      distributed tests included; `slow` marks excluded), with the
 #      same flags and timeout the driver uses.
 #
@@ -16,6 +20,36 @@ set -o pipefail
 cd "$(dirname "$0")/.."
 
 python -m compileall -q presto_tpu || exit $?
+
+timeout -k 10 240 env JAX_PLATFORMS=cpu JAX_ENABLE_X64=1 python - <<'PY' || exit $?
+import sys
+
+sys.path.insert(0, ".")
+from presto_tpu.connectors.tpch import TpchConnector
+from presto_tpu.runtime.metrics import REGISTRY
+from presto_tpu.runtime.session import Session
+
+conn = TpchConnector(sf=0.005)
+q = ("select l_returnflag, l_linestatus, count(*) c, sum(l_quantity) q "
+     "from lineitem group by l_returnflag, l_linestatus "
+     "order by l_returnflag, l_linestatus")
+s = Session({"tpch": conn})
+a = s.sql(q)
+t0 = REGISTRY.snapshot().get("exec.traces", 0)
+b = s.sql(q)
+snap = REGISTRY.snapshot()
+assert snap.get("exec.traces", 0) == t0, "warm run re-traced"
+assert snap.get("result_cache.hit", 0) >= 1, "no result-cache hit"
+s2 = Session({"tpch": conn}, properties={"result_cache_enabled": False})
+c = s2.sql(q)
+snap2 = REGISTRY.snapshot()
+assert snap2.get("exec_cache.hit", 0) >= 1, "no executable-cache hit"
+assert snap2.get("exec.traces", 0) == t0, "cross-session run re-traced"
+assert a.equals(b) and a.equals(c), "cached results differ"
+print("cache smoke: exec_cache.hit=%d result_cache.hit=%d traces=%d"
+      % (snap2.get("exec_cache.hit", 0), snap2.get("result_cache.hit", 0),
+         snap2.get("exec.traces", 0)))
+PY
 
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

@@ -1,0 +1,494 @@
+"""Query caching subsystem (presto_tpu/cache/): fingerprints,
+compiled-executable cache, versioned result cache, stats cache.
+
+Reference parity: prepared-plan reuse + fragment-result caching
+(RaptorX) + the worker-side expression-compiler caches [SURVEY §2.1].
+Covers the ISSUE-2 acceptance matrix: cold/warm no-retrace, bitwise
+result-cache hits, DDL invalidation, byte-budget LRU eviction, failed /
+fault-injected queries never populating, and the enabled=false bypass.
+"""
+
+import pandas as pd
+import pytest
+
+from presto_tpu.batch import Dictionary
+from presto_tpu.cache.exec_cache import EXEC_CACHE, ExecutableCache
+from presto_tpu.cache.fingerprint import (
+    dictionary_fingerprint,
+    fingerprint,
+    plan_fingerprint,
+    plan_is_deterministic,
+    referenced_tables,
+    try_fingerprint,
+)
+from presto_tpu.cache.result_cache import ResultCache, frame_bytes
+from presto_tpu.connectors.tpch import TpchConnector
+from presto_tpu.expr import BIGINT, InputRef
+from presto_tpu.runtime.faults import FaultInjector, injected
+from presto_tpu.runtime.metrics import REGISTRY
+from presto_tpu.runtime.session import Session
+
+CONN = TpchConnector(sf=0.01)
+
+AGG_JOIN_SQL = (
+    "select n_name, count(*) c, sum(s_acctbal) b "
+    "from supplier join nation on s_nationkey = n_nationkey "
+    "group by n_name order by n_name"
+)
+
+
+def make_session(**props):
+    return Session({"tpch": CONN}, properties=props or None)
+
+
+def counter(name: str) -> float:
+    return REGISTRY.snapshot().get(name, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# fingerprints
+# ---------------------------------------------------------------------------
+
+
+def test_dictionary_hashes_by_content_not_identity():
+    d1 = Dictionary(["a", "b", "c"])
+    d2 = Dictionary(["a", "b", "c"])
+    d3 = Dictionary(["a", "b", "x"])
+    assert d1 is not d2
+    assert dictionary_fingerprint(d1) == dictionary_fingerprint(d2)
+    assert dictionary_fingerprint(d1) != dictionary_fingerprint(d3)
+    assert fingerprint(d1) == fingerprint(d2)
+    assert fingerprint(d1) != fingerprint(d3)
+
+
+def test_fingerprint_distinguishes_structure():
+    assert fingerprint((1, 2), 3) != fingerprint((1, 2, 3))
+    assert fingerprint("12") != fingerprint(12)
+    assert fingerprint([1, [2]]) != fingerprint([[1], 2])
+    assert try_fingerprint(object()) is None  # uncacheable, never a guess
+
+
+def test_identical_sql_has_identical_plan_fingerprint():
+    s = make_session()
+    fp1 = plan_fingerprint(s.plan(AGG_JOIN_SQL), s.catalog, s.properties)
+    fp2 = plan_fingerprint(s.plan(AGG_JOIN_SQL), s.catalog, s.properties)
+    assert fp1 is not None and fp1 == fp2
+    # a different query, and a codegen-affecting property, change it
+    fp3 = plan_fingerprint(
+        s.plan(AGG_JOIN_SQL.replace("count(*)", "count(*) + 1")),
+        s.catalog, s.properties,
+    )
+    assert fp3 != fp1
+    fp4 = plan_fingerprint(s.plan(AGG_JOIN_SQL), s.catalog,
+                           {"direct_group_limit": 7})
+    assert fp4 != fp1
+
+
+def test_table_version_bump_changes_plan_fingerprint():
+    s = make_session()
+    fp1 = plan_fingerprint(s.plan("select count(*) c from region"),
+                           s.catalog, s.properties)
+    s.catalog.invalidate("region")
+    fp2 = plan_fingerprint(s.plan("select count(*) c from region"),
+                           s.catalog, s.properties)
+    assert fp1 != fp2
+
+
+def test_system_table_plans_are_volatile():
+    s = make_session()
+    plan = s.plan("select name from runtime_metrics")
+    assert ("system", "runtime_metrics") in referenced_tables(plan)
+    assert not plan_is_deterministic(plan, s.catalog)
+    assert plan_is_deterministic(s.plan("select count(*) c from region"),
+                                 s.catalog)
+
+
+# ---------------------------------------------------------------------------
+# executable cache
+# ---------------------------------------------------------------------------
+
+
+def test_exec_cache_get_or_build_and_lru_eviction():
+    c = ExecutableCache(max_entries=2)
+    builds = []
+
+    def build(tag):
+        def b():
+            builds.append(tag)
+            return tag
+
+        return b
+
+    assert c.get_or_build(c.key_of("a"), build("a")) == "a"
+    assert c.get_or_build(c.key_of("a"), build("a2")) == "a"  # hit
+    assert builds == ["a"]
+    c.get_or_build(c.key_of("b"), build("b"))
+    c.get_or_build(c.key_of("a"), build("a3"))  # refresh a's recency
+    c.get_or_build(c.key_of("c"), build("c"))  # evicts b (LRU-first)
+    assert c.get_or_build(c.key_of("a"), build("a4")) == "a"
+    assert builds == ["a", "b", "c"]
+    assert c.get_or_build(c.key_of("b"), build("b2")) == "b2"  # rebuilt
+    assert builds == ["a", "b", "c", "b2"]
+    # an unfingerprintable key falls back to building uncached
+    assert c.get_or_build(None, build("u")) == "u"
+    assert c.get_or_build(None, build("u2")) == "u2"
+
+
+def test_exec_cache_key_folds_pallas_setting(monkeypatch):
+    """Step bodies read use_pallas() at trace time, so the kernel
+    choice is baked into the compiled step — the key must separate the
+    two worlds or flipping pallas_strings is inert on warm hits."""
+    monkeypatch.setenv("PRESTO_TPU_PALLAS", "0")
+    k0 = EXEC_CACHE.key_of("probe")
+    monkeypatch.setenv("PRESTO_TPU_PALLAS", "1")
+    k1 = EXEC_CACHE.key_of("probe")
+    assert k0 is not None and k0 != k1
+
+
+def test_warm_identical_query_does_not_retrace():
+    """The tentpole assertion: a second identical query (fresh session,
+    result cache off so the pipeline really executes) is served
+    entirely from jit signature caches — zero re-traces."""
+    s1 = make_session(result_cache_enabled=False)
+    df1 = s1.sql(AGG_JOIN_SQL)
+    s2 = make_session(result_cache_enabled=False)
+    traces0 = counter("exec.traces")
+    hits0 = counter("exec_cache.hit")
+    df2 = s2.sql(AGG_JOIN_SQL)
+    assert counter("exec.traces") == traces0  # no re-trace at all
+    assert counter("exec_cache.hit") > hits0
+    pd.testing.assert_frame_equal(df1, df2)
+
+
+# ---------------------------------------------------------------------------
+# result cache: unit level
+# ---------------------------------------------------------------------------
+
+
+def _df(tag: int, rows: int = 64) -> pd.DataFrame:
+    return pd.DataFrame({"x": range(tag, tag + rows)})
+
+
+def test_result_cache_byte_budget_evicts_lru_first():
+    s = make_session()  # real catalog for the version re-check
+    one = frame_bytes(_df(0))
+    rc = ResultCache(max_bytes=2 * one + one // 2)  # fits exactly two
+    rc.put("a", _df(1), (("t", 0),))
+    rc.put("b", _df(2), (("t", 0),))
+    assert rc.get("a", s.catalog) is not None  # refresh a's recency
+    ev0 = counter("result_cache.evicted")
+    rc.put("c", _df(3), (("t", 0),))  # evicts b, the LRU entry
+    assert counter("result_cache.evicted") == ev0 + 1
+    assert rc.get("b", s.catalog) is None
+    assert rc.get("a", s.catalog) is not None
+    assert rc.get("c", s.catalog) is not None
+    assert rc.bytes_used <= rc.max_bytes
+    # an over-budget frame is skipped, not stored
+    sk0 = counter("result_cache.skipped")
+    assert not rc.put("huge", _df(9, rows=100_000), (("t", 0),))
+    assert counter("result_cache.skipped") == sk0 + 1
+    assert rc.get("huge", s.catalog) is None
+
+
+def test_result_cache_version_drift_drops_entry():
+    s = make_session()
+    rc = ResultCache(max_bytes=1 << 20)
+    rc.put("k", _df(1), (("region", s.catalog.version("region")),))
+    assert rc.get("k", s.catalog) is not None
+    s.catalog.invalidate("region")
+    inv0 = counter("result_cache.invalidated")
+    assert rc.get("k", s.catalog) is None
+    assert counter("result_cache.invalidated") == inv0 + 1
+    assert len(rc) == 0
+
+
+def test_result_cache_returns_defensive_copies():
+    s = make_session()
+    rc = ResultCache(max_bytes=1 << 20)
+    src = _df(1)
+    rc.put("k", src, ())
+    out = rc.get("k", s.catalog)
+    out.loc[:, "x"] = -1
+    again = rc.get("k", s.catalog)
+    assert again["x"].tolist() == src["x"].tolist()
+
+
+# ---------------------------------------------------------------------------
+# result cache: end to end
+# ---------------------------------------------------------------------------
+
+
+def test_warm_query_is_result_cache_hit_bitwise_identical():
+    s = make_session()
+    df1 = s.sql(AGG_JOIN_SQL)
+    hit0 = counter("result_cache.hit")
+    df2 = s.sql(AGG_JOIN_SQL)
+    assert counter("result_cache.hit") == hit0 + 1
+    pd.testing.assert_frame_equal(df1, df2)  # dtypes + values, exact
+    info = s.query_history[-1]
+    assert info.cache_hit and info.state == "FINISHED"
+    import json
+
+    assert json.loads(info.to_json())["cacheHit"] is True
+
+
+def test_result_cache_hit_skips_execution_entirely():
+    s = make_session()
+    s.sql(AGG_JOIN_SQL)
+    started0 = counter("query.started")
+    traces0 = counter("exec.traces")
+    execs = []
+    orig = s._make_executor
+    s._make_executor = lambda: execs.append(1) or orig()
+    s.sql(AGG_JOIN_SQL)
+    assert execs == []  # no executor was even constructed
+    assert counter("exec.traces") == traces0
+    assert counter("query.started") == started0 + 1  # still tracked
+
+
+def test_query_cached_event_fires():
+    s = make_session()
+
+    class L:
+        cached = []
+        completed = []
+
+        def query_cached(self, info):
+            self.cached.append(info.query_id)
+
+        def query_completed(self, info):
+            self.completed.append(info.query_id)
+
+    s.events.add(L())
+    s.sql("select count(*) c from region")
+    assert L.cached == []
+    s.sql("select count(*) c from region")
+    assert len(L.cached) == 1
+    # a cached query still reaches the terminal query_completed event
+    assert L.cached[0] == L.completed[-1]
+
+
+def test_explain_analyze_reports_cache_hit():
+    s = make_session()
+    q = "select count(*) c from nation"
+    first = s.explain_analyze(q)
+    assert "result cache: HIT" not in first
+    second = s.explain_analyze(q)
+    assert second.startswith("result cache: HIT (no execution)")
+
+
+def test_result_cache_disabled_bypasses_cleanly():
+    s = make_session(result_cache_enabled=False)
+    hit0 = counter("result_cache.hit")
+    pop0 = counter("result_cache.populated")
+    df1 = s.sql(AGG_JOIN_SQL)
+    df2 = s.sql(AGG_JOIN_SQL)
+    pd.testing.assert_frame_equal(df1, df2)
+    assert counter("result_cache.hit") == hit0
+    assert counter("result_cache.populated") == pop0
+    assert len(s.result_cache) == 0
+    assert not s.query_history[-1].cache_hit
+
+
+def test_volatile_system_queries_never_cached():
+    s = make_session()
+    s.sql("select name, value from runtime_metrics")
+    hit0 = counter("result_cache.hit")
+    s.sql("select name, value from runtime_metrics")
+    assert counter("result_cache.hit") == hit0
+    assert len(s.result_cache) == 0
+
+
+def test_fault_injected_runs_never_populate():
+    s = make_session()
+    pop0 = counter("result_cache.populated")
+    with injected(FaultInjector()):  # armed-but-quiet injector
+        df1 = s.sql("select count(*) c from region")
+        df2 = s.sql("select count(*) c from region")
+    pd.testing.assert_frame_equal(df1, df2)
+    assert counter("result_cache.populated") == pop0
+    assert len(s.result_cache) == 0
+
+
+def test_failed_queries_never_populate():
+    s = make_session()
+
+    class Boom:
+        recorder = None
+
+        def run(self, plan):
+            raise RuntimeError("exec failure")
+
+    s._make_executor = lambda: Boom()
+    pop0 = counter("result_cache.populated")
+    with pytest.raises(RuntimeError, match="exec failure"):
+        s.sql("select count(*) c from region")
+    assert counter("result_cache.populated") == pop0
+    assert len(s.result_cache) == 0
+    assert s.query_history[-1].state == "FAILED"
+
+
+def test_result_caches_are_per_session():
+    """Equal fingerprints across sessions do NOT imply equal data:
+    private memory catalogs may hold different rows under one name."""
+    s1 = make_session()
+    s2 = make_session()
+    s1.sql("create table private as select 1 x")
+    s2.sql("create table private as select 2 x")
+    q = "select x from private"
+    assert int(s1.sql(q)["x"][0]) == 1
+    assert int(s1.sql(q)["x"][0]) == 1  # warm in s1
+    assert int(s2.sql(q)["x"][0]) == 2  # never served s1's entry
+
+
+def test_shared_agg_step_keeps_per_trace_dictionaries():
+    """Regression: operators sharing one cached agg step must each see
+    the dictionaries of THEIR OWN trace signature. A shared side-dict
+    would hand a signature-cache hit the most recent trace's
+    dictionary — decoding one session's group keys with another
+    session's strings."""
+    q = "select x, count(*) c from t group by x order by x"
+    s1 = make_session()
+    s1.sql("create table t as select 'aa' x union all select 'bb' x")
+    s2 = make_session()
+    s2.sql("create table t as select 'yy' x union all select 'zz' x")
+    assert s1.sql(q)["x"].tolist() == ["aa", "bb"]
+    assert s2.sql(q)["x"].tolist() == ["yy", "zz"]  # same step fingerprint
+    s3 = make_session()  # fresh session, signature hit on s1's trace
+    s3.sql("create table t as select 'aa' x union all select 'bb' x")
+    assert s3.sql(q)["x"].tolist() == ["aa", "bb"]
+
+
+# ---------------------------------------------------------------------------
+# DDL invalidation
+# ---------------------------------------------------------------------------
+
+
+def test_ctas_insert_drop_invalidate_result_cache():
+    s = make_session()
+    s.sql("create table t as select 1 a union all select 2 a")
+    q = "select sum(a) s from t"
+    assert int(s.sql(q)["s"][0]) == 3
+    hit0 = counter("result_cache.hit")
+    assert int(s.sql(q)["s"][0]) == 3  # warm: served from cache
+    assert counter("result_cache.hit") == hit0 + 1
+    s.sql("insert into t select 10 a")
+    assert int(s.sql(q)["s"][0]) == 13  # stale 3 is impossible
+    s.sql("drop table t")
+    s.sql("create table t as select 100 a")
+    assert int(s.sql(q)["s"][0]) == 100
+
+
+def test_stale_metadata_read_after_ctas_impossible():
+    """Regression (satellite #2): every DDL path — SQL or direct
+    Python-API writes on the memory connector — must bump the catalog
+    version and drop cached TableMeta."""
+    s = make_session()
+    s.sql("create table m as select 1 a")
+    v1 = s.catalog.version("m")
+    assert v1 == 1  # exactly ONE bump per DDL statement
+    meta1 = s.catalog.resolve("m")
+    assert meta1.row_count == 1
+    s.sql("insert into m select 2 a")
+    assert s.catalog.version("m") == v1 + 1
+    assert s.catalog.resolve("m").row_count == 2  # not the cached meta
+    # direct Python-API write (bypasses SQL DDL) still bumps
+    mem = s.catalog.connector("memory")
+    v2 = s.catalog.version("direct")
+    mem.create_table("direct", pd.DataFrame({"z": [1, 2, 3]}))
+    assert s.catalog.version("direct") > v2
+    assert s.catalog.resolve("direct").row_count == 3
+    mem.drop_table("direct")
+    assert s.catalog.version("direct") > v2 + 1
+
+
+def test_ddl_forces_full_miss_then_recaches():
+    s = make_session()
+    s.sql("create table r as select 5 v")
+    q = "select v from r"
+    s.sql(q)
+    s.sql(q)  # warm
+    miss0 = counter("result_cache.miss")
+    s.sql("insert into r select 6 v")
+    df = s.sql(q)  # full miss: recomputed
+    assert counter("result_cache.miss") > miss0
+    assert sorted(df["v"].tolist()) == [5, 6]
+    hit0 = counter("result_cache.hit")
+    s.sql(q)  # and the recomputed result re-caches
+    assert counter("result_cache.hit") == hit0 + 1
+
+
+# ---------------------------------------------------------------------------
+# stats cache (promoted joinkeys min/max readbacks)
+# ---------------------------------------------------------------------------
+
+
+def test_stats_cache_content_keyed_and_version_invalidated():
+    from presto_tpu.cache import stats_cache
+
+    s = make_session()
+    plan_a = s.plan("select l_partkey from lineitem")
+    plan_b = s.plan("select l_partkey from lineitem")  # distinct object
+    expr = InputRef(BIGINT, "l_partkey")
+    k1 = stats_cache.minmax_key(s.catalog, plan_a, expr)
+    k2 = stats_cache.minmax_key(s.catalog, plan_b, expr)
+    assert k1 is not None and k1 == k2  # content, not identity
+    calls = []
+    v1 = stats_cache.cached_minmax(k1, lambda: (calls.append(1), (0, 7))[1])
+    v2 = stats_cache.cached_minmax(k2, lambda: (calls.append(1), (9, 9))[1])
+    assert v1 == v2 == (0, 7) and calls == [1]  # one readback, reused
+    s.catalog.invalidate("lineitem")
+    k3 = stats_cache.minmax_key(s.catalog, plan_a, expr)
+    assert k3 != k1  # DDL bump forces a fresh probe
+    # two sessions' same-named tables never share entries
+    s2 = make_session()
+    k4 = stats_cache.minmax_key(s2.catalog, s2.plan(
+        "select l_partkey from lineitem"), expr)
+    assert k4 != k2
+
+
+def test_stats_cache_unbound_scalar_subtrees_uncacheable():
+    """A subtree filtered by a scalar subquery reads values bound from
+    a SIBLING subplan — the fingerprint cannot see them, so the probe
+    must stay uncacheable (stale min/max would mis-pack join keys)."""
+    from presto_tpu.cache import stats_cache
+
+    s = make_session()
+    plan = s.plan("select l_partkey from lineitem "
+                  "where l_quantity <= (select max(p_size) from part)")
+    expr = InputRef(BIGINT, "l_partkey")
+    assert stats_cache.minmax_key(s.catalog, plan, expr) is None
+
+
+# ---------------------------------------------------------------------------
+# surfacing
+# ---------------------------------------------------------------------------
+
+
+def test_counters_surface_through_system_runtime_metrics():
+    s = make_session()
+    s.sql(AGG_JOIN_SQL)
+    s.sql(AGG_JOIN_SQL)
+    df = s.sql("select name, value from runtime_metrics")
+    names = {n.rstrip() for n in df["name"].tolist()}
+    assert {"result_cache.hit", "result_cache.miss",
+            "result_cache.populated", "exec_cache.hit",
+            "exec_cache.miss", "exec.traces"} <= names
+    vals = {n.rstrip(): v for n, v in zip(df["name"], df["value"])}
+    assert vals["result_cache.hit"] >= 1
+    assert vals["exec_cache.hit"] >= 1
+
+
+def test_exec_cache_max_entries_property_applies():
+    prior = EXEC_CACHE.max_entries
+    try:
+        s = make_session(exec_cache_max_entries=8)
+        s.sql("select count(*) c from region")
+        assert EXEC_CACHE.max_entries == 8
+        # the cache is process-wide: a session that never set the knob
+        # must not touch (or reset) the bound another session chose
+        s2 = make_session()
+        s2.sql("select count(*) c from region")
+        assert EXEC_CACHE.max_entries == 8
+    finally:
+        EXEC_CACHE.set_max_entries(prior)
