@@ -86,6 +86,8 @@ class ExecutableCache:
         (tracing can be slow and may itself consult this cache); a
         racing duplicate build keeps the first-inserted entry so every
         caller shares one callable."""
+        from presto_tpu.runtime.trace import span as trace_span
+
         if key is None:
             REGISTRY.counter("exec_cache.uncacheable").add()
             return builder()
@@ -96,7 +98,11 @@ class ExecutableCache:
                 REGISTRY.counter("exec_cache.hit").add()
                 return entry
         REGISTRY.counter("exec_cache.miss").add()
-        built = builder()
+        # only the miss path gets a span: a hit is a dict probe (spans
+        # on it would dominate trace volume for zero signal), a miss
+        # pays an XLA trace worth seeing on the timeline
+        with trace_span("exec_cache:build", "cache", {"hit": False}):
+            built = builder()
         with self._lock:
             entry = self._entries.setdefault(key, built)
             self._entries.move_to_end(key)

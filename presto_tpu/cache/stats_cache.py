@@ -74,6 +74,8 @@ def minmax_key(catalog, node, key_expr) -> Optional[str]:
 def cached_minmax(key: Optional[str],
                   compute: Callable[[], "tuple[int, int]"]):
     """The (min, max) for ``key``, computing (and storing) on miss."""
+    from presto_tpu.runtime.trace import span as trace_span
+
     if key is not None:
         hit = _entries.get(key)
         if hit is not None:
@@ -81,7 +83,10 @@ def cached_minmax(key: Optional[str],
             REGISTRY.counter("stats_cache.hit").add()
             return hit
     REGISTRY.counter("stats_cache.miss").add()
-    value = compute()
+    # the miss pays a device reduction + synchronous host readback —
+    # one of the few blocking round trips in planning, worth a span
+    with trace_span("stats_cache:minmax_probe", "cache"):
+        value = compute()
     if key is not None:
         _entries[key] = value
         while len(_entries) > MAX_ENTRIES:

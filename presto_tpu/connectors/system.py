@@ -36,6 +36,36 @@ SCHEMAS: dict[str, dict[str, DataType]] = {
         "node_id": fixed_bytes(32),
         "platform": fixed_bytes(16),
     },
+    # post-hoc query history (the session's ring buffer, fed by the
+    # built-in query_completed listener) with the phase breakdown
+    "query_history": {
+        "query_id": fixed_bytes(24),
+        "state": varchar(),
+        "query": fixed_bytes(256),
+        "trace_token": fixed_bytes(32),
+        "queued_s": DOUBLE,
+        "planning_s": DOUBLE,
+        "execution_s": DOUBLE,
+        "elapsed_s": DOUBLE,
+        "output_rows": BIGINT,
+        "fragment_retries": BIGINT,
+        "cache_hit": BIGINT,
+        "degraded": BIGINT,
+        "error_code": fixed_bytes(32),
+    },
+    # flattened span traces of recent queries (runtime/trace.py);
+    # start_s is relative to the query's first span
+    "trace_spans": {
+        "query_id": fixed_bytes(24),
+        "span_id": BIGINT,
+        "parent_id": BIGINT,
+        "name": fixed_bytes(48),
+        "category": fixed_bytes(12),
+        "start_s": DOUBLE,
+        "duration_s": DOUBLE,
+        "plan_node_id": BIGINT,
+        "trace_token": fixed_bytes(32),
+    },
 }
 
 
@@ -69,7 +99,9 @@ class SystemConnector:
         return SCHEMAS[table]
 
     def dictionaries(self, table: str) -> Mapping[str, Dictionary]:
-        return {"state": STATE_DICT} if table == "runtime_queries" else {}
+        if table in ("runtime_queries", "query_history"):
+            return {"state": STATE_DICT}
+        return {}
 
     def row_count(self, table: str) -> int:
         return len(self._rows(table)[0]) if self._rows(table) else 0
@@ -94,6 +126,41 @@ class SystemConnector:
             snap = REGISTRY.snapshot()
             names = sorted(snap)
             return names, [snap[n] for n in names]
+        if table == "query_history":
+            infos = self._session.history.infos()
+            return (
+                [i.query_id for i in infos],
+                [i.state for i in infos],
+                [" ".join(i.sql.split()) for i in infos],
+                [i.trace_token or "" for i in infos],
+                [i.queued_s for i in infos],
+                [i.planning_s for i in infos],
+                [i.execution_s for i in infos],
+                [i.elapsed_s for i in infos],
+                [i.output_rows for i in infos],
+                [i.fragment_retries for i in infos],
+                [int(i.cache_hit) for i in infos],
+                [int(i.degraded) for i in infos],
+                [i.error_code or "" for i in infos],
+            )
+        if table == "trace_spans":
+            qids, sids, pids_, names_, cats, starts, durs, nids, toks = (
+                [], [], [], [], [], [], [], [], []
+            )
+            for rec in self._session.traces.recorders():
+                t0 = rec.t0
+                for sp in rec.spans:
+                    qids.append(rec.query_id)
+                    sids.append(sp.span_id)
+                    pids_.append(sp.parent_id)
+                    names_.append(sp.name)
+                    cats.append(sp.cat)
+                    starts.append(max(sp.t0 - t0, 0.0))
+                    durs.append(max(sp.t1 - sp.t0, 0.0))
+                    nids.append(int(sp.args.get("plan_node_id", -1)))
+                    toks.append(rec.trace_token or "")
+            return (qids, sids, pids_, names_, cats, starts, durs, nids,
+                    toks)
         if table == "runtime_nodes":
             import jax
 
@@ -128,6 +195,37 @@ class SystemConnector:
             arrays = {
                 "node_id": _bytes_col(ids, 32),
                 "platform": _bytes_col(platforms, 16),
+            }
+        elif table == "query_history":
+            (qid, state, sql, tok, queued, planning, execution, elapsed,
+             outrows, retries, hits, degraded, ecode) = rows
+            arrays = {
+                "query_id": _bytes_col(qid, 24),
+                "state": STATE_DICT.encode(state).astype(np.int32),
+                "query": _bytes_col(sql, 256),
+                "trace_token": _bytes_col(tok, 32),
+                "queued_s": np.asarray(queued, np.float64),
+                "planning_s": np.asarray(planning, np.float64),
+                "execution_s": np.asarray(execution, np.float64),
+                "elapsed_s": np.asarray(elapsed, np.float64),
+                "output_rows": np.asarray(outrows, np.int64),
+                "fragment_retries": np.asarray(retries, np.int64),
+                "cache_hit": np.asarray(hits, np.int64),
+                "degraded": np.asarray(degraded, np.int64),
+                "error_code": _bytes_col(ecode, 32),
+            }
+        elif table == "trace_spans":
+            (qid, sid, pid, name, cat, start, dur, nid, tok) = rows
+            arrays = {
+                "query_id": _bytes_col(qid, 24),
+                "span_id": np.asarray(sid, np.int64),
+                "parent_id": np.asarray(pid, np.int64),
+                "name": _bytes_col(name, 48),
+                "category": _bytes_col(cat, 12),
+                "start_s": np.asarray(start, np.float64),
+                "duration_s": np.asarray(dur, np.float64),
+                "plan_node_id": np.asarray(nid, np.int64),
+                "trace_token": _bytes_col(tok, 32),
             }
         arrays = {c: v[split.lo : split.hi] for c, v in arrays.items()}
         if columns is not None:

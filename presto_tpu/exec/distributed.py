@@ -71,12 +71,23 @@ from presto_tpu.ops.groupby import gather_padded, group_ids_sort, segment_agg
 from presto_tpu.ops.hashing import partition_ids
 from presto_tpu.ops.sort import sort_indices
 from presto_tpu.ops.join import build_lookup, probe_exists, probe_expand, probe_unique
-from presto_tpu.parallel.exchange import any_flag, exchange_multiround
+from presto_tpu.parallel.exchange import (
+    a2a_wire_bytes,
+    any_flag,
+    exchange_multiround,
+    gather_wire_bytes,
+    record_exchange,
+)
 from presto_tpu.parallel.mesh import replicated, row_sharding, worker_axes
 from presto_tpu.plan import nodes as N
 from presto_tpu.plan.catalog import Catalog
 from presto_tpu.runtime.faults import fault_point
 from presto_tpu.runtime.lifecycle import check_deadline
+from presto_tpu.runtime.trace import (
+    batch_device_bytes,
+    batch_row_bytes,
+)
+from presto_tpu.runtime.trace import span as trace_span
 from presto_tpu.spi import batch_capacity
 from presto_tpu.types import TypeKind
 
@@ -212,6 +223,8 @@ class DistributedExecutor:
         self.gather_limit = gather_limit
         #: optional StatsRecorder for the current query (see LocalExecutor)
         self.recorder = None
+        #: stable plan-node ids for trace spans without a recorder
+        self._trace_ids = None
 
     # ------------------------------------------------------------------
     def run(self, plan: N.PlanNode):
@@ -226,13 +239,18 @@ class DistributedExecutor:
         self.fragment_info = fragment_plan(
             plan, self.catalog, self.broadcast_limit,
             self.join_build_budget)
+        if self.recorder is not None:
+            self.recorder.attach_plan(plan)
         scalars: dict[str, Any] = {}
-        d = self._exec(plan.child, scalars)
-        b = self._replicate(d).batch
-        b = b.select(list(plan.sources)).rename(dict(zip(plan.sources, plan.names)))
-        if live_count(b) == 0:
-            return pd.DataFrame(columns=list(plan.names))
-        return b.to_pandas()[list(plan.names)]
+        with trace_span("node:Output", "node",
+                        {"plan_node_id": self._nid(plan)}):
+            d = self._exec(plan.child, scalars)
+            b = self._replicate(d).batch
+            b = b.select(list(plan.sources)).rename(
+                dict(zip(plan.sources, plan.names)))
+            if live_count(b) == 0:
+                return pd.DataFrame(columns=list(plan.names))
+            return b.to_pandas()[list(plan.names)]
 
     # ------------------------------------------------------------------
     def _exec(self, node: N.PlanNode, scalars: dict) -> DistBatch:
@@ -249,18 +267,38 @@ class DistributedExecutor:
             raise NotImplementedError(f"no distributed executor for {type(node).__name__}")
         label = f"fragment:{type(node).__name__}"
         rec = self.recorder
+        nid = self._nid(node)
         if rec is None:
-            return run_fragment(label, lambda: m(node, scalars))
+            with trace_span(f"node:{type(node).__name__}", "node",
+                            {"plan_node_id": nid}):
+                return run_fragment(label, lambda: m(node, scalars))
         import time as _time
 
         t0 = _time.perf_counter()
-        out = run_fragment(label, lambda: m(node, scalars))
+        with trace_span(f"node:{type(node).__name__}", "node",
+                        {"plan_node_id": nid}) as sp:
+            out = run_fragment(label, lambda: m(node, scalars))
         wall = _time.perf_counter() - t0  # inclusive of children
-        rows = -1
+        rows, nbytes, dev_bytes = -1, -1, -1
         if rec.measure_rows and isinstance(out, DistBatch):
             rows = live_count(out.batch)
-        rec.record(node, wall, rows)
+            nbytes = rows * batch_row_bytes(out.batch)
+            dev_bytes = batch_device_bytes(out.batch)
+            if sp is not None:
+                sp.args["rows"] = rows
+        rec.record(node, wall, rows, output_bytes=nbytes,
+                   device_bytes=dev_bytes)
         return out
+
+    def _nid(self, node) -> int:
+        """Stable per-query plan-node id (runtime/stats.NodeIds)."""
+        if self.recorder is not None:
+            return self.recorder.node_id(node)
+        if self._trace_ids is None:
+            from presto_tpu.runtime.stats import NodeIds
+
+            self._trace_ids = NodeIds()
+        return self._trace_ids.of(node)
 
     def _replicate(self, d: DistBatch, guard: str | None = None,
                    rows_hint: int | None = None) -> DistBatch:
@@ -294,7 +332,15 @@ class DistributedExecutor:
             cap2 = batch_capacity(max(rows, 16), minimum=16)
             if self.nworkers * cap2 < b.capacity:
                 b = _compact_step(self.mesh, cap2)(b)
+        import time as _time
+
+        t0 = _time.perf_counter()
         b = jax.device_put(b, replicated(self.mesh))
+        record_exchange(
+            "gather" if guard is None else f"gather:{guard}",
+            gather_wire_bytes(batch_row_bytes(b), b.capacity, self.nworkers),
+            self.nworkers, _time.perf_counter() - t0,
+        )
         return DistBatch(b, sharded=False)
 
     def _shard(self, b: Batch) -> Batch:
@@ -500,6 +546,8 @@ class DistributedExecutor:
         from presto_tpu.cache.exec_cache import EXEC_CACHE
 
         mg_final = batch_capacity(Pn * quota, minimum=64)
+        import time as _time
+
         for _ in range(MAX_RETRIES):
             # content-keyed in the executable cache: grouped-execution
             # bucket passes share one XLA program per capacity tuple
@@ -512,8 +560,20 @@ class DistributedExecutor:
                 lambda: self._make_agg_step(keys, aggs, pax, mg_partial,
                                             quota, mgf),
             )
-            out, overflow = step(b)
-            if not bool(overflow):
+            t0 = _time.perf_counter()
+            with trace_span("step:dist_agg", "step",
+                            {"quota": quota, "recv_cap": mgf}):
+                out, overflow, rounds = step(b)
+                done = not bool(overflow)
+            # exchanged rows are partial-agg group rows: the final
+            # output's columns plus one int64 merge-count per agg
+            row_b = batch_row_bytes(out) + 9 * len(aggs)
+            r = int(np.asarray(rounds))
+            record_exchange(
+                "aggregate", a2a_wire_bytes(row_b, Pn, quota, r),
+                Pn, _time.perf_counter() - t0, rounds=r,
+            )
+            if done:
                 return DistBatch(out, sharded=True)
             mg_final *= 2
         raise CapacityOverflow("DistributedAggregate", mg_final)
@@ -607,7 +667,7 @@ class DistributedExecutor:
 
         @partial(
             shard_map, mesh=mesh,
-            in_specs=(P(axes),), out_specs=(P(axes), P()),
+            in_specs=(P(axes),), out_specs=(P(axes), P(), P()),
             check_vma=False,
         )
         def step(b: Batch):
@@ -615,10 +675,11 @@ class DistributedExecutor:
             part, ovf1 = partial_phase(b)
             key_sort = [c for n, _ in keys for c in _sortables(part[n])]
             pids = partition_ids(key_sort, Pn)
-            exch, ovf2 = exchange_multiround(part, pids, Pn, quota, mgf,
-                                             axes=axes)
+            exch, ovf2, rounds = exchange_multiround(
+                part, pids, Pn, quota, mgf, axes=axes, with_rounds=True
+            )
             out, ovf3 = final_phase(exch)
-            return out, any_flag(ovf1 | ovf2 | ovf3, axes)
+            return out, any_flag(ovf1 | ovf2 | ovf3, axes), rounds
 
         return jax.jit(step)
 
@@ -871,8 +932,23 @@ class DistributedExecutor:
                     node, lkey, rkey, *caps, verify,
                 ),
             )
-            out, overflow, flags = step(left.batch, right.batch)
-            long_runs, sentinel = (bool(x) for x in np.asarray(flags))
+            import time as _time
+
+            t0 = _time.perf_counter()
+            with trace_span("step:repartition_join", "step",
+                            {"kind": node.kind, "lrecv": lrecv,
+                             "rrecv": rrecv}):
+                out, overflow, flags, rounds = step(left.batch, right.batch)
+                long_runs, sentinel = (bool(x) for x in np.asarray(flags))
+                ok = not bool(overflow)
+            lr, rr = (int(x) for x in np.asarray(rounds))
+            record_exchange(
+                "join",
+                a2a_wire_bytes(batch_row_bytes(left.batch), Pn, lquota, lr)
+                + a2a_wire_bytes(batch_row_bytes(right.batch), Pn, rquota,
+                                 rr),
+                Pn, _time.perf_counter() - t0, rounds=lr + rr,
+            )
             if long_runs:
                 raise NotImplementedError(
                     "hash-key collision run exceeds the verified probe's "
@@ -883,7 +959,7 @@ class DistributedExecutor:
                     "a join build key equals the reserved int64 sentinel; "
                     "such keys are indistinguishable from dead slots"
                 )
-            if not bool(overflow):
+            if ok:
                 return DistBatch(out, sharded=True)
             lrecv *= 2
             rrecv *= 2
@@ -920,7 +996,7 @@ class DistributedExecutor:
         @partial(
             shard_map, mesh=self.mesh,
             in_specs=(P(axes), P(axes)),
-            out_specs=(P(axes), P(), P()),
+            out_specs=(P(axes), P(), P(), P()),
             check_vma=False,
         )
         def step(lb: Batch, rb: Batch):
@@ -931,10 +1007,11 @@ class DistributedExecutor:
             rv = evaluate(rkey, rb)
             lpids = partition_ids([lv.data.astype(jnp.int64)], Pn)
             rpids = partition_ids([rv.data.astype(jnp.int64)], Pn)
-            le, ovf1 = exchange_multiround(lb, lpids, Pn, lquota, lrecv,
-                                           axes=axes)
-            re, ovf2 = exchange_multiround(rb, rpids, Pn, rquota, rrecv,
-                                           axes=axes)
+            le, ovf1, lrnd = exchange_multiround(
+                lb, lpids, Pn, lquota, lrecv, axes=axes, with_rounds=True)
+            re, ovf2, rrnd = exchange_multiround(
+                rb, rpids, Pn, rquota, rrecv, axes=axes, with_rounds=True)
+            rounds = jnp.stack([lrnd, rrnd])
             bv = evaluate(rkey, re)
             build_cap = re.capacity
             side = build_lookup(bv.data, re.live & bv.valid, build_cap)
@@ -959,7 +1036,7 @@ class DistributedExecutor:
                 exists = probe_exists(side, pv.data, pvalid)
                 keep = exists if kind == "semi" else le.live & ~exists
                 return (le.with_live(le.live & keep), any_flag(ovf, axes),
-                        longrun)
+                        longrun, rounds)
             if unique:
                 if verify:
                     res = verified_unique_probe(side, lkey, verify, re, le)
@@ -976,7 +1053,7 @@ class DistributedExecutor:
                 live = le.live & res.matched if kind == "inner" else le.live
                 pout = Batch(cols, live)
                 if kind != "full":
-                    return pout, any_flag(ovf, axes), longrun
+                    return pout, any_flag(ovf, axes), longrun, rounds
                 flags = (
                     jnp.zeros(re.capacity, jnp.bool_)
                     .at[jnp.where(res.matched, res.build_row, re.capacity)]
@@ -987,6 +1064,7 @@ class DistributedExecutor:
                     concat_batches([pout, tail]),
                     any_flag(ovf, axes),
                     longrun,
+                    rounds,
                 )
             res = probe_expand(
                 side, pv.data, pvalid, out_cap,
@@ -1012,7 +1090,8 @@ class DistributedExecutor:
                 )
             pout = Batch(cols, live)
             if kind != "full":
-                return pout, any_flag(ovf | res.overflow, axes), longrun
+                return pout, any_flag(ovf | res.overflow, axes), longrun, \
+                    rounds
             flags = (
                 jnp.zeros(re.capacity, jnp.bool_)
                 .at[res.build_row]
@@ -1023,6 +1102,7 @@ class DistributedExecutor:
                 concat_batches([pout, tail]),
                 any_flag(ovf | res.overflow, axes),
                 longrun,
+                rounds,
             )
 
         return jax.jit(step)
@@ -1348,6 +1428,8 @@ class DistributedExecutor:
         recv_cap = batch_capacity(2 * cap_dev, minimum=64)
         from presto_tpu.cache.exec_cache import EXEC_CACHE
 
+        import time as _time
+
         for _ in range(MAX_RETRIES):
             rc = recv_cap
             step = EXEC_CACHE.get_or_build(
@@ -1358,8 +1440,18 @@ class DistributedExecutor:
                 ),
                 lambda: self._make_window_step(part_exprs, op, quota, rc),
             )
-            out, overflow = step(b)
-            if not bool(overflow):
+            t0 = _time.perf_counter()
+            with trace_span("step:dist_window", "step",
+                            {"quota": quota, "recv_cap": rc}):
+                out, overflow, rounds = step(b)
+                ok = not bool(overflow)
+            r = int(np.asarray(rounds))
+            record_exchange(
+                "window",
+                a2a_wire_bytes(batch_row_bytes(b), Pn, quota, r),
+                Pn, _time.perf_counter() - t0, rounds=r,
+            )
+            if ok:
                 return DistBatch(out, sharded=True)
             recv_cap *= 2
         raise CapacityOverflow("PartitionedWindow", recv_cap)
@@ -1392,16 +1484,17 @@ class DistributedExecutor:
 
         @partial(
             shard_map, mesh=self.mesh,
-            in_specs=(P(axes),), out_specs=(P(axes), P()),
+            in_specs=(P(axes),), out_specs=(P(axes), P(), P()),
             check_vma=False,
         )
         def step(local: Batch):
             trace_probe()
             pids = partition_ids(hash_cols(local), Pn)
-            exch, ovf = exchange_multiround(local, pids, Pn, quota, recv_cap,
-                                            axes=axes)
+            exch, ovf, rounds = exchange_multiround(
+                local, pids, Pn, quota, recv_cap, axes=axes,
+                with_rounds=True)
             out = window_body(exch)
-            return out, any_flag(ovf, axes)
+            return out, any_flag(ovf, axes), rounds
 
         return jax.jit(step)
 
@@ -1621,6 +1714,8 @@ class DistributedExecutor:
 
         quota = batch_capacity(-(-cap_dev // Pn), minimum=64)
         recv_cap = batch_capacity(2 * cap_dev, minimum=64)
+        import time as _time
+
         for _ in range(MAX_RETRIES):
             rc = recv_cap
             # splitters are DATA (sampled per input), so they ride in
@@ -1631,8 +1726,18 @@ class DistributedExecutor:
                                   self._mesh_fp),
                 lambda: self._make_range_sort_step(keys, quota, rc),
             )
-            out, overflow = step(b, splitters)
-            if not bool(overflow):
+            t0 = _time.perf_counter()
+            with trace_span("step:dist_sort", "step",
+                            {"quota": quota, "recv_cap": rc}):
+                out, overflow, rounds = step(b, splitters)
+                ok = not bool(overflow)
+            r = int(np.asarray(rounds))
+            record_exchange(
+                "sort",
+                a2a_wire_bytes(batch_row_bytes(b), Pn, quota, r),
+                Pn, _time.perf_counter() - t0, rounds=r,
+            )
+            if ok:
                 return DistBatch(out, sharded=True)
             recv_cap *= 2
         raise CapacityOverflow("RangePartitionSort", recv_cap)
@@ -1647,15 +1752,16 @@ class DistributedExecutor:
 
         @partial(
             shard_map, mesh=self.mesh,
-            in_specs=(P(axes), P()), out_specs=(P(axes), P()),
+            in_specs=(P(axes), P()), out_specs=(P(axes), P(), P()),
             check_vma=False,
         )
         def step(local: Batch, splitters):
             trace_probe()
             cmp = sort_cmp(k0, local)
             pids = jnp.searchsorted(splitters, cmp, side="right").astype(jnp.int32)
-            exch, ovf = exchange_multiround(local, pids, Pn, quota, recv_cap,
-                                            axes=axes)
+            exch, ovf, rounds = exchange_multiround(
+                local, pids, Pn, quota, recv_cap, axes=axes,
+                with_rounds=True)
             vals = [evaluate(k.expr, exch) for k in keys]
             order = sort_indices(
                 [v.data for v in vals],
@@ -1673,7 +1779,7 @@ class DistributedExecutor:
                 for nm, c in exch.columns.items()
             }
             out = Batch(cols, gather_padded(exch.live, order, False))
-            return out, any_flag(ovf, axes)
+            return out, any_flag(ovf, axes), rounds
 
         return jax.jit(step)
 

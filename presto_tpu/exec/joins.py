@@ -34,6 +34,7 @@ from presto_tpu.exec.operators import (
     concat_batches,
 )
 from presto_tpu.expr import Expr, InputRef, evaluate
+from presto_tpu.runtime.trace import span as trace_span
 from presto_tpu.ops.groupby import gather_padded
 from presto_tpu.ops.join import (
     BuildSide,
@@ -190,7 +191,8 @@ class JoinBuildOperator(CollectingOperator):
             EXEC_CACHE.key_of("join_build", key_expr, cap, dd, pack_bits),
             make_build,
         )
-        side, dense, long_runs = build(batch)
+        with trace_span("step:join_build", "step", {"capacity": cap}):
+            side, dense, long_runs = build(batch)
         if bool(side.overflow):
             raise CapacityOverflow("JoinBuild", cap, int(side.n_rows))
         if bool(side.sentinel_hit):
@@ -442,8 +444,11 @@ class LookupJoinOperator(Operator):
                 if self.build.dense_side is not None
                 else self.build.build_side
             )
-            return [self._step(side, self.build.payload, batch)]
-        out, overflow = self._step(self.build.build_side, self.build.payload, batch)
+            with trace_span(f"step:probe_{self.join_type}", "step"):
+                return [self._step(side, self.build.payload, batch)]
+        with trace_span(f"step:probe_{self.join_type}", "step"):
+            out, overflow = self._step(self.build.build_side,
+                                       self.build.payload, batch)
         if bool(overflow):
             raise CapacityOverflow("LookupJoin", self.out_capacity)
         return [out]
@@ -562,10 +567,12 @@ class LookupJoinOperator(Operator):
                 if self.build.dense_side is not None
                 else self.build.build_side
             )
-            return self._full_step(side, self.build.payload, flags, batch)
-        out, new_flags, overflow = self._full_step(
-            self.build.build_side, self.build.payload, flags, batch
-        )
+            with trace_span("step:probe_full", "step"):
+                return self._full_step(side, self.build.payload, flags, batch)
+        with trace_span("step:probe_full", "step"):
+            out, new_flags, overflow = self._full_step(
+                self.build.build_side, self.build.payload, flags, batch
+            )
         if bool(overflow):
             raise CapacityOverflow("LookupJoin", self.out_capacity)
         return out, new_flags

@@ -111,6 +111,10 @@ class LocalExecutor:
         #: optional StatsRecorder for the current query (set by the
         #: Session; powers QueryInfo node stats and EXPLAIN ANALYZE)
         self.recorder = None
+        #: stable plan-node ids for trace spans when no recorder is
+        #: attached (the recorder's NodeIds wins so spans and NodeStats
+        #: agree on plan_node_id)
+        self._trace_ids = None
         #: L9 capacity planner: estimated build sides above this byte
         #: budget run as grouped (bucketed) execution with host-RAM
         #: offload instead of one device-resident lookup source
@@ -140,7 +144,10 @@ class LocalExecutor:
 
     def run_batches(self, plan: N.Output):
         from presto_tpu.runtime.lifecycle import run_fragment
+        from presto_tpu.runtime.trace import span as trace_span
 
+        if self.recorder is not None:
+            self.recorder.attach_plan(plan)
         scalars: dict[str, Any] = {}
         child = plan.child
         batches = self._exec(child, scalars)
@@ -158,7 +165,9 @@ class LocalExecutor:
                 out.append(ren)
             return out
 
-        return run_fragment("fragment:Output", drain), list(plan.names)
+        with trace_span("node:Output", "node",
+                        {"plan_node_id": self._nid(plan)}):
+            return run_fragment("fragment:Output", drain), list(plan.names)
 
     # ------------------------------------------------------------------
     def _exec(self, node: N.PlanNode, scalars: dict) -> BatchStream:
@@ -173,6 +182,12 @@ class LocalExecutor:
         """
         from presto_tpu.runtime.lifecycle import run_fragment
 
+        from presto_tpu.runtime.trace import (
+            batch_device_bytes,
+            batch_row_bytes,
+        )
+        from presto_tpu.runtime.trace import span as trace_span
+
         m = getattr(self, f"_exec_{type(node).__name__.lower()}", None)
         if m is None:
             raise NotImplementedError(f"no executor for {type(node).__name__}")
@@ -184,20 +199,43 @@ class LocalExecutor:
         # replayable streams included.
         label = f"fragment:{type(node).__name__}"
         rec = self.recorder
+        nid = self._nid(node)
         if rec is None:
-            return run_fragment(label, lambda: m(node, scalars))
+            with trace_span(f"node:{type(node).__name__}", "node",
+                            {"plan_node_id": nid}):
+                return run_fragment(label, lambda: m(node, scalars))
         import time as _time
 
         t0 = _time.perf_counter()
-        out = run_fragment(label, lambda: m(node, scalars))
-        rows = -1
-        if rec.measure_rows and isinstance(out, BatchStream):
-            batches = out.materialize()
-            rows = sum(live_count(b) for b in batches)
-            out = BatchStream.of(batches)
+        with trace_span(f"node:{type(node).__name__}", "node",
+                        {"plan_node_id": nid}) as sp:
+            out = run_fragment(label, lambda: m(node, scalars))
+            rows, nbytes, dev_bytes = -1, -1, -1
+            if rec.measure_rows and isinstance(out, BatchStream):
+                batches = out.materialize()
+                rows, nbytes, dev_bytes = 0, 0, 0
+                for b in batches:
+                    lc = live_count(b)
+                    rows += lc
+                    nbytes += lc * batch_row_bytes(b)
+                    dev_bytes += batch_device_bytes(b)
+                out = BatchStream.of(batches)
         wall = _time.perf_counter() - t0  # inclusive of children
-        rec.record(node, wall, rows)
+        if sp is not None and rows >= 0:
+            sp.args["rows"] = rows
+        rec.record(node, wall, rows, output_bytes=nbytes,
+                   device_bytes=dev_bytes)
         return out
+
+    def _nid(self, node) -> int:
+        """Stable per-query plan-node id (runtime/stats.NodeIds)."""
+        if self.recorder is not None:
+            return self.recorder.node_id(node)
+        if self._trace_ids is None:
+            from presto_tpu.runtime.stats import NodeIds
+
+            self._trace_ids = NodeIds()
+        return self._trace_ids.of(node)
 
     # ---- leaves ----------------------------------------------------------
     def _exec_tablescan(self, node: N.TableScan, scalars) -> BatchStream:

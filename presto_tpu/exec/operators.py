@@ -45,6 +45,7 @@ from presto_tpu.ops.groupby import (
 )
 from presto_tpu.ops.sort import sort_indices, top_n_indices
 from presto_tpu.runtime.errors import InternalError, ResourceExhausted
+from presto_tpu.runtime.trace import span as trace_span
 from presto_tpu.types import BIGINT, DOUBLE, DataType, TypeKind
 
 
@@ -154,7 +155,10 @@ class FilterProjectOperator(Operator):
         return step
 
     def process(self, batch: Batch) -> list[Batch]:
-        return [self._step(batch)]
+        # FilterProject usually runs via stream.map closures (never
+        # inside a Pipeline), so the jitted-step span lives here
+        with trace_span("step:filter_project", "step"):
+            return [self._step(batch)]
 
 
 # ---------------------------------------------------------------------------

@@ -165,6 +165,7 @@ class Pipeline:
 
     def run(self) -> list[Batch]:
         from presto_tpu.runtime.lifecycle import check_deadline
+        from presto_tpu.runtime.trace import span as trace_span
 
         outputs: list[Batch] = []
 
@@ -175,7 +176,8 @@ class Pipeline:
             st = self.stats[i]
             st.input_batches += 1
             t0 = time.perf_counter()
-            produced = self.operators[i].process(batch)
+            with trace_span(f"step:{st.name}", "step"):
+                produced = self.operators[i].process(batch)
             st.wall_s += time.perf_counter() - t0
             for b in produced:
                 st.output_batches += 1
@@ -184,16 +186,18 @@ class Pipeline:
         # the driver-loop deadline boundary: one check per morsel (a
         # compiled step in flight runs to completion; the NEXT push is
         # what an expired query_max_run_time stops)
-        for batch in self.source:
-            check_deadline("driver-loop")
-            push(0, batch)
+        with trace_span("driver:push", "driver"):
+            for batch in self.source:
+                check_deadline("driver-loop")
+                push(0, batch)
         # finish cascade — checked per finish() step, not once: for
         # sort/window/topN plans the heavy work happens HERE, so an
         # expired deadline must stop the remaining collecting operators
         for i, op in enumerate(self.operators):
             check_deadline("driver-finish")
             t0 = time.perf_counter()
-            tail = op.finish()
+            with trace_span(f"finish:{self.stats[i].name}", "step"):
+                tail = op.finish()
             self.stats[i].wall_s += time.perf_counter() - t0
             for b in tail:
                 self.stats[i].output_batches += 1

@@ -28,6 +28,7 @@ compiles to ONE XLA program with the collective in the middle).
 
 from __future__ import annotations
 
+import time
 from functools import partial
 
 import jax
@@ -97,6 +98,7 @@ def exchange_multiround(
     recv_cap: int,
     max_rounds: int | None = None,
     axes=WORKERS,
+    with_rounds: bool = False,
 ):
     """Skew-aware per-device shuffle body: multi-round, fixed wire quota.
 
@@ -119,6 +121,10 @@ def exchange_multiround(
     Returns ``(received, overflow)`` like ``exchange_local``; overflow
     is this device's receive-side flag OR an undrained-after-
     ``max_rounds`` flag (psum across the axis before acting).
+    ``with_rounds=True`` additionally returns the executed round count
+    (int32; identical on every device — the while cond is driven by
+    the global pending flag) so the host can account exact wire bytes
+    (``a2a_wire_bytes`` x rounds) for the exchange metrics.
     """
     P = num_partitions
     cap = batch.live.shape[0]
@@ -187,7 +193,7 @@ def exchange_multiround(
             new_bufs,
         )
 
-    remaining, _pending, off, ovf, _rnd, bufs = jax.lax.while_loop(
+    remaining, _pending, off, ovf, rnd, bufs = jax.lax.while_loop(
         cond, body, init
     )
     undrained = jnp.any(remaining)
@@ -197,7 +203,10 @@ def exchange_multiround(
         for n in names
     }
     live = jnp.arange(recv_cap) < off
-    return Batch(cols, live), ovf | undrained
+    out = Batch(cols, live)
+    if with_rounds:
+        return out, ovf | undrained, rnd
+    return out, ovf | undrained
 
 
 def broadcast_local(batch: Batch, axes=WORKERS) -> Batch:
@@ -213,6 +222,54 @@ def broadcast_local(batch: Batch, axes=WORKERS) -> Batch:
 def any_flag(flag, axes=WORKERS):
     """Combine per-device overflow flags (inside shard_map)."""
     return jax.lax.psum(flag.astype(jnp.int32), axes) > 0
+
+
+# ---------------------------------------------------------------------------
+# Exchange metrics (the observability layer's view of the data plane)
+# ---------------------------------------------------------------------------
+#
+# Wire-byte accounting is *capacity-based and exact for the dense
+# collectives*: an ``all_to_all`` moves the full ``[P, quota]`` send
+# tensor per column per device regardless of row liveness, so bytes =
+# rounds x P senders x (P x quota) rows x row_bytes. ``all_gather``
+# replication moves each device's shard to the P-1 others. Dispatch
+# time is the host-observed wall of the enclosing compiled step — the
+# collective is fused inside it, so the step IS the exchange dispatch
+# unit (SURVEY §7.1).
+
+
+def a2a_wire_bytes(row_bytes: int, num_partitions: int, quota: int,
+                   rounds: int = 1) -> int:
+    """Total bytes one hash-partitioned exchange moved across the mesh
+    (all devices, all rounds)."""
+    return int(rounds) * num_partitions * num_partitions * quota * row_bytes
+
+
+def gather_wire_bytes(row_bytes: int, capacity: int, mesh_size: int) -> int:
+    """Bytes an all_gather/replication of a row-sharded batch of global
+    ``capacity`` moves (each shard travels to the other P-1 devices)."""
+    return capacity * max(mesh_size - 1, 0) * row_bytes
+
+
+def record_exchange(site: str, nbytes: int, partitions: int,
+                    dispatch_s: float, rounds: int = 1) -> None:
+    """Publish one exchange dispatch: process metrics (counters +
+    ``exchange.dispatch_s`` histogram) and a completed trace span
+    under the active recorder, carrying the byte/partition/round
+    accounting in its args."""
+    from presto_tpu.runtime import trace
+    from presto_tpu.runtime.metrics import REGISTRY
+
+    REGISTRY.counter("exchange.dispatches").add()
+    REGISTRY.counter("exchange.bytes").add(float(nbytes))
+    REGISTRY.counter("exchange.rounds").add(float(rounds))
+    REGISTRY.histogram("exchange.dispatch_s").add(dispatch_s)
+    trace.add_complete(
+        f"exchange:{site}", "exchange",
+        time.perf_counter() - dispatch_s, dispatch_s,
+        {"bytes": int(nbytes), "partitions": int(partitions),
+         "rounds": int(rounds)},
+    )
 
 
 # ---------------------------------------------------------------------------

@@ -11,8 +11,10 @@ query (logged and swallowed, as the reference does).
 from __future__ import annotations
 
 import logging
+from collections import deque
 from typing import Protocol
 
+from presto_tpu.runtime.metrics import REGISTRY
 from presto_tpu.runtime.stats import QueryInfo
 
 log = logging.getLogger("presto_tpu.events")
@@ -48,6 +50,7 @@ class EventDispatcher:
             try:
                 fn(info)
             except Exception:  # listener bugs never fail queries
+                REGISTRY.counter("events.listener_errors").add()
                 log.exception("event listener %r failed in %s", l, method)
 
     def query_created(self, info: QueryInfo):
@@ -72,3 +75,29 @@ class EventDispatcher:
         """Fired on each fragment retry; ``info.fragment_retries`` has
         already been incremented when listeners see it."""
         self._fire("fragment_retried", info)
+
+
+class QueryHistoryBuffer:
+    """Ring buffer of recently completed QueryInfos — the built-in
+    EventListener feeding the ``system.query_history`` table
+    (reference: an EventListener plugin persisting QueryCompletedEvents
+    as queryable history). ``query_completed`` fires for every terminal
+    state, so FAILED and cache-hit queries appear too."""
+
+    def __init__(self, maxlen: int = 256):
+        self._ring: deque[QueryInfo] = deque(maxlen=maxlen)
+
+    def resize(self, maxlen: int) -> None:
+        """Apply a changed ``query_history_limit`` (deque maxlen is
+        immutable, so rebuild keeping the newest entries)."""
+        if maxlen != self._ring.maxlen:
+            self._ring = deque(self._ring, maxlen=maxlen)
+
+    def query_completed(self, info: QueryInfo) -> None:
+        self._ring.append(info)
+
+    def infos(self) -> "list[QueryInfo]":
+        return list(self._ring)
+
+    def __len__(self) -> int:
+        return len(self._ring)

@@ -55,6 +55,7 @@ from presto_tpu.runtime.errors import (
     is_retryable,
 )
 from presto_tpu.runtime.metrics import REGISTRY
+from presto_tpu.runtime.trace import span as trace_span
 
 #: admission headroom over the device budget when no explicit
 #: ``query_max_memory_bytes`` is set: node estimates are loose upper
@@ -131,12 +132,18 @@ def run_fragment(label: str, fn: Callable[[], object]):
     the retry budget by the plan depth."""
     ctx = _CURRENT.get()
     if ctx is None:
-        return fn()
+        with trace_span(label, "fragment"):
+            return fn()
     ctx.check_deadline(label)
     attempts = max(0, ctx.retry.count)
+    dispatch_h = REGISTRY.histogram("fragment.dispatch_s")
     for attempt in range(attempts + 1):
         try:
-            return fn()
+            with trace_span(
+                label, "fragment",
+                {"attempt": attempt} if attempt else None,
+            ), dispatch_h.time():
+                return fn()
         except Exception as e:
             exhausted = getattr(e, "_presto_retries_exhausted", False)
             if not is_retryable(e) or exhausted or attempt == attempts:
@@ -151,7 +158,11 @@ def run_fragment(label: str, fn: Callable[[], object]):
                 sleep_s = min(
                     sleep_s, max(0.0, ctx.deadline - time.monotonic())
                 )
-            time.sleep(sleep_s)
+            with trace_span(
+                f"backoff:{label}", "retry",
+                {"attempt": attempt, "error": type(e).__name__},
+            ):
+                time.sleep(sleep_s)
             ctx.check_deadline(label)
     raise AssertionError("unreachable")  # pragma: no cover
 
@@ -234,7 +245,8 @@ class QueryManager:
         scope, fragment retry (enforced at the executors' dispatch
         boundaries via the context), and distributed->local
         degradation as the last resort."""
-        self.admit(plan)
+        with trace_span("admission", "lifecycle"):
+            self.admit(plan)
         ctx = self._context(info)
         token = _CURRENT.set(ctx)
         try:
@@ -274,4 +286,5 @@ class QueryManager:
             # fresh recorder per attempt
             recorder.nodes.clear()
         local.recorder = recorder
-        return local.run(plan)
+        with trace_span("degrade_to_local", "lifecycle"):
+            return local.run(plan)

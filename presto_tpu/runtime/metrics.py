@@ -4,12 +4,20 @@ Reference parity: Airlift's ``@Managed`` JMX beans — ``CounterStat``,
 ``TimeStat``, ``DistributionStat`` — exported by every subsystem and
 queryable live through the JMX connector [SURVEY §5.5; reference tree
 unavailable]. Single-process, single-controller: a flat registry of
-named counters/timers, exposed as the ``system.runtime_metrics`` table
-and snapshot-able as JSON.
+named counters/timers/histograms, exposed as the
+``system.runtime_metrics`` table and snapshot-able as JSON.
+
+Thread safety: event listeners and prefetch workers may bump stats off
+the driver thread, so every ``add`` is atomic under a per-stat lock
+(the registry lock only guards map creation). ``HistogramStat`` is the
+``DistributionStat`` role on fixed buckets — p50/p95/p99 appear in
+snapshots — and hot timers (query execution, fragment dispatch,
+exchange dispatch, cache lookups) record onto it.
 """
 
 from __future__ import annotations
 
+import bisect
 import threading
 import time
 from dataclasses import dataclass, field
@@ -19,9 +27,13 @@ from dataclasses import dataclass, field
 class CounterStat:
     name: str
     total: float = 0.0
+    _lock: threading.Lock = field(
+        default_factory=threading.Lock, repr=False, compare=False
+    )
 
     def add(self, v: float = 1.0):
-        self.total += v
+        with self._lock:
+            self.total += v
 
 
 @dataclass
@@ -34,19 +46,85 @@ class TimeStat:
     total_s: float = 0.0
     min_s: float = float("inf")
     max_s: float = 0.0
+    _lock: threading.Lock = field(
+        default_factory=threading.Lock, repr=False, compare=False
+    )
 
     def add(self, seconds: float):
-        self.count += 1
-        self.total_s += seconds
-        self.min_s = min(self.min_s, seconds)
-        self.max_s = max(self.max_s, seconds)
+        with self._lock:
+            self.count += 1
+            self.total_s += seconds
+            self.min_s = min(self.min_s, seconds)
+            self.max_s = max(self.max_s, seconds)
 
     def time(self):
         return _Timer(self)
 
 
+#: default histogram bucket upper bounds: geometric, 10us..100s in
+#: quarter-decade steps (wall times of everything from a span append to
+#: a cold distributed compile land inside; the last bucket is +inf)
+DEFAULT_BOUNDS = tuple(10.0 ** (-5 + i * 0.25) for i in range(29))
+
+
+class HistogramStat:
+    """Fixed-bucket histogram with percentile snapshots.
+
+    Values land in the first bucket whose upper bound is >= v (the last
+    bucket is unbounded). Percentiles report the matched bucket's upper
+    bound — a conservative (never under-reporting) estimate; the exact
+    observed max is tracked separately.
+    """
+
+    __slots__ = ("name", "bounds", "counts", "count", "total", "max",
+                 "_lock")
+
+    def __init__(self, name: str, bounds: tuple = DEFAULT_BOUNDS):
+        self.name = name
+        self.bounds = tuple(float(b) for b in bounds)
+        self.counts = [0] * (len(self.bounds) + 1)
+        self.count = 0
+        self.total = 0.0
+        self.max = 0.0
+        self._lock = threading.Lock()
+
+    def add(self, v: float):
+        i = bisect.bisect_left(self.bounds, v)
+        with self._lock:
+            self.counts[i] += 1
+            self.count += 1
+            self.total += v
+            if v > self.max:
+                self.max = v
+
+    def time(self):
+        return _Timer(self)
+
+    def quantile(self, q: float) -> float:
+        """Upper bound of the bucket holding the q-quantile (0 when
+        empty; the exact max for the overflow bucket)."""
+        if self.count == 0:
+            return 0.0
+        target = q * self.count
+        seen = 0
+        for i, c in enumerate(self.counts):
+            seen += c
+            if seen >= target and c:
+                return self.bounds[i] if i < len(self.bounds) else self.max
+        return self.max
+
+    def snapshot_into(self, out: dict) -> None:
+        out[self.name + ".count"] = float(self.count)
+        out[self.name + ".total"] = self.total
+        if self.count:
+            out[self.name + ".p50"] = self.quantile(0.50)
+            out[self.name + ".p95"] = self.quantile(0.95)
+            out[self.name + ".p99"] = self.quantile(0.99)
+            out[self.name + ".max"] = self.max
+
+
 class _Timer:
-    def __init__(self, stat: TimeStat):
+    def __init__(self, stat):
         self.stat = stat
 
     def __enter__(self):
@@ -62,6 +140,7 @@ class MetricsRegistry:
         self._lock = threading.Lock()
         self.counters: dict[str, CounterStat] = {}
         self.timers: dict[str, TimeStat] = {}
+        self.histograms: dict[str, HistogramStat] = {}
 
     def counter(self, name: str) -> CounterStat:
         with self._lock:
@@ -75,6 +154,22 @@ class MetricsRegistry:
                 self.timers[name] = TimeStat(name)
             return self.timers[name]
 
+    def histogram(self, name: str,
+                  bounds: tuple = DEFAULT_BOUNDS) -> HistogramStat:
+        with self._lock:
+            if name not in self.histograms:
+                self.histograms[name] = HistogramStat(name, bounds)
+            return self.histograms[name]
+
+    def reset(self) -> None:
+        """Drop every stat (test isolation; live handles from before a
+        reset keep counting into detached objects, so re-fetch by name
+        after resetting)."""
+        with self._lock:
+            self.counters.clear()
+            self.timers.clear()
+            self.histograms.clear()
+
     def snapshot(self) -> dict:
         out: dict[str, float] = {}
         for c in self.counters.values():
@@ -85,6 +180,8 @@ class MetricsRegistry:
             if t.count:
                 out[t.name + ".min_s"] = t.min_s
                 out[t.name + ".max_s"] = t.max_s
+        for h in self.histograms.values():
+            h.snapshot_into(out)
         return out
 
 

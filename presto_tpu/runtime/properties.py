@@ -193,6 +193,36 @@ SESSION_PROPERTIES: dict[str, PropertyDef] = {
             _positive,
         ),
         PropertyDef(
+            "trace_enabled", bool, True,
+            "Record a structured span trace (query -> fragment -> plan "
+            "node -> jitted-step dispatch, plus cache/retry/exchange "
+            "spans) for every query. Traces are retained in a "
+            "per-session ring, exportable as Chrome trace JSON via "
+            "Session.export_trace(path) and queryable as "
+            "system.trace_spans.",
+        ),
+        PropertyDef(
+            "trace_max_spans", int, 8192,
+            "Span cap per traced query; spans beyond it are dropped "
+            "(counted in the trace.spans_dropped metric), never an "
+            "error.",
+            _positive,
+        ),
+        PropertyDef(
+            "query_history_limit", int, 256,
+            "Entries retained in the session's query-history ring (the "
+            "system.query_history table, fed by the built-in "
+            "query_completed listener).",
+            _positive,
+        ),
+        PropertyDef(
+            "profile_annotations", bool, False,
+            "Wrap every trace span in a jax.profiler.TraceAnnotation "
+            "named '<span>#<trace_token>' so xprof/TensorBoard device "
+            "timelines (see profile_dir) correlate with engine spans "
+            "by trace token.",
+        ),
+        PropertyDef(
             "profile_dir", str, None,
             "When set, every query executes under jax.profiler.trace "
             "writing an XLA op-level timeline (TensorBoard/xprof) to "

@@ -25,8 +25,9 @@ from presto_tpu.exec.local_planner import LocalExecutor
 from presto_tpu.plan.catalog import Catalog
 from presto_tpu.plan.nodes import PlanNode, plan_tree_str
 from presto_tpu.plan.prune import prune
+from presto_tpu.runtime import trace
 from presto_tpu.runtime.errors import UserError, error_code, is_retryable
-from presto_tpu.runtime.events import EventDispatcher
+from presto_tpu.runtime.events import EventDispatcher, QueryHistoryBuffer
 from presto_tpu.runtime.lifecycle import QueryManager
 from presto_tpu.runtime.metrics import REGISTRY
 from presto_tpu.runtime.stats import (
@@ -34,6 +35,7 @@ from presto_tpu.runtime.stats import (
     StatsRecorder,
     render_analyzed_plan,
 )
+from presto_tpu.runtime.trace import TraceRecorder, TraceStore
 from presto_tpu.sql.analyzer import Analyzer
 from presto_tpu.sql.parser import parse
 
@@ -64,6 +66,14 @@ class Session:
         self.trace_token = trace_token
         self.events = EventDispatcher()
         self.query_history: list[QueryInfo] = []
+        #: ring of recent completed QueryInfos behind system.query_history
+        #: (a built-in EventListener — the reference's history-store
+        #: EventListener plugin shape)
+        self.history = QueryHistoryBuffer(self.prop("query_history_limit"))
+        self.events.add(self.history)
+        #: ring of recent span traces (Session.export_trace /
+        #: system.trace_spans); populated when trace_enabled
+        self.traces = TraceStore()
         #: lifecycle mechanics: admission control, deadlines, fragment
         #: retry, distributed->local degradation (runtime/lifecycle.py)
         self.query_manager = QueryManager(self)
@@ -99,6 +109,10 @@ class Session:
         from presto_tpu.runtime.properties import validate_properties
 
         self.properties.update(validate_properties({name: value}))
+        if name == "query_history_limit":
+            # the history ring is sized at construction; a changed
+            # limit must take effect, not silently keep the old bound
+            self.history.resize(self.prop(name))
 
     def show_session(self) -> "list[tuple[str, object, str]]":
         """(name, effective value, description) rows, SHOW SESSION."""
@@ -205,13 +219,19 @@ class Session:
 
     def explain_analyze(self, sql: str) -> str:
         """Execute and render the plan annotated with actuals
-        (reference: EXPLAIN ANALYZE). A result-cache hit is reported
+        (reference: EXPLAIN ANALYZE), plus the exchange/cache span
+        rollups from the query's trace. A result-cache hit is reported
         in a header line — no execution happened, so node actuals
         render as not-executed."""
         recorder = StatsRecorder()
+        t0 = time.perf_counter()
         plan = self.plan(sql)
-        _df, info = self._run_tracked(sql, plan, recorder)
-        rendered = render_analyzed_plan(plan, recorder)
+        planning_s = time.perf_counter() - t0
+        _df, info = self._run_tracked(sql, plan, recorder,
+                                      planning_s=planning_s)
+        rendered = render_analyzed_plan(
+            plan, recorder, tracer=self.traces.for_query(info.query_id)
+        )
         if info.cache_hit:
             return "result cache: HIT (no execution)\n" + rendered
         return rendered
@@ -222,13 +242,16 @@ class Session:
         summary frame."""
         from presto_tpu.sql import ast as A
 
+        t0 = time.perf_counter()
         stmt = parse(sql)
         if isinstance(stmt, (A.CreateTableAs, A.InsertInto, A.DropTable)):
             return self._run_ddl(sql, stmt)
         want = bool(self.prop("collect_node_stats"))
         plan = prune(self.analyzer.analyze(stmt))
+        planning_s = time.perf_counter() - t0
         df, _info = self._run_with_retries(
-            sql, plan, (lambda: StatsRecorder()) if want else (lambda: None)
+            sql, plan, (lambda: StatsRecorder()) if want else (lambda: None),
+            planning_s=planning_s,
         )
         return df
 
@@ -280,8 +303,11 @@ class Session:
                     f"cannot insert into {stmt.name}: the {owner!r} catalog "
                     "is read-only"
                 )
+        t0 = time.perf_counter()
         plan = prune(self.analyzer.analyze(stmt.query))
-        df, _info = self._run_with_retries(sql, plan, lambda: None)
+        planning_s = time.perf_counter() - t0
+        df, _info = self._run_with_retries(sql, plan, lambda: None,
+                                           planning_s=planning_s)
         if isinstance(stmt, A.CreateTableAs):
             rows = mem.create_table(stmt.name, df)
         else:
@@ -292,9 +318,14 @@ class Session:
 
     def execute(self, sql: str):
         """Execute returning (DataFrame, QueryInfo)."""
-        return self._run_with_retries(sql, self.plan(sql), StatsRecorder)
+        t0 = time.perf_counter()
+        plan = self.plan(sql)
+        planning_s = time.perf_counter() - t0
+        return self._run_with_retries(sql, plan, StatsRecorder,
+                                      planning_s=planning_s)
 
-    def _run_with_retries(self, sql: str, plan, make_recorder):
+    def _run_with_retries(self, sql: str, plan, make_recorder,
+                          planning_s: float = 0.0):
         """The engine's whole failure-recovery posture, like the
         reference's: no mid-query recovery — a failed attempt fails the
         query, and recovery is re-running it from the top
@@ -304,26 +335,55 @@ class Session:
         retries = self.prop("query_retries")
         for attempt in range(retries + 1):
             try:
-                return self._run_tracked(sql, plan, make_recorder())
+                return self._run_tracked(sql, plan, make_recorder(),
+                                         planning_s=planning_s)
             except Exception:
                 if attempt == retries:
                     raise
                 REGISTRY.counter("query.retried").add()
 
     # ------------------------------------------------------------------
-    def _run_tracked(self, sql: str, plan: PlanNode, recorder):
+    def _run_tracked(self, sql: str, plan: PlanNode, recorder,
+                     planning_s: float = 0.0):
+        """Track one execution attempt: QueryInfo lifecycle, span trace
+        (when ``trace_enabled``), result-cache lookup, events."""
         info = QueryInfo(
             query_id=f"q_{next(_query_seq)}_{uuid.uuid4().hex[:8]}",
             sql=sql,
             state="QUEUED",
             created_at=time.time(),
+            created_mono=time.monotonic(),
+            planning_s=planning_s,
             trace_token=self.trace_token,
         )
+        tracer = None
+        token = None
+        if self.prop("trace_enabled"):
+            tracer = TraceRecorder(
+                info.query_id, self.trace_token,
+                max_spans=self.prop("trace_max_spans"),
+                annotate=bool(self.prop("profile_annotations")),
+            )
+            token = trace.install(tracer)
+        try:
+            with trace.span("query", "query", {"query_id": info.query_id}):
+                return self._run_tracked_inner(sql, plan, recorder, info)
+        finally:
+            if tracer is not None:
+                trace.uninstall(token)
+                self.traces.add(tracer)
+
+    def _run_tracked_inner(self, sql: str, plan: PlanNode, recorder, info):
         self.query_history.append(info)
         REGISTRY.counter("query.started").add()
         self.events.query_created(info)
         info.state = "RUNNING"
         info.started_at = time.time()
+        info.started_mono = time.monotonic()
+        if recorder is not None:
+            # deterministic pre-order plan-node ids (trace spans and
+            # NodeStats correlate on them)
+            recorder.attach_plan(plan)
         # ---- versioned result cache (cache/result_cache.py) ----------
         # the fingerprint folds in plan content, referenced-table
         # catalog versions, mesh shape, and codegen session properties;
@@ -340,14 +400,19 @@ class Session:
         if self.prop("result_cache_enabled") and ResultCache.admissible(
             plan, self.catalog
         ):
-            fp = plan_fingerprint(plan, self.catalog, self.properties,
-                                  self.mesh)
-            cached = self.result_cache.get(fp, self.catalog)
+            with trace.span("result_cache:lookup", "cache") as sp, \
+                    REGISTRY.histogram("cache.result_lookup_s").time():
+                fp = plan_fingerprint(plan, self.catalog, self.properties,
+                                      self.mesh)
+                cached = self.result_cache.get(fp, self.catalog)
+                if sp is not None:
+                    sp.args["hit"] = cached is not None
             if cached is not None:
                 info.state = "FINISHED"
                 info.cache_hit = True
                 info.output_rows = len(cached)
                 info.finished_at = time.time()
+                info.finished_mono = time.monotonic()
                 REGISTRY.counter("query.completed").add()
                 self.events.query_cached(info)
                 self.events.query_completed(info)
@@ -355,7 +420,8 @@ class Session:
         executor = self._make_executor()
         executor.recorder = recorder
         try:
-            with REGISTRY.timer("query.execution").time(), self._profiled():
+            with REGISTRY.histogram("query.execution_s").time(), \
+                    self._profiled():
                 df = self.query_manager.run_plan(executor, plan, info,
                                                  recorder)
             info.state = "FINISHED"
@@ -364,10 +430,11 @@ class Session:
             # fp is only non-None when admission passed at lookup, and
             # nothing in this synchronous path can change admissibility
             if fp is not None:
-                self.result_cache.put(
-                    fp, df, table_versions(plan, self.catalog),
-                    max_bytes=self.prop("result_cache_max_bytes"),
-                )
+                with trace.span("result_cache:populate", "cache"):
+                    self.result_cache.put(
+                        fp, df, table_versions(plan, self.catalog),
+                        max_bytes=self.prop("result_cache_max_bytes"),
+                    )
         except Exception as e:
             info.state = "FAILED"
             info.error = f"{type(e).__name__}: {e}"
@@ -378,9 +445,33 @@ class Session:
             raise
         finally:
             info.finished_at = time.time()
+            info.finished_mono = time.monotonic()
             if recorder is not None:
+                recorder.finalize(plan)
                 info.node_stats = [
                     s.to_dict() for s in recorder.nodes.values()
                 ]
             self.events.query_completed(info)
         return df, info
+
+    # ------------------------------------------------------------------
+    def export_trace(self, path: str, query_id: Optional[str] = None) -> str:
+        """Write retained span traces as Chrome ``trace_event`` JSON
+        (load in Perfetto / chrome://tracing). ``query_id`` narrows the
+        export to one query; default exports every retained trace, one
+        pid per query. Returns ``path``."""
+        from presto_tpu.runtime.trace import export_chrome_trace
+
+        if query_id is None:
+            recorders = self.traces.recorders()
+        else:
+            rec = self.traces.for_query(query_id)
+            if rec is None:
+                raise UserError(f"no retained trace for query {query_id!r} "
+                                "(trace_enabled off, or evicted)")
+            recorders = [rec]
+        if not recorders:
+            raise UserError(
+                "no traces retained (is trace_enabled set to false?)"
+            )
+        return export_chrome_trace(path, recorders)

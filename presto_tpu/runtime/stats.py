@@ -12,6 +12,14 @@ inside a fused step is opaque to host timers by design — XLA owns the
 schedule; per-node wall time measures the host-observed latency of the
 node's dispatch including its device work (jax profiler traces cover
 the intra-step timeline, SURVEY §5.1 TPU mapping).
+
+Node identity: stats key on *stable per-query plan-node ids* assigned
+by :class:`NodeIds` (pre-order over the plan, dispatch order for
+synthetic nodes) — never on raw ``id(node)``. A bare ``id()`` key is
+the same bug class as the ``id()``-keyed minmax cache removed in PR 2:
+CPython reuses addresses after GC, which could silently merge two
+distinct nodes' stats. ``NodeIds`` pins a strong reference to every
+node it names, so an id can never be reused while the map lives.
 """
 
 from __future__ import annotations
@@ -22,46 +30,125 @@ from dataclasses import dataclass, field
 from typing import Any, Optional
 
 
+class NodeIds:
+    """Stable per-query plan-node ids (shared by StatsRecorder and the
+    trace layer so spans and stats correlate on ``plan_node_id``)."""
+
+    __slots__ = ("_ids", "_pinned", "_next")
+
+    def __init__(self):
+        self._ids: dict[int, int] = {}
+        #: strong refs: an id(node) key stays unique for our lifetime
+        self._pinned: list = []
+        self._next = 0
+
+    def assign(self, plan) -> None:
+        """Pre-order id assignment over a plan tree (deterministic ids
+        for EXPLAIN/export; idempotent per node)."""
+        self.of(plan)
+        for c in plan.children:
+            self.assign(c)
+
+    def of(self, node) -> int:
+        key = id(node)
+        nid = self._ids.get(key)
+        if nid is None:
+            nid = self._next
+            self._next += 1
+            self._ids[key] = nid
+            self._pinned.append(node)
+        return nid
+
+    def get(self, node) -> Optional[int]:
+        return self._ids.get(id(node))
+
+
 @dataclass
 class NodeStats:
     """Actuals for one plan node (reference: OperatorStats)."""
 
     node_type: str
     detail: str = ""
+    node_id: int = -1
     wall_s: float = 0.0
+    input_rows: int = -1  # -1: not measured
     output_rows: int = -1  # -1: not measured
+    output_bytes: int = -1  # live-row payload bytes of the node's output
+    device_bytes: int = -1  # peak device-buffer (capacity) bytes observed
     invocations: int = 0
 
     def to_dict(self):
         return {
             "node": self.node_type,
             "detail": self.detail,
+            "nodeId": self.node_id,
             "wall_s": round(self.wall_s, 6),
+            "input_rows": self.input_rows,
             "output_rows": self.output_rows,
+            "output_bytes": self.output_bytes,
+            "device_bytes": self.device_bytes,
             "invocations": self.invocations,
         }
 
 
 class StatsRecorder:
-    """Collects NodeStats keyed by plan-node identity during one query."""
+    """Collects NodeStats keyed by stable per-query node id."""
 
     def __init__(self, measure_rows: bool = True):
+        self.ids = NodeIds()
         self.nodes: dict[int, NodeStats] = {}
         self.measure_rows = measure_rows
 
-    def record(self, node, wall_s: float, output_rows: int = -1):
-        key = id(node)
+    def attach_plan(self, plan) -> None:
+        """Pre-assign deterministic pre-order ids for a plan about to
+        execute (synthetic nodes dispatched later extend the space)."""
+        self.ids.assign(plan)
+
+    def node_id(self, node) -> int:
+        return self.ids.of(node)
+
+    def record(self, node, wall_s: float, output_rows: int = -1,
+               output_bytes: int = -1, device_bytes: int = -1):
+        key = self.ids.of(node)
         st = self.nodes.get(key)
         if st is None:
-            st = NodeStats(type(node).__name__)
+            st = NodeStats(type(node).__name__, node_id=key)
             self.nodes[key] = st
         st.wall_s += wall_s
         st.invocations += 1
         if output_rows >= 0:
             st.output_rows = output_rows
+        if output_bytes >= 0:
+            st.output_bytes = (
+                output_bytes if st.output_bytes < 0
+                else st.output_bytes + output_bytes
+            )
+        if device_bytes >= 0:
+            st.device_bytes = max(st.device_bytes, device_bytes)
 
     def stats_for(self, node) -> Optional[NodeStats]:
-        return self.nodes.get(id(node))
+        nid = self.ids.get(node)
+        return None if nid is None else self.nodes.get(nid)
+
+    def finalize(self, plan) -> None:
+        """Derive each node's input_rows from its children's measured
+        output_rows (the Driver->Pipeline rollup direction)."""
+
+        def walk(node):
+            st = self.stats_for(node)
+            if st is not None and node.children:
+                total, known = 0, False
+                for c in node.children:
+                    cst = self.stats_for(c)
+                    if cst is not None and cst.output_rows >= 0:
+                        total += cst.output_rows
+                        known = True
+                if known:
+                    st.input_rows = total
+            for c in node.children:
+                walk(c)
+
+        walk(plan)
 
 
 @dataclass
@@ -69,7 +156,10 @@ class QueryInfo:
     """One executed query's full record (reference: QueryInfo JSON).
 
     ``trace_token`` propagates from the session for cross-system
-    correlation [SURVEY §5.1]."""
+    correlation [SURVEY §5.1]. Wall-clock fields (``created_at`` etc.)
+    are for display; *durations* come from the monotonic mirror fields
+    (``*_mono``) — a wall-clock step (NTP, DST) must never produce a
+    negative or inflated elapsed time."""
 
     query_id: str
     sql: str
@@ -78,6 +168,12 @@ class QueryInfo:
     trace_token: Optional[str] = None
     started_at: Optional[float] = None
     finished_at: Optional[float] = None
+    #: monotonic mirrors of the lifecycle timestamps (duration source)
+    created_mono: Optional[float] = None
+    started_mono: Optional[float] = None
+    finished_mono: Optional[float] = None
+    #: host time spent in parse/analyze/prune before tracking started
+    planning_s: float = 0.0
     error: Optional[str] = None
     #: taxonomy code (runtime/errors.py), set on FAILED transitions
     error_code: Optional[str] = None
@@ -94,7 +190,28 @@ class QueryInfo:
     node_stats: list = field(default_factory=list)  # list[NodeStats.to_dict()]
 
     @property
+    def queued_s(self) -> float:
+        """QUEUED -> RUNNING (monotonic; 0 while still queued)."""
+        if self.created_mono is None or self.started_mono is None:
+            return 0.0
+        return max(0.0, self.started_mono - self.created_mono)
+
+    @property
+    def execution_s(self) -> float:
+        """RUNNING -> terminal (monotonic; live queries read 'so far')."""
+        if self.started_mono is None:
+            return 0.0
+        end = (
+            self.finished_mono if self.finished_mono is not None
+            else time.monotonic()
+        )
+        return max(0.0, end - self.started_mono)
+
+    @property
     def elapsed_s(self) -> float:
+        if self.started_mono is not None:
+            return self.execution_s
+        # legacy construction without monotonic mirrors: wall fallback
         if self.started_at is None:
             return 0.0
         end = self.finished_at if self.finished_at is not None else time.time()
@@ -111,6 +228,9 @@ class QueryInfo:
                 "startedAt": self.started_at,
                 "finishedAt": self.finished_at,
                 "elapsedS": round(self.elapsed_s, 6),
+                "queuedS": round(self.queued_s, 6),
+                "planningS": round(self.planning_s, 6),
+                "executionS": round(self.execution_s, 6),
                 "error": self.error,
                 "errorCode": self.error_code,
                 "retryable": self.retryable,
@@ -123,11 +243,22 @@ class QueryInfo:
         )
 
 
-def render_analyzed_plan(plan, recorder: StatsRecorder) -> str:
-    """EXPLAIN ANALYZE rendering: the plan tree annotated with actuals
-    (reference: PlanPrinter.textDistributedPlan with stats)."""
-    from presto_tpu.plan.nodes import plan_tree_str
+def _fmt_bytes(n: int) -> str:
+    if n < 0:
+        return "?"
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024 or unit == "GiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{n}B"
+        n /= 1024
+    return f"{n}B"  # pragma: no cover
 
+
+def render_analyzed_plan(plan, recorder: StatsRecorder,
+                         tracer=None) -> str:
+    """EXPLAIN ANALYZE rendering: the plan tree annotated with actuals
+    (reference: PlanPrinter.textDistributedPlan with stats), followed
+    by the query's exchange and cache span rollups when a trace
+    recorder is supplied."""
     lines = []
 
     def walk(node, indent):
@@ -136,8 +267,11 @@ def render_analyzed_plan(plan, recorder: StatsRecorder) -> str:
         st = recorder.stats_for(node)
         if st is not None:
             rows = "?" if st.output_rows < 0 else f"{st.output_rows:,}"
+            in_rows = "?" if st.input_rows < 0 else f"{st.input_rows:,}"
             lines.append(
-                f"{pad}{name}  [wall {st.wall_s * 1e3:.1f}ms, rows {rows}, "
+                f"{pad}{name}  [wall {st.wall_s * 1e3:.1f}ms, "
+                f"rows {in_rows}->{rows}, "
+                f"bytes {_fmt_bytes(st.output_bytes)}, "
                 f"calls {st.invocations}]"
             )
         else:
@@ -146,4 +280,20 @@ def render_analyzed_plan(plan, recorder: StatsRecorder) -> str:
             walk(c, indent + 1)
 
     walk(plan, 0)
+    if tracer is not None:
+        ex = tracer.spans_by_cat("exchange")
+        if ex:
+            total = sum(int(s.args.get("bytes", 0)) for s in ex)
+            rounds = sum(int(s.args.get("rounds", 0)) for s in ex)
+            wall = sum(max(s.t1 - s.t0, 0.0) for s in ex)
+            lines.append(
+                f"exchanges: {len(ex)} dispatches, {_fmt_bytes(total)} "
+                f"moved, {rounds} rounds, wall {wall * 1e3:.1f}ms"
+            )
+        for s in tracer.spans_by_cat("cache"):
+            extra = ", ".join(f"{k}={v}" for k, v in sorted(s.args.items()))
+            lines.append(
+                f"cache: {s.name} {max(s.t1 - s.t0, 0.0) * 1e3:.2f}ms"
+                + (f" ({extra})" if extra else "")
+            )
     return "\n".join(lines) + "\n"

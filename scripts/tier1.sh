@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # Tier-1 verify — the checked-in form of the ROADMAP.md command.
 #
-# Three gates, cheapest first:
+# Four gates, cheapest first:
 #   1. `python -m compileall` over the package: a syntax/static gate
 #      that fails in seconds instead of letting a typo ride to the
 #      middle of the pytest run.
@@ -9,7 +9,11 @@
 #      session, then once more in a fresh session — the warm runs must
 #      hit the result cache and the executable cache with ZERO
 #      re-traces and identical rows (ISSUE-2 acceptance).
-#   3. The tier-1 pytest suite on the CPU backend (virtual-device
+#   3. Trace-export smoke: one distributed TPC-H query on an 8-device
+#      virtual mesh must export valid Chrome-trace JSON with >= 1 span
+#      per executed plan node and nonzero exchange bytes (ISSUE-3
+#      acceptance).
+#   4. The tier-1 pytest suite on the CPU backend (virtual-device
 #      distributed tests included; `slow` marks excluded), with the
 #      same flags and timeout the driver uses.
 #
@@ -49,6 +53,49 @@ assert a.equals(b) and a.equals(c), "cached results differ"
 print("cache smoke: exec_cache.hit=%d result_cache.hit=%d traces=%d"
       % (snap2.get("exec_cache.hit", 0), snap2.get("result_cache.hit", 0),
          snap2.get("exec.traces", 0)))
+PY
+
+timeout -k 10 420 env JAX_ENABLE_X64=1 python - <<'PY' || exit $?
+import json
+import sys
+
+sys.path.insert(0, ".")
+from __graft_entry__ import _provision_virtual_mesh
+
+_provision_virtual_mesh(8)
+
+from presto_tpu.connectors.tpch import TpchConnector
+from presto_tpu.connectors.tpch.queries import QUERIES
+from presto_tpu.parallel.mesh import make_mesh
+from presto_tpu.runtime.metrics import REGISTRY
+from presto_tpu.runtime.session import Session
+
+s = Session({"tpch": TpchConnector(sf=0.005)}, mesh=make_mesh(8),
+             trace_token="tier1-smoke")
+df = s.sql(QUERIES["q3"])
+assert len(df) > 0, "distributed Q3 produced no rows"
+path = s.export_trace("/tmp/_t1_trace.json")
+data = json.load(open(path))  # must be valid JSON
+spans = [e for e in data["traceEvents"] if e.get("ph") == "X"]
+assert spans, "empty trace"
+assert all(e["args"].get("trace_token") == "tier1-smoke" for e in spans), \
+    "trace_token missing from spans"
+node_ids = {e["args"]["plan_node_id"] for e in spans
+            if e.get("cat") == "node"}
+plan = s.plan(QUERIES["q3"])
+
+def count(n):
+    return 1 + sum(count(c) for c in n.children)
+
+want = count(plan)
+assert len(node_ids) >= want, \
+    f"only {len(node_ids)} node spans for {want} plan nodes"
+ex_bytes = sum(e["args"].get("bytes", 0) for e in spans
+               if e.get("cat") == "exchange")
+assert ex_bytes > 0, "no exchange bytes recorded for a distributed run"
+assert REGISTRY.snapshot().get("exchange.bytes", 0) > 0
+print("trace smoke: %d spans, %d plan nodes, %d exchange bytes"
+      % (len(spans), want, ex_bytes))
 PY
 
 rm -f /tmp/_t1.log

@@ -113,11 +113,11 @@ def test_query_retries_rerun_failed_queries():
     calls = []
     orig = Session._run_tracked
 
-    def flaky(self, sql, plan, recorder):
+    def flaky(self, sql, plan, recorder, **kw):
         calls.append(1)
         if len(calls) < 3:
             raise RuntimeError("transient device loss")
-        return orig(self, sql, plan, recorder)
+        return orig(self, sql, plan, recorder, **kw)
 
     Session._run_tracked = flaky
     try:

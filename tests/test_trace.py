@@ -1,0 +1,500 @@
+"""Observability layer: span traces, histogram metrics, query history,
+event ordering, and the <5% recording-overhead bound (ISSUE-3).
+
+Reference parity targets: OperatorStats/QueryStats rollups, the
+EventListener SPI, and tracing hooks [SURVEY §5.1, §5.5].
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from presto_tpu.connectors.tpch import TpchConnector
+from presto_tpu.runtime.errors import UserError
+from presto_tpu.runtime.metrics import REGISTRY, HistogramStat, MetricsRegistry
+from presto_tpu.runtime.session import Session
+from presto_tpu.runtime.stats import NodeIds, QueryInfo, StatsRecorder
+
+Q_AGG = (
+    "select l_returnflag, l_linestatus, count(*) c, sum(l_quantity) q "
+    "from lineitem group by l_returnflag, l_linestatus "
+    "order by l_returnflag, l_linestatus"
+)
+
+
+@pytest.fixture(scope="module")
+def conn():
+    return TpchConnector(sf=0.005)
+
+
+def _span_path_cats(rec, span):
+    """Categories along a span's ancestor chain (incl. the span)."""
+    by_id = {s.span_id: s for s in rec.spans}
+    cats = []
+    cur = span
+    while cur is not None:
+        cats.append(cur.cat)
+        cur = by_id.get(cur.parent_id)
+    return cats
+
+
+# ---------------------------------------------------------------------------
+# span recording + export
+# ---------------------------------------------------------------------------
+
+
+def test_local_query_records_nested_spans(conn):
+    s = Session({"tpch": conn}, trace_token="tok-local")
+    s.sql(Q_AGG)
+    rec = s.traces.latest()
+    assert rec is not None and rec.trace_token == "tok-local"
+    roots = [sp for sp in rec.spans if sp.parent_id == -1]
+    assert [sp.cat for sp in roots] == ["query"]
+    steps = rec.spans_by_cat("step")
+    assert steps, "no jitted-step spans recorded"
+    # at least one step nests under node and query (the full chain)
+    chains = [_span_path_cats(rec, sp) for sp in steps]
+    assert any(
+        {"query", "node", "fragment"} <= set(c) for c in chains
+    ), chains
+    # every executed plan node got exactly one node span, distinct ids
+    node_ids = [
+        sp.args["plan_node_id"] for sp in rec.spans_by_cat("node")
+    ]
+    assert node_ids and len(set(node_ids)) == len(node_ids)
+    # cache spans exist (result-cache lookup at minimum)
+    assert rec.spans_by_cat("cache")
+
+
+def test_export_chrome_trace_is_valid_json(tmp_path, conn):
+    s = Session({"tpch": conn}, trace_token="tok-export")
+    s.sql("select count(*) c from nation")
+    path = s.export_trace(str(tmp_path / "trace.json"))
+    data = json.load(open(path))
+    events = data["traceEvents"]
+    xs = [e for e in events if e.get("ph") == "X"]
+    assert xs, "no complete events exported"
+    for e in xs:
+        assert {"name", "cat", "ts", "dur", "pid", "tid"} <= set(e)
+        assert e["args"]["trace_token"] == "tok-export"
+    # metadata names the query process
+    assert any(e.get("ph") == "M" for e in events)
+    assert "tok-export" in data["otherData"]["trace_tokens"]
+
+
+def test_trace_disabled_records_nothing(conn):
+    s = Session({"tpch": conn}, properties={"trace_enabled": False})
+    s.sql("select count(*) c from nation")
+    assert len(s.traces) == 0
+    with pytest.raises(UserError):
+        s.export_trace("/tmp/_no_trace.json")
+
+
+def test_trace_max_spans_bounds_recording(conn):
+    s = Session({"tpch": conn}, properties={"trace_max_spans": 3})
+    s.sql("select count(*) c from nation")
+    rec = s.traces.latest()
+    assert len(rec.spans) <= 3
+    assert rec.dropped > 0
+
+
+def test_export_single_query_filter(tmp_path, conn):
+    s = Session({"tpch": conn})
+    s.sql("select count(*) c from nation")
+    s.sql("select count(*) c from region")
+    qid = s.traces.latest().query_id
+    path = s.export_trace(str(tmp_path / "one.json"), query_id=qid)
+    data = json.load(open(path))
+    assert data["otherData"]["queries"] == [qid]
+    with pytest.raises(UserError):
+        s.export_trace(str(tmp_path / "x.json"), query_id="q_none")
+
+
+# ---------------------------------------------------------------------------
+# system tables
+# ---------------------------------------------------------------------------
+
+
+def test_system_query_history_phase_timings(conn):
+    s = Session({"tpch": conn}, trace_token="tok-hist")
+    s.sql(Q_AGG)
+    s.sql(Q_AGG)  # warm: result-cache hit
+    df = s.sql(
+        "select query_id, state, queued_s, planning_s, execution_s, "
+        "elapsed_s, cache_hit, trace_token from query_history"
+    )
+    assert len(df) >= 2
+    assert (df["queued_s"] >= 0).all()
+    assert (df["execution_s"] >= 0).all()
+    assert df["planning_s"].iloc[0] > 0
+    assert df["state"].iloc[0] == "FINISHED"
+    assert int(df["cache_hit"].iloc[1]) == 1  # the warm repeat
+    assert df["trace_token"].iloc[0] == "tok-hist"
+
+
+def test_query_history_ring_is_bounded(conn):
+    s = Session({"tpch": conn}, properties={"query_history_limit": 2})
+    for _ in range(4):
+        s.sql("select count(*) c from nation")
+    assert len(s.history) == 2
+
+
+def test_query_history_limit_set_property_resizes(conn):
+    s = Session({"tpch": conn}, properties={"query_history_limit": 8})
+    for _ in range(3):
+        s.sql("select count(*) c from nation")
+    s.set_property("query_history_limit", 2)
+    assert len(s.history) == 2  # newest entries kept
+    s.sql("select count(*) c from region")
+    assert len(s.history) == 2
+
+
+def test_system_trace_spans_table(conn):
+    s = Session({"tpch": conn}, trace_token="tok-spans")
+    s.sql("select count(*) c from nation")
+    df = s.sql(
+        "select query_id, span_id, parent_id, name, category, start_s, "
+        "duration_s, plan_node_id, trace_token from trace_spans"
+    )
+    assert len(df) > 0
+    assert (df["duration_s"] >= 0).all()
+    assert (df["start_s"] >= 0).all()
+    cats = set(df["category"])
+    assert "query" in cats and "node" in cats
+    assert set(df["trace_token"]) == {"tok-spans"}
+    # parent ids reference spans within the same query
+    roots = df[df["parent_id"] == -1]
+    assert len(roots) >= 1
+
+
+def test_failed_query_lands_in_history_with_error_code(conn):
+    from presto_tpu.runtime.faults import FaultInjector, injected
+
+    s = Session({"tpch": conn})
+    inj = FaultInjector()
+    inj.inject("scan", times=None)
+    with injected(inj):
+        with pytest.raises(Exception):
+            s.sql("select count(*) c from nation")
+    df = s.sql("select state, error_code, execution_s from query_history")
+    failed = df[df["state"] == "FAILED"]
+    assert len(failed) == 1
+    assert failed["error_code"].iloc[0] != ""
+    assert failed["execution_s"].iloc[0] >= 0
+
+
+# ---------------------------------------------------------------------------
+# histogram metrics
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_stat_percentiles():
+    h = HistogramStat("t")
+    for v in [0.001] * 98 + [0.5, 2.0]:
+        h.add(v)
+    assert h.count == 100
+    assert h.quantile(0.5) <= 0.0018  # bucket upper bound near 1ms
+    assert h.quantile(0.99) >= 0.5
+    assert h.max == 2.0
+    snap = {}
+    h.snapshot_into(snap)
+    assert {"t.count", "t.p50", "t.p95", "t.p99", "t.max"} <= set(snap)
+
+
+def test_runtime_metrics_exposes_histogram_percentiles(conn):
+    s = Session({"tpch": conn})
+    s.sql("select count(*) c from nation")
+    df = s.sql("select name, value from runtime_metrics")
+    names = set(df["name"])
+    assert "query.execution_s.p50" in names
+    assert "query.execution_s.p95" in names
+    assert "query.execution_s.p99" in names
+
+
+def test_counter_and_timer_adds_are_thread_safe():
+    reg = MetricsRegistry()
+    c = reg.counter("race.counter")
+    t = reg.timer("race.timer")
+    h = reg.histogram("race.hist")
+
+    def bump():
+        for _ in range(5000):
+            c.add()
+            t.add(0.001)
+            h.add(0.001)
+
+    threads = [threading.Thread(target=bump) for _ in range(8)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert c.total == 8 * 5000
+    assert t.count == 8 * 5000
+    assert h.count == 8 * 5000
+
+
+def test_metrics_registry_reset():
+    reg = MetricsRegistry()
+    reg.counter("a").add(3)
+    reg.histogram("b").add(1.0)
+    reg.timer("c").add(1.0)
+    assert reg.snapshot()
+    reg.reset()
+    assert reg.snapshot() == {}
+
+
+# ---------------------------------------------------------------------------
+# QueryInfo phases (monotonic clock pair)
+# ---------------------------------------------------------------------------
+
+
+def test_queryinfo_durations_use_monotonic_pair():
+    info = QueryInfo(
+        query_id="q", sql="select 1", state="FINISHED",
+        created_at=1e9, created_mono=100.0, started_mono=100.5,
+        finished_mono=102.0, planning_s=0.25,
+        started_at=5.0, finished_at=2.0,  # wall clock stepped BACKWARD
+    )
+    assert info.queued_s == pytest.approx(0.5)
+    assert info.execution_s == pytest.approx(1.5)
+    assert info.elapsed_s == pytest.approx(1.5)  # not the -3s wall delta
+    d = json.loads(info.to_json())
+    assert d["queuedS"] == pytest.approx(0.5)
+    assert d["planningS"] == pytest.approx(0.25)
+    assert d["executionS"] == pytest.approx(1.5)
+
+
+def test_queryinfo_phases_populated_by_session(conn):
+    s = Session({"tpch": conn})
+    _df, info = s.execute("select count(*) c from nation")
+    assert info.created_mono is not None
+    assert info.started_mono is not None
+    assert info.finished_mono is not None
+    assert info.execution_s > 0
+    assert info.planning_s > 0
+
+
+# ---------------------------------------------------------------------------
+# stable node ids (satellite: id(node) reuse bug class)
+# ---------------------------------------------------------------------------
+
+
+def test_node_ids_pin_nodes_against_id_reuse():
+    import gc
+
+    class FakeNode:
+        children = ()
+
+    ids = NodeIds()
+    first = FakeNode()
+    first_id = ids.of(first)
+    addr = id(first)
+    del first
+    gc.collect()
+    # the pinned reference keeps the object alive: no new node can
+    # land on the same address and alias the id
+    assert ids._pinned and id(ids._pinned[0]) == addr
+    others = [FakeNode() for _ in range(64)]
+    assert all(id(o) != addr for o in others)
+    assert all(ids.of(o) != first_id for o in others)
+
+
+def test_stats_recorder_keys_by_stable_id():
+    class FakeNode:
+        children = ()
+
+    rec = StatsRecorder()
+    a, b = FakeNode(), FakeNode()
+    rec.record(a, 0.5, 10)
+    rec.record(b, 0.25, 20)
+    rec.record(a, 0.5)
+    sa, sb = rec.stats_for(a), rec.stats_for(b)
+    assert sa is not sb
+    assert sa.wall_s == pytest.approx(1.0) and sa.invocations == 2
+    assert sb.output_rows == 20
+    assert sa.node_id != sb.node_id
+
+
+def test_node_stats_carry_bytes_and_input_rows(conn):
+    s = Session({"tpch": conn})
+    _df, info = s.execute(Q_AGG)
+    by_type = {st["node"]: st for st in info.node_stats}
+    agg = by_type["Aggregate"]
+    assert agg["output_rows"] == 4
+    assert agg["input_rows"] > 100  # lineitem rows flowed in
+    assert agg["output_bytes"] > 0
+    assert agg["device_bytes"] >= agg["output_bytes"]
+    assert agg["nodeId"] >= 0
+
+
+def test_explain_analyze_enriched(conn):
+    s = Session({"tpch": conn})
+    out = s.explain_analyze("select count(*) c from region")
+    assert "bytes" in out
+    assert "rows" in out
+    assert "cache: result_cache:lookup" in out
+
+
+# ---------------------------------------------------------------------------
+# event dispatcher guarantees (satellite)
+# ---------------------------------------------------------------------------
+
+
+class _OrderListener:
+    def __init__(self):
+        self.events = []
+
+    def query_created(self, info):
+        self.events.append(("created", info.state))
+
+    def query_failed(self, info):
+        self.events.append(("failed", info.state))
+
+    def query_completed(self, info):
+        self.events.append(("completed", info.state))
+
+    def fragment_retried(self, info):
+        self.events.append(("retried", info.fragment_retries))
+
+
+def test_query_failed_fires_before_query_completed(conn):
+    from presto_tpu.runtime.faults import FaultInjector, injected
+
+    s = Session({"tpch": conn}, properties={"result_cache_enabled": False})
+    listener = _OrderListener()
+    s.add_event_listener(listener)
+    inj = FaultInjector()
+    inj.inject("scan", times=None)  # every scan fails; no retries armed
+    with injected(inj):
+        with pytest.raises(Exception):
+            s.sql("select count(*) c from nation")
+    kinds = [k for k, _ in listener.events]
+    assert "failed" in kinds and "completed" in kinds
+    assert kinds.index("failed") < kinds.index("completed")
+    # the failed event already sees the FAILED state
+    assert dict(listener.events)["failed"] == "FAILED"
+
+
+def test_fragment_retried_counts_visible_to_listeners(conn):
+    from presto_tpu.runtime.faults import FaultInjector, injected
+
+    s = Session(
+        {"tpch": conn},
+        properties={"retry_count": 3, "retry_backoff_s": 0.0,
+                    "result_cache_enabled": False},
+    )
+    listener = _OrderListener()
+    s.add_event_listener(listener)
+    inj = FaultInjector()
+    inj.inject("scan", times=2)
+    with injected(inj):
+        df = s.sql("select count(*) c from nation")
+    assert int(df["c"][0]) == 25
+    retries = [n for k, n in listener.events if k == "retried"]
+    # monotonically increasing counts, already incremented at fire time
+    assert retries == sorted(retries) and retries[0] >= 1
+    assert retries[-1] == 2
+
+
+def test_listener_exceptions_swallowed_and_counted(conn):
+    class Bad:
+        def query_completed(self, info):
+            raise RuntimeError("listener bug")
+
+    before = REGISTRY.snapshot().get("events.listener_errors", 0)
+    s = Session({"tpch": conn})
+    s.add_event_listener(Bad())
+    df = s.sql("select count(*) c from nation")  # must not fail
+    assert int(df["c"][0]) == 25
+    after = REGISTRY.snapshot().get("events.listener_errors", 0)
+    assert after >= before + 1
+
+
+# ---------------------------------------------------------------------------
+# overhead bound (acceptance: <5% on the warm-cache Q1 path)
+# ---------------------------------------------------------------------------
+
+
+def test_trace_overhead_under_5pct_warm_q1(conn):
+    props = {"result_cache_enabled": False}
+    s_on = Session({"tpch": conn}, properties=props)
+    s_off = Session(
+        {"tpch": conn}, properties={**props, "trace_enabled": False}
+    )
+    # warm the executable caches so neither side pays trace+compile
+    s_on.sql(Q_AGG)
+    s_off.sql(Q_AGG)
+
+    def best_of(rounds):
+        on, off = [], []
+        for _ in range(rounds):
+            t0 = time.perf_counter()
+            s_off.sql(Q_AGG)
+            off.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            s_on.sql(Q_AGG)
+            on.append(time.perf_counter() - t0)
+        return min(on), min(off)
+
+    # min-of-N interleaved runs estimates the noise-free cost; a real
+    # tracing regression is systematic and survives the min. Retry once
+    # with more rounds before failing: a loaded CI box can blow a 5%
+    # wall-clock bound with zero code defect, and the gate must only
+    # trip on the systematic case.
+    for rounds in (5, 9):
+        best_on, best_off = best_of(rounds)
+        if best_on <= best_off * 1.05 + 0.005:
+            return
+    raise AssertionError(
+        f"tracing overhead too high: on={best_on:.4f}s off={best_off:.4f}s"
+    )
+
+
+# ---------------------------------------------------------------------------
+# distributed acceptance (virtual mesh; slow tier like the other
+# distributed suites)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_distributed_q3_trace_acceptance(tmp_path):
+    from presto_tpu.connectors.tpch.queries import QUERIES
+    from presto_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(8)
+    s = Session(
+        {"tpch": TpchConnector(sf=0.005)}, mesh=mesh, trace_token="tok-q3"
+    )
+    df = s.sql(QUERIES["q3"])
+    assert len(df) > 0
+    rec = s.traces.latest()
+    # spans nest query -> node -> fragment -> step
+    steps = rec.spans_by_cat("step")
+    assert any(
+        {"query", "node", "fragment"} <= set(_span_path_cats(rec, sp))
+        for sp in steps
+    )
+    # one node span per executed plan node
+    plan = s.plan(QUERIES["q3"])
+
+    def count_nodes(n):
+        return 1 + sum(count_nodes(c) for c in n.children)
+
+    node_ids = {sp.args["plan_node_id"] for sp in rec.spans_by_cat("node")}
+    assert len(node_ids) == count_nodes(plan)
+    # exchange spans carry nonzero byte counts
+    ex = rec.spans_by_cat("exchange")
+    assert ex and sum(sp.args["bytes"] for sp in ex) > 0
+    assert all(sp.args["rounds"] >= 1 for sp in ex)
+    # exported JSON carries the trace token on every span
+    path = s.export_trace(str(tmp_path / "q3.json"))
+    data = json.load(open(path))
+    xs = [e for e in data["traceEvents"] if e.get("ph") == "X"]
+    assert xs and all(e["args"]["trace_token"] == "tok-q3" for e in xs)
+    # history row with phase timings
+    hist = s.sql(
+        "select query_id, execution_s, planning_s from query_history"
+    )
+    assert len(hist) == 1 and hist["execution_s"].iloc[0] > 0
