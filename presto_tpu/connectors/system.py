@@ -51,7 +51,19 @@ SCHEMAS: dict[str, dict[str, DataType]] = {
         "fragment_retries": BIGINT,
         "cache_hit": BIGINT,
         "degraded": BIGINT,
+        "oom_retries": BIGINT,
+        "memory_queued_s": DOUBLE,
         "error_code": fixed_bytes(32),
+    },
+    # live state of the memory pool this session admits through
+    # (runtime/memory.MemoryPool): one row, materialized at scan time
+    "memory_pool": {
+        "pool": fixed_bytes(16),
+        "capacity_bytes": BIGINT,
+        "reserved_bytes": BIGINT,
+        "free_bytes": BIGINT,
+        "active_queries": BIGINT,
+        "queued_queries": BIGINT,
     },
     # flattened span traces of recent queries (runtime/trace.py);
     # start_s is relative to the query's first span
@@ -141,7 +153,20 @@ class SystemConnector:
                 [i.fragment_retries for i in infos],
                 [int(i.cache_hit) for i in infos],
                 [int(i.degraded) for i in infos],
+                [i.oom_retries for i in infos],
+                [i.memory_queued_s for i in infos],
                 [i.error_code or "" for i in infos],
+            )
+        if table == "memory_pool":
+            pool = self._session.pool()
+            snap = pool.snapshot()  # one lock: internally consistent
+            return (
+                [pool.name],
+                [snap["capacity_bytes"]],
+                [snap["reserved_bytes"]],
+                [snap["free_bytes"]],
+                [snap["active_queries"]],
+                [snap["queued_queries"]],
             )
         if table == "trace_spans":
             qids, sids, pids_, names_, cats, starts, durs, nids, toks = (
@@ -198,7 +223,7 @@ class SystemConnector:
             }
         elif table == "query_history":
             (qid, state, sql, tok, queued, planning, execution, elapsed,
-             outrows, retries, hits, degraded, ecode) = rows
+             outrows, retries, hits, degraded, oomr, memq, ecode) = rows
             arrays = {
                 "query_id": _bytes_col(qid, 24),
                 "state": STATE_DICT.encode(state).astype(np.int32),
@@ -212,7 +237,19 @@ class SystemConnector:
                 "fragment_retries": np.asarray(retries, np.int64),
                 "cache_hit": np.asarray(hits, np.int64),
                 "degraded": np.asarray(degraded, np.int64),
+                "oom_retries": np.asarray(oomr, np.int64),
+                "memory_queued_s": np.asarray(memq, np.float64),
                 "error_code": _bytes_col(ecode, 32),
+            }
+        elif table == "memory_pool":
+            name, cap, reserved, free, active, queued = rows
+            arrays = {
+                "pool": _bytes_col(name, 16),
+                "capacity_bytes": np.asarray(cap, np.int64),
+                "reserved_bytes": np.asarray(reserved, np.int64),
+                "free_bytes": np.asarray(free, np.int64),
+                "active_queries": np.asarray(active, np.int64),
+                "queued_queries": np.asarray(queued, np.int64),
             }
         elif table == "trace_spans":
             (qid, sid, pid, name, cat, start, dur, nid, tok) = rows

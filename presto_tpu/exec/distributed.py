@@ -65,6 +65,7 @@ from presto_tpu.exec.operators import (
     TopNOperator,
     _phys_dtype,
 )
+from presto_tpu.exec.ladder import OomLadderMixin
 from presto_tpu.exec.pipeline import BatchSource, Pipeline
 from presto_tpu.expr import BIGINT, evaluate, bind_scalars
 from presto_tpu.ops.groupby import gather_padded, group_ids_sort, segment_agg
@@ -166,7 +167,7 @@ def _compact_local(b: Batch, out_cap: int) -> Batch:
     return Batch(cols, gather_padded(b.live, idx, False))
 
 
-class DistributedExecutor:
+class DistributedExecutor(OomLadderMixin):
     """Single-controller distributed executor over a worker mesh.
 
     Mirrors ``LocalExecutor``'s plan dispatch; every node either reuses
@@ -225,6 +226,11 @@ class DistributedExecutor:
         self.recorder = None
         #: stable plan-node ids for trace spans without a recorder
         self._trace_ids = None
+        #: adaptive OOM degradation ladder rung (exec/ladder.py): rung
+        #: 1 forces grouped (bucketed) execution and disables the
+        #: plan-time proven-broadcast shortcut; each further rung
+        #: doubles grouped bucket counts
+        self.oom_rung = 0
 
     # ------------------------------------------------------------------
     def run(self, plan: N.PlanNode):
@@ -526,7 +532,7 @@ class DistributedExecutor:
         from presto_tpu.runtime.memory import estimate_node_bytes
 
         est = estimate_node_bytes(node, self.catalog)
-        if est > self.join_build_budget:
+        if est > self.join_build_budget or self.oom_rung > 0:
             return self._grouped_dist_agg(d.batch, keys, aggs, pax, est)
         return self._dist_grouped_agg(d.batch, keys, aggs, pax)
 
@@ -537,6 +543,7 @@ class DistributedExecutor:
         quota stays fixed (sized for the balanced case = one round);
         retries double only the *receive* capacity, which overflows only
         when one device genuinely owns more groups than planned."""
+        fault_point("step.agg")
         fault_point("exchange.aggregate")
         Pn = self.nworkers
         cap_dev = b.capacity // Pn
@@ -727,6 +734,7 @@ class DistributedExecutor:
         info = getattr(self, "fragment_info", None)
         if (
             info is not None
+            and self.oom_rung == 0  # a runtime OOM refuted the proof
             and info.join_strategy.get(id(node)) == "broadcast"
             and info.join_fits_budget.get(id(node))
             and info.join_rows_ub.get(id(node), 1 << 62)
@@ -736,6 +744,7 @@ class DistributedExecutor:
             # plan-time proven (sound stats upper bound <= broadcast
             # limit AND <= join budget): skip the live_count device
             # sync and the budget readback entirely (plan/fragmenter.py)
+            fault_point("step.join_build")
             return self._broadcast_join(node, left, right, lkey, rkey,
                                         verify,
                                         rows_hint=info.join_rows_ub.get(
@@ -745,7 +754,8 @@ class DistributedExecutor:
         # hand — a stats overestimate must not force a host spill of a
         # build that fits)
         est = build_rows * node_row_bytes(node.right)
-        if est > self.join_build_budget:
+        spill = est > self.join_build_budget
+        if spill or (self.oom_rung > 0 and not verify):
             if verify:
                 raise NotImplementedError(
                     "wide string keys in grouped (spilled) joins"
@@ -756,6 +766,7 @@ class DistributedExecutor:
             sides = [left, right]
             del left, right
             return self._grouped_dist_join(node, sides, lkey, rkey, est)
+        fault_point("step.join_build")
         if (
             build_rows <= self.broadcast_limit
             or not right.sharded
@@ -1254,7 +1265,8 @@ class DistributedExecutor:
         free before the bucket passes start (a plain parameter would
         stay pinned by the caller's frame for the whole loop).
         """
-        nbuckets = max(2, int(-(-est_bytes // max(self.join_build_budget, 1))))
+        fault_point("step.grouped_join")
+        nbuckets = self._grouped_nbuckets(est_bytes)
         lcols, llive, lbids = self._pull_host(sides[0], lkey, nbuckets)
         sides[0] = None
         rcols, rlive, rbids = self._pull_host(sides[1], rkey, nbuckets)
@@ -1283,7 +1295,7 @@ class DistributedExecutor:
         from presto_tpu.ops.hashing import bucket_ids
 
         Pn = self.nworkers
-        nbuckets = max(2, int(-(-est_bytes // max(self.join_build_budget, 1))))
+        nbuckets = self._grouped_nbuckets(est_bytes)
 
         def key_sortables(local: Batch):
             return [
@@ -1357,7 +1369,7 @@ class DistributedExecutor:
 
         build_rows = live_count(right.batch)
         est = build_rows * node_row_bytes(node.right)
-        if est > self.join_build_budget:
+        if est > self.join_build_budget or self.oom_rung > 0:
             # bucketing is exact for semi AND anti: a probe key's
             # existence is decided entirely within its own bucket
             sides = [left, right]
@@ -1365,6 +1377,7 @@ class DistributedExecutor:
             return self._grouped_dist_join(
                 _SemiShim(node), sides, lkey, rkey, est
             )
+        fault_point("step.join_build")
         if (
             build_rows <= self.broadcast_limit
             or not right.sharded

@@ -1,0 +1,64 @@
+"""Adaptive OOM degradation ladder — shared executor state.
+
+One mixin so the two executors cannot drift: the lifecycle layer
+(``runtime/lifecycle.QueryManager._run_with_oom_ladder``) catches a
+runtime ``DeviceOutOfMemory``, calls :meth:`degrade_for_oom`, and
+re-runs the plan; the executors consult :attr:`oom_rung` at every
+grouped-execution decision. Rung semantics:
+
+- rung 0: trust the stats estimates (the normal path);
+- rung 1: force grouped (bucketed) execution for joins/semi-joins —
+  and, on the distributed tier, grouped aggregation — even though the
+  estimate said the build fits, and drop plan-time proven-broadcast
+  shortcuts (the OOM just refuted the proof);
+- rung k>=2: multiply grouped bucket counts by 2^(k-1) (capped) and
+  divide probe-chunk rows by the same factor (floored — the local
+  tier's host-spill chunks; the distributed tier's per-bucket
+  capacities already derive from actual counts).
+
+Local aggregations have no spill tier to re-plan onto (they already
+fold one morsel at a time into bounded device state), so for them a
+rung is a plain re-run — which only helps when the pressure was
+transient; the ladder cap keeps that bounded.
+"""
+
+from __future__ import annotations
+
+#: past this rung every ladder knob is at its floor/cap (nbuckets
+#: reaches the 1<<12 cap from 2 and probe chunks their 1<<10 floor at
+#: rung 12), so degrading further cannot change the plan
+OOM_RUNG_CAP = 12
+
+
+class OomLadderMixin:
+    """Ladder state + knob scaling shared by Local/DistributedExecutor."""
+
+    #: current ladder rung; class default 0, bumped per instance
+    oom_rung: int = 0
+
+    def degrade_for_oom(self) -> bool:
+        """Step one rung down the ladder; returns False when no further
+        degradation is possible — past OOM_RUNG_CAP a re-run would
+        execute the identical plan (the per-query budget below the cap
+        is ``oom_ladder_max``, enforced by the lifecycle layer)."""
+        if self.oom_rung >= OOM_RUNG_CAP:
+            return False
+        self.oom_rung += 1
+        return True
+
+    def _oom_factor(self) -> int:
+        """Knob multiplier of the current rung (1 at rungs 0 and 1 —
+        rung 1 only forces grouped mode; 2^(k-1) from rung 2 on)."""
+        return 1 << (self.oom_rung - 1) if self.oom_rung > 1 else 1
+
+    def _grouped_nbuckets(self, est_bytes: int) -> int:
+        """Bucket count of a grouped (spilled) execution:
+        ceil(estimate / budget), at least 2, scaled by the current
+        ladder rung (capped). The ONE formula both executors use —
+        duplicated copies would silently desync the tiers."""
+        n = max(2, int(-(-est_bytes // max(self.join_build_budget, 1))))
+        return min(n * self._oom_factor(), 1 << 12)
+
+    def _oom_probe_chunk(self, probe_chunk: int) -> int:
+        """Probe-chunk rows under the current rung (floored)."""
+        return max(probe_chunk // self._oom_factor(), 1 << 10)

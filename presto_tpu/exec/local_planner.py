@@ -28,6 +28,7 @@ import numpy as np
 
 from presto_tpu.batch import Batch, live_count
 from presto_tpu.exec.joins import BuildOutput, JoinBuildOperator, LookupJoinOperator
+from presto_tpu.exec.ladder import OomLadderMixin
 from presto_tpu.exec.operators import (
     AggSpec,
     CapacityOverflow,
@@ -52,6 +53,23 @@ from presto_tpu.types import TypeKind
 DIRECT_LIMIT = 4096
 MAX_GROUP_CAP = 1 << 20
 MAX_RETRIES = 6
+
+
+def _probe_capacity(lspill, nbuckets: int, probe_chunk: int) -> int:
+    """Compiled capacity of grouped-join probe chunks: bounded by the
+    rows a chunk can actually carry — ``probe_chunk`` caps accumulation,
+    the largest bucket caps the data, a single oversized spill chunk
+    passes through whole. Without the data bound, a budget-derived
+    ``probe_chunk`` (huge when grouped execution is FORCED by the OOM
+    ladder rather than by a genuine spill) would compile probe steps at
+    millions of padded rows for kilobytes of input."""
+    max_bucket = max(
+        (lspill.bucket_rows(b) for b in range(nbuckets)), default=0
+    )
+    return batch_capacity(
+        max(min(probe_chunk, max_bucket), lspill.max_chunk_rows(), 16),
+        minimum=16,
+    )
 
 
 def _null_column(dtype, cap: int, tail: tuple = ()):
@@ -104,7 +122,7 @@ def pick_group_strategy(keys, pax, dict_len, est_rows: int,
     return SortStrategy(min(batch_capacity(max(est_rows, 16)), MAX_GROUP_CAP))
 
 
-class LocalExecutor:
+class LocalExecutor(OomLadderMixin):
     def __init__(self, catalog: Catalog, join_build_budget: int | None = None,
                  direct_group_limit: int = DIRECT_LIMIT):
         self.catalog = catalog
@@ -124,6 +142,10 @@ class LocalExecutor:
             join_build_budget = device_budget_bytes() // 4
         self.join_build_budget = join_build_budget
         self.direct_group_limit = direct_group_limit
+        #: adaptive OOM degradation ladder rung (exec/ladder.py;
+        #: runtime/lifecycle.py bumps it via degrade_for_oom after a
+        #: runtime DeviceOutOfMemory and re-runs the plan)
+        self.oom_rung = 0
 
     # ------------------------------------------------------------------
     def run(self, plan: N.PlanNode):
@@ -305,6 +327,7 @@ class LocalExecutor:
             op = GlobalAggregationOperator(aggs)
             return BatchStream.of(Pipeline(child, [op]).run())
         strategy = self._pick_group_strategy(keys, pax, node, child)
+        fault_point("step.agg")
         for attempt in range(MAX_RETRIES):
             op = HashAggregationOperator(keys, aggs, strategy, passengers=pax)
             try:
@@ -431,21 +454,28 @@ class LocalExecutor:
         # estimate: their build sides in this suite are pre-aggregated
         # subqueries (q51/q97 shapes), and the grouped tier has no
         # unmatched-build tail yet
-        if est > self.join_build_budget and node.kind != "full":
+        spill = est > self.join_build_budget
+        if (spill or self.oom_rung > 0) and node.kind != "full":
             lkey, rkey, verify = self._join_key_exprs(
                 node.left_keys, node.right_keys, left, right_stream, scalars,
                 node.left, node.right,
             )
-            if verify:
+            if verify and spill:
                 raise NotImplementedError(
                     "wide string keys in grouped (spilled) joins"
                 )
-            return self._exec_grouped_join(
-                node, left, right_stream, lkey, rkey, est
-            )
+            if not verify:
+                return self._exec_grouped_join(
+                    node, left, right_stream, lkey, rkey, est
+                )
+            # ladder-forced grouped execution cannot handle wide string
+            # keys; the estimate said the build fits, so stay in-memory
         # the build side is inherently materialized (the lookup source
         # concatenates it); the PROBE side streams batch-by-batch
         right = right_stream.materialize()
+        from presto_tpu.runtime.faults import fault_point
+
+        fault_point("step.join_build")
         lkey, rkey, verify = self._join_key_exprs(
             node.left_keys, node.right_keys, left, right, scalars,
             node.left, node.right,
@@ -580,23 +610,21 @@ class LocalExecutor:
         from presto_tpu.exec.grouped import bucket_batches, spill_stream
         from presto_tpu.runtime.memory import node_row_bytes
 
-        nbuckets = max(2, int(-(-est_bytes // max(self.join_build_budget, 1))))
+        nbuckets = self._grouped_nbuckets(est_bytes)
+        # probe chunks sized so a chunk stays well under the budget
+        probe_chunk = self._oom_probe_chunk(max(
+            1 << 14,
+            self.join_build_budget // max(node_row_bytes(node.left), 1) // 4,
+        ))
         rspill = spill_stream(right_stream, rkey, nbuckets)
         lspill = spill_stream(left, lkey, nbuckets)
         outs = [BuildOutput(n, n) for n in node.output_right]
         rfields = {f.name: f for f in node.right.fields}
-        # probe chunks sized so a chunk stays well under the budget
-        probe_chunk = max(
-            1 << 14,
-            self.join_build_budget // max(node_row_bytes(node.left), 1) // 4,
-        )
         build_cap = batch_capacity(
             max(max((rspill.bucket_rows(b) for b in range(nbuckets)), default=0), 16),
             minimum=16,
         )
-        probe_cap = batch_capacity(
-            max(probe_chunk, lspill.max_chunk_rows(), 16), minimum=16
-        )
+        probe_cap = _probe_capacity(lspill, nbuckets, probe_chunk)
         build = JoinBuildOperator(rkey, capacity=build_cap)
         probe_ops: dict[tuple, LookupJoinOperator] = {}
 
@@ -621,6 +649,9 @@ class LocalExecutor:
         state = {"cap": batch_capacity(max(build_cap, probe_cap, 1024))}
 
         def make():
+            from presto_tpu.runtime.faults import fault_point
+
+            fault_point("step.grouped_join")
             for bk in range(nbuckets):
                 build_batch = rspill.bucket_batch(bk, capacity=build_cap)
                 probe_chunks = bucket_batches(lspill, bk, probe_chunk, probe_cap)
@@ -655,7 +686,7 @@ class LocalExecutor:
         from presto_tpu.runtime.memory import estimate_node_bytes
 
         est = estimate_node_bytes(node.right, self.catalog)
-        if est > self.join_build_budget:
+        if est > self.join_build_budget or self.oom_rung > 0:
             # grouped semi/anti: a probe key's existence is decided
             # entirely by its own hash bucket, so bucketing is exact
             # for both semi AND anti (an absent bucket means globally
@@ -668,6 +699,9 @@ class LocalExecutor:
                 raise NotImplementedError("wide string semi-join keys")
             return self._exec_grouped_semijoin(left, right_stream, lkey, rkey, est, jt)
         right = right_stream.materialize()
+        from presto_tpu.runtime.faults import fault_point
+
+        fault_point("step.join_build")
         lkey, rkey, verify = self._join_key_exprs(
             node.left_keys, node.right_keys, left, right, scalars,
             node.left, node.right,
@@ -689,21 +723,22 @@ class LocalExecutor:
                                est_bytes: int, jt: str):
         from presto_tpu.exec.grouped import bucket_batches, spill_stream
 
-        nbuckets = max(2, int(-(-est_bytes // max(self.join_build_budget, 1))))
+        nbuckets = self._grouped_nbuckets(est_bytes)
+        probe_chunk = self._oom_probe_chunk(1 << 18)
         rspill = spill_stream(right_stream, rkey, nbuckets)
         lspill = spill_stream(left, lkey, nbuckets)
-        probe_chunk = 1 << 18
         build_cap = batch_capacity(
             max(max((rspill.bucket_rows(b) for b in range(nbuckets)), default=0), 16),
             minimum=16,
         )
-        probe_cap = batch_capacity(
-            max(probe_chunk, lspill.max_chunk_rows(), 16), minimum=16
-        )
+        probe_cap = _probe_capacity(lspill, nbuckets, probe_chunk)
         build = JoinBuildOperator(rkey, capacity=build_cap)
         op = LookupJoinOperator(build, lkey, (), jt)
 
         def make():
+            from presto_tpu.runtime.faults import fault_point
+
+            fault_point("step.grouped_join")
             for bk in range(nbuckets):
                 build_batch = rspill.bucket_batch(bk, capacity=build_cap)
                 probe_chunks = bucket_batches(lspill, bk, probe_chunk, probe_cap)

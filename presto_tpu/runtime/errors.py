@@ -63,6 +63,20 @@ class ResourceExhausted(PrestoError, RuntimeError):
     retryable = False
 
 
+class DeviceOutOfMemory(ResourceExhausted):
+    """A runtime (backend) out-of-memory: XLA raised RESOURCE_EXHAUSTED
+    mid-dispatch, i.e. a plan-time estimate was WRONG and the static
+    spill decision under-provisioned. Not retryable as-is — replaying
+    the same compiled step allocates the same buffers — but
+    *recoverable*: the lifecycle layer's adaptive degradation ladder
+    (``oom_ladder_max``) re-plans the query with grouped execution /
+    more buckets / smaller probe chunks and re-runs it, so a wrong
+    estimate degrades throughput instead of correctness."""
+
+    error_code = "DEVICE_OUT_OF_MEMORY"
+    retryable = False
+
+
 class ExceededTimeLimit(PrestoError, RuntimeError):
     """The per-query wall-clock deadline (``query_max_run_time``)
     expired. Not retryable within the query — a retry starts from zero
@@ -96,6 +110,23 @@ def is_retryable(exc: BaseException) -> bool:
     level ``query_retries`` still re-runs them — that knob predates
     the taxonomy and deliberately retries everything)."""
     return bool(getattr(exc, "retryable", False))
+
+
+def is_backend_oom(exc: BaseException) -> bool:
+    """Does ``exc`` look like a backend out-of-memory? Matches the
+    shapes the runtime actually throws — ``XlaRuntimeError`` carrying a
+    RESOURCE_EXHAUSTED status, allocator "out of memory" messages, and
+    stdlib ``MemoryError`` — plus the injector's backend-shaped
+    ``BackendOom`` (runtime/faults.py), which exists so the recovery
+    ladder is testable on CPU. Taxonomy errors are never re-classified:
+    a ``ResourceExhausted`` admission rejection mentioning bytes must
+    not morph into a recoverable device OOM."""
+    if isinstance(exc, PrestoError):
+        return False
+    if isinstance(exc, MemoryError):
+        return True
+    msg = str(exc)
+    return "RESOURCE_EXHAUSTED" in msg or "out of memory" in msg.lower()
 
 
 def error_code(exc: BaseException) -> str:

@@ -34,6 +34,8 @@ class EventListener(Protocol):
 
     def fragment_retried(self, info: QueryInfo) -> None: ...
 
+    def query_degraded(self, info: QueryInfo) -> None: ...
+
 
 class EventDispatcher:
     def __init__(self, listeners=()):
@@ -75,6 +77,12 @@ class EventDispatcher:
         """Fired on each fragment retry; ``info.fragment_retries`` has
         already been incremented when listeners see it."""
         self._fire("fragment_retried", info)
+
+    def query_degraded(self, info: QueryInfo):
+        """Fired each time the OOM recovery ladder steps a rung down
+        (``info.oom_retries`` already reflects the new rung) — the
+        runtime-OOM analog of fragment_retried."""
+        self._fire("query_degraded", info)
 
 
 class QueryHistoryBuffer:

@@ -39,7 +39,25 @@ SITES = (
     "exchange.window",  # partitioned-window shuffle
     "exchange.sort",  # range-partition sort shuffle
     "aggregation",  # aggregation dispatch (local + distributed)
+    "step.join_build",  # in-memory join build materialization/dispatch
+    "step.grouped_join",  # grouped (bucketed) join bucket passes
+    "step.agg",  # grouped-aggregation jitted-step dispatch
 )
+
+
+class BackendOom(RuntimeError):
+    """Backend-SHAPED out-of-memory for the ``oom`` fault kind: NOT a
+    taxonomy error — it mimics what ``jaxlib``'s ``XlaRuntimeError``
+    raises at a jitted-step dispatch when HBM runs out, so the mapping
+    layer (``runtime/errors.is_backend_oom`` at the fragment boundary)
+    and the degradation ladder above it are exercised end-to-end on
+    CPU, where a real allocator OOM is impractical to stage."""
+
+    def __init__(self, message: str = ""):
+        super().__init__(
+            "RESOURCE_EXHAUSTED: Out of memory while trying to allocate "
+            "device buffer" + (f" ({message})" if message else " (injected)")
+        )
 
 
 @dataclass
@@ -48,14 +66,29 @@ class FaultSpec:
 
     site: str
     error: type = TransientFailure
-    #: fire on the first N matching calls (None = every matching call)
+    #: fire on the first N matching calls (None = every matching call);
+    #: with ``per_site`` the bound applies to each CONCRETE site a
+    #: prefix spec matches, not to the spec as a whole
     times: int | None = 1
     probability: float = 1.0
     message: str = ""
+    per_site: bool = False
     fired: int = 0
+    fired_by_site: dict = field(default_factory=dict)
 
     def matches(self, site: str) -> bool:
         return site == self.site or site.startswith(self.site + ".")
+
+    def exhausted(self, site: str) -> bool:
+        if self.times is None:
+            return False
+        if self.per_site:
+            return self.fired_by_site.get(site, 0) >= self.times
+        return self.fired >= self.times
+
+    def record_fire(self, site: str) -> None:
+        self.fired += 1
+        self.fired_by_site[site] = self.fired_by_site.get(site, 0) + 1
 
 
 @dataclass
@@ -76,11 +109,28 @@ class FaultInjector:
         times: int | None = 1,
         probability: float = 1.0,
         message: str = "",
+        per_site: bool = False,
     ) -> FaultSpec:
         """Arm a fault at ``site`` (or any descendant ``site.*``)."""
-        spec = FaultSpec(site, error, times, probability, message)
+        spec = FaultSpec(site, error, times, probability, message, per_site)
         self.specs.append(spec)
         return spec
+
+    def inject_oom(
+        self,
+        site: str = "step",
+        times: int | None = 1,
+        probability: float = 1.0,
+        per_site: bool = True,
+    ) -> FaultSpec:
+        """The ``oom`` fault kind: a backend-shaped RESOURCE_EXHAUSTED
+        (:class:`BackendOom`) at jitted-step dispatch sites, with
+        deterministic PER-SITE fire counts by default — "the in-memory
+        build OOMs twice, the grouped pass succeeds" is expressible as
+        one spec. The fragment boundary maps the raise into the typed
+        ``DeviceOutOfMemory``, which drives the degradation ladder."""
+        return self.inject(site, error=BackendOom, times=times,
+                           probability=probability, per_site=per_site)
 
     def fired(self, site: str | None = None) -> int:
         """Total fires, optionally restricted to one armed site."""
@@ -88,19 +138,24 @@ class FaultInjector:
             s.fired for s in self.specs if site is None or s.site == site
         )
 
+    def fired_at(self, site: str) -> int:
+        """Fires recorded at one CONCRETE site, across every spec
+        (prefix specs included)."""
+        return sum(s.fired_by_site.get(site, 0) for s in self.specs)
+
     def check(self, site: str) -> None:
         """Raise the first armed fault matching ``site`` (hook-point
         body; engine code calls :func:`fault_point` instead)."""
         for spec in self.specs:
             if not spec.matches(site):
                 continue
-            if spec.times is not None and spec.fired >= spec.times:
+            if spec.exhausted(site):
                 continue
             if spec.probability < 1.0 and (
                 self._rng.random() >= spec.probability
             ):
                 continue
-            spec.fired += 1
+            spec.record_fire(site)
             msg = spec.message or (
                 f"injected fault at {site!r} (fire #{spec.fired})"
             )

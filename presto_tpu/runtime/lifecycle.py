@@ -50,19 +50,14 @@ from dataclasses import dataclass
 from typing import Callable, Optional
 
 from presto_tpu.runtime.errors import (
+    DeviceOutOfMemory,
     ExceededTimeLimit,
     ResourceExhausted,
+    is_backend_oom,
     is_retryable,
 )
 from presto_tpu.runtime.metrics import REGISTRY
 from presto_tpu.runtime.trace import span as trace_span
-
-#: admission headroom over the device budget when no explicit
-#: ``query_max_memory_bytes`` is set: node estimates are loose upper
-#: shapes, and the grouped/streaming tiers keep true residency far
-#: below them — the default only rejects queries that would dwarf the
-#: device by any execution strategy
-DEFAULT_ADMISSION_HEADROOM = 64
 
 #: cap on one exponential-backoff sleep (a retry loop must never turn
 #: a deadline miss into a multi-minute hang)
@@ -122,6 +117,21 @@ def check_deadline(where: str = "driver") -> None:
         ctx.check_deadline(where)
 
 
+def _map_backend_oom(e: BaseException, where: str):
+    """Classify a backend RESOURCE_EXHAUSTED / allocator OOM raised at
+    a dispatch boundary into the taxonomy. Returns the typed
+    ``DeviceOutOfMemory`` to raise, or None when ``e`` is not an OOM.
+    Every dispatch in both executors funnels through
+    :func:`run_fragment`, so this single choke point covers all jitted
+    -step sites — including lazy streams drained by an ancestor."""
+    if not is_backend_oom(e):
+        return None
+    REGISTRY.counter("query.backend_oom").add()
+    return DeviceOutOfMemory(
+        f"backend out of memory at {where}: {type(e).__name__}: {e}"
+    )
+
+
 def run_fragment(label: str, fn: Callable[[], object]):
     """Execute one fragment dispatch under the active lifecycle: the
     deadline is checked at entry and between attempts, and retryable
@@ -129,11 +139,20 @@ def run_fragment(label: str, fn: Callable[[], object]):
     times. Exceptions that exhausted their retries here are tagged
     (``_presto_retries_exhausted``) so every ancestor dispatch — whose
     body re-invokes this fragment — re-raises instead of multiplying
-    the retry budget by the plan depth."""
+    the retry budget by the plan depth. Backend OOMs (real XLA
+    RESOURCE_EXHAUSTED or the injected ``oom`` fault kind) map into
+    ``DeviceOutOfMemory`` here — non-retryable at the fragment level,
+    recoverable by the query-level degradation ladder."""
     ctx = _CURRENT.get()
     if ctx is None:
         with trace_span(label, "fragment"):
-            return fn()
+            try:
+                return fn()
+            except Exception as e:
+                oom = _map_backend_oom(e, label)
+                if oom is not None:
+                    raise oom from e
+                raise
     ctx.check_deadline(label)
     attempts = max(0, ctx.retry.count)
     dispatch_h = REGISTRY.histogram("fragment.dispatch_s")
@@ -145,6 +164,9 @@ def run_fragment(label: str, fn: Callable[[], object]):
             ), dispatch_h.time():
                 return fn()
         except Exception as e:
+            oom = _map_backend_oom(e, label)
+            if oom is not None:
+                raise oom from e
             exhausted = getattr(e, "_presto_retries_exhausted", False)
             if not is_retryable(e) or exhausted or attempt == attempts:
                 if is_retryable(e):
@@ -202,22 +224,57 @@ class QueryManager:
         limit = self.session.prop("query_max_memory_bytes")
         if limit is not None:
             return int(limit)
-        from presto_tpu.runtime.memory import device_budget_bytes
+        # the SAME headroom constant sizes the default shared pool, so
+        # the per-query backstop and the pool capacity cannot drift
+        from presto_tpu.runtime.memory import (
+            DEFAULT_POOL_HEADROOM,
+            device_budget_bytes,
+        )
 
-        return device_budget_bytes() * DEFAULT_ADMISSION_HEADROOM
+        return device_budget_bytes() * DEFAULT_POOL_HEADROOM
 
-    def admit(self, plan) -> None:
-        """Reject (ResourceExhausted) before launch when the plan's
-        peak estimated materialization exceeds the admission limit."""
+    def admit(self, plan, info, pool) -> None:
+        """Admission in two stages: the per-query limit rejects
+        (ResourceExhausted) before launch when the plan's peak
+        estimated materialization exceeds it; then the shared memory
+        pool takes a byte reservation for that peak, QUEUING (bounded
+        FIFO, ``admission_queue_timeout_s``) while concurrent queries
+        hold the pool — block-then-run instead of reject-or-nothing.
+        Rejection/timeout messages carry the estimate, the limit, the
+        offending node type, and the live pool reservations."""
         limit = self.admission_limit()
         peak, node = peak_estimate_bytes(plan, self.session.catalog)
         if peak > limit:
             REGISTRY.counter("query.admission_rejected").add()
             raise ResourceExhausted(
                 f"admission control: {node} is estimated to materialize "
-                f"{peak} bytes, over the limit of {limit} bytes (set the "
-                "query_max_memory_bytes session property to raise it)"
+                f"{peak} bytes, over the limit of {limit} bytes "
+                f"({pool.describe()}; set the query_max_memory_bytes "
+                "session property to raise it)"
             )
+        timeout_s = self.session.prop("admission_queue_timeout_s")
+        deadline_s = self.session.prop("query_max_run_time")
+        if deadline_s is not None:
+            # the run-time deadline's clock starts AFTER admission, so
+            # cap the queue wait by it — a 5s-deadline query must not
+            # sit 30s in the pool queue and still look on-time
+            timeout_s = (
+                deadline_s if timeout_s is None
+                else min(timeout_s, deadline_s)
+            )
+        t0 = time.monotonic()
+        try:
+            queued_s = pool.reserve(
+                info.query_id, peak,
+                timeout_s=timeout_s,
+                detail=f"peak estimate {peak} bytes at {node}",
+            )
+        except ResourceExhausted:
+            # a timed-out query queued the LONGEST — record its wait
+            info.memory_queued_s = time.monotonic() - t0
+            raise
+        info.memory_reserved_bytes = peak
+        info.memory_queued_s = queued_s
 
     # -- execution scope ------------------------------------------------
     def _context(self, info) -> QueryContext:
@@ -241,35 +298,97 @@ class QueryManager:
         return ctx
 
     def run_plan(self, executor, plan, info, recorder):
-        """Run a plan under the full lifecycle: admission, deadline
-        scope, fragment retry (enforced at the executors' dispatch
-        boundaries via the context), and distributed->local
-        degradation as the last resort."""
-        with trace_span("admission", "lifecycle"):
-            self.admit(plan)
-        ctx = self._context(info)
-        token = _CURRENT.set(ctx)
+        """Run a plan under the full lifecycle: queued admission
+        against the shared memory pool, deadline scope, fragment retry
+        (enforced at the executors' dispatch boundaries via the
+        context), the adaptive OOM degradation ladder, and
+        distributed->local degradation as the last resort. The pool
+        reservation is released on EVERY terminal state."""
+        pool = self.session.pool()
         try:
+            with trace_span("admission", "lifecycle"):
+                self.admit(plan, info, pool)
+        finally:
+            # admission — including any time blocked in the pool's
+            # FIFO queue — is QUEUED time, not execution: re-stamp the
+            # RUNNING transition on success AND failure so
+            # queued_s/execution_s split at the true run start, never
+            # double-counting the wait as execution (the cache-hit
+            # path does not reach here and keeps its original stamp)
+            info.started_at = time.time()
+            info.started_mono = time.monotonic()
+        try:
+            ctx = self._context(info)
+            token = _CURRENT.set(ctx)
+            try:
+                # timed post-admission, so the execution histogram
+                # agrees with QueryInfo.execution_s (pool wait is
+                # QUEUED)
+                with REGISTRY.histogram("query.execution_s").time():
+                    return self._run_with_oom_ladder(executor, plan, info,
+                                                     recorder, ctx)
+            finally:
+                info.fragment_retries = ctx.fragment_retries
+                _CURRENT.reset(token)
+        finally:
+            # the release guard covers EVERYTHING after a successful
+            # reservation — even an async exception before the inner
+            # scope installs would otherwise leak pool capacity for
+            # the life of the process
+            pool.release(info.query_id)
+
+    def _run_with_oom_ladder(self, executor, plan, info, recorder, ctx):
+        """The adaptive OOM recovery loop (robust-hash-join posture,
+        PAPERS.md arXiv:2112.02480): a runtime ``DeviceOutOfMemory`` —
+        a WRONG low estimate the static spill decision trusted — does
+        not kill the query; the executor steps one rung down its
+        degradation ladder (force grouped execution, then double
+        buckets / halve probe chunks) and the plan re-runs, up to
+        ``oom_ladder_max`` rungs. Deterministic re-planning, not a
+        blind replay: each rung strictly shrinks per-step residency, so
+        wrong estimates degrade throughput, never correctness."""
+        ladder_max = self.session.prop("oom_ladder_max")
+        rung = 0
+        while True:
             try:
                 return executor.run(plan)
+            except DeviceOutOfMemory as e:
+                degrade = getattr(executor, "degrade_for_oom", None)
+                if rung >= ladder_max or degrade is None or not degrade():
+                    raise
+                rung += 1
+                # additive: a degraded-to-local run's ladder continues
+                # the count the distributed attempt started
+                info.oom_retries += 1
+                REGISTRY.counter("query.oom_degraded").add()
+                self.session.events.query_degraded(info)
+                if recorder is not None:
+                    # stats from the OOMed attempt must not leak into
+                    # (or double-count in) the re-run's QueryInfo
+                    recorder.nodes.clear()
+                with trace_span(
+                    "oom_degrade", "lifecycle",
+                    {"rung": rung, "error": str(e)[:120]},
+                ):
+                    ctx.check_deadline("oom_ladder")
             except Exception as e:
                 if (
                     is_retryable(e)
                     and getattr(executor, "mesh", None) is not None
                     and self.session.prop("degrade_to_local")
                 ):
-                    return self._degrade(plan, info, recorder)
+                    return self._degrade(plan, info, recorder, ctx)
                 raise
-        finally:
-            info.fragment_retries = ctx.fragment_retries
-            _CURRENT.reset(token)
 
-    def _degrade(self, plan, info, recorder):
+    def _degrade(self, plan, info, recorder, ctx):
         """Re-plan a failed distributed query onto the single-device
         local pipeline (graceful degradation; the deadline keeps
         running — the retry context stays installed, and if the local
         run fails too, implicit ``__context__`` chaining preserves the
-        original distributed failure)."""
+        original distributed failure). The degraded run gets its OWN
+        OOM ladder: one device now holds mesh-size times the data, so
+        an in-memory build that fit distributed may genuinely OOM here
+        — exactly the case the ladder recovers."""
         from presto_tpu.exec.local_planner import LocalExecutor
 
         REGISTRY.counter("query.degraded_to_local").add()
@@ -287,4 +406,5 @@ class QueryManager:
             recorder.nodes.clear()
         local.recorder = recorder
         with trace_span("degrade_to_local", "lifecycle"):
-            return local.run(plan)
+            return self._run_with_oom_ladder(local, plan, info, recorder,
+                                             ctx)

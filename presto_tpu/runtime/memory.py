@@ -1,4 +1,4 @@
-"""Device-memory budgeting — the L9 capacity planner.
+"""Device-memory budgeting and arbitration — the L9 capacity planner.
 
 Reference parity: ``MemoryPool`` / ``QueryContext`` / the
 ``MemoryRevokingScheduler``-triggered spill decision [SURVEY §2.1 L9
@@ -7,30 +7,247 @@ allocations are planned at compile time — so budgeting happens at PLAN
 time: the executor estimates a fragment's device-resident bytes from
 connector stats and chooses grouped (bucketed) execution with host-RAM
 offload BEFORE compiling, instead of reacting to pressure mid-flight.
+
+Arbitration (:class:`MemoryPool`): concurrent queries reserve their
+peak stats-estimated bytes at admission from a shared pool and release
+on every terminal state. A query that does not fit QUEUES (bounded
+FIFO, ``admission_queue_timeout_s``) instead of failing — the
+block-then-run behavior the reference gets from ``MemoryPool`` +
+cluster admission. When the estimate is wrong *low* anyway, the
+runtime OOM recovery ladder (runtime/lifecycle.py) takes over.
 """
 
 from __future__ import annotations
 
+import threading
+import time
+from collections import deque
+
 from presto_tpu.plan import nodes as N
+from presto_tpu.runtime.metrics import REGISTRY
 from presto_tpu.types import DataType, TypeKind
 
 #: conservative default when the backend exposes no memory stats
 #: (v5e chip = 16 GB HBM; leave headroom for XLA scratch + outputs)
 DEFAULT_BUDGET_BYTES = 8 << 30
 
+#: floor on the computed budget: a warm process whose allocator already
+#: holds most of the device must still be able to run *small* queries
+#: (the grouped/streaming tiers bound true residency far below the
+#: budget, and XLA reuses the held buffers)
+MIN_BUDGET_BYTES = 256 << 20
+
+#: headroom over the device budget shared by the default admission
+#: limit (runtime/lifecycle.py imports this) AND the default pool
+#: capacity: node estimates are loose upper shapes and the grouped/
+#: streaming tiers keep true residency far below them, so both
+#: backstops only reject queries that would dwarf the device under any
+#: execution strategy
+DEFAULT_POOL_HEADROOM = 64
+
+
+#: default-device budget, snapshotted at FIRST use: budget-derived
+#: compiled-step capacities (nbuckets, probe chunks) feed the
+#: content-keyed executable cache, so the budget must not drift with
+#: the allocator's live bytes_in_use between queries — that would
+#: recompile warm steps every run. The snapshot still reflects what
+#: was already held when the engine started (the warm-process case the
+#: subtraction exists for).
+_DEFAULT_BUDGET: int | None = None
+
 
 def device_budget_bytes(device=None) -> int:
-    """Usable device memory for resident operator state."""
+    """Usable device memory for resident operator state: half the
+    backend's byte limit MINUS what the allocator already held at
+    first call (a warm process must not over-admit against memory it
+    cannot get back), floored at :data:`MIN_BUDGET_BYTES`. The default
+    -device value is computed once per process; passing an explicit
+    ``device`` always measures fresh."""
+    global _DEFAULT_BUDGET
+    if device is None and _DEFAULT_BUDGET is not None:
+        return _DEFAULT_BUDGET
     import jax
 
     dev = device or jax.devices()[0]
+    budget = DEFAULT_BUDGET_BYTES
     try:
         stats = dev.memory_stats()
         if stats and "bytes_limit" in stats:
-            return int(stats["bytes_limit"] * 0.5)
+            budget = int(stats["bytes_limit"] * 0.5)
+            budget -= int(stats.get("bytes_in_use", 0))
+            budget = max(budget, MIN_BUDGET_BYTES)
     except Exception:  # noqa: BLE001 — CPU/interpret backends
         pass
-    return DEFAULT_BUDGET_BYTES
+    if device is None:
+        _DEFAULT_BUDGET = budget
+    return budget
+
+
+class MemoryPool:
+    """Byte-reservation arbiter shared by concurrent queries.
+
+    ``reserve`` blocks in strict FIFO order (head-of-line: a large
+    query cannot be starved by a stream of small ones) until the
+    reservation fits or ``timeout_s`` expires; ``release`` is
+    idempotent per query id and wakes every waiter. Reservations are
+    *estimates* — the pool bounds concurrent admission, the grouped
+    tiers bound true residency.
+    """
+
+    def __init__(self, capacity_bytes: int, name: str = "pool"):
+        self.capacity_bytes = int(capacity_bytes)
+        self.name = name
+        self._cv = threading.Condition()
+        self._reservations: dict[str, int] = {}
+        self._queue: deque = deque()  # FIFO waiter tickets
+
+    # ---- observability ---------------------------------------------------
+    @property
+    def reserved_bytes(self) -> int:
+        with self._cv:
+            return sum(self._reservations.values())
+
+    @property
+    def free_bytes(self) -> int:
+        return self.capacity_bytes - self.reserved_bytes
+
+    @property
+    def active_count(self) -> int:
+        with self._cv:
+            return len(self._reservations)
+
+    @property
+    def queued_count(self) -> int:
+        with self._cv:
+            return len(self._queue)
+
+    def reservations(self) -> "dict[str, int]":
+        with self._cv:
+            return dict(self._reservations)
+
+    def snapshot(self) -> "dict[str, int]":
+        """One internally-consistent reading of the pool gauges (a
+        single lock acquisition — the ``system.memory_pool`` row must
+        not mix states from before and after a concurrent release)."""
+        with self._cv:
+            reserved = sum(self._reservations.values())
+            return {
+                "capacity_bytes": self.capacity_bytes,
+                "reserved_bytes": reserved,
+                "free_bytes": self.capacity_bytes - reserved,
+                "active_queries": len(self._reservations),
+                "queued_queries": len(self._queue),
+            }
+
+    def describe(self) -> str:
+        """One-line pool state for admission error messages."""
+        with self._cv:
+            reserved = sum(self._reservations.values())
+            return (
+                f"pool {self.name!r}: {reserved}/{self.capacity_bytes} "
+                f"bytes reserved by {len(self._reservations)} queries, "
+                f"{len(self._queue)} queued"
+            )
+
+    # ---- reserve / release ----------------------------------------------
+    def reserve(self, query_id: str, nbytes: int,
+                timeout_s: float | None = None, detail: str = "") -> float:
+        """Reserve ``nbytes`` for ``query_id``, blocking FIFO until the
+        pool has room. Returns the seconds spent queued. Raises
+        ``ResourceExhausted`` immediately when the reservation can
+        NEVER fit, or after ``timeout_s`` in the queue."""
+        from presto_tpu.runtime.errors import ResourceExhausted
+
+        nbytes = max(0, int(nbytes))
+        ctx = f" ({detail})" if detail else ""
+        if nbytes > self.capacity_bytes:
+            REGISTRY.counter("memory.rejected").add()
+            raise ResourceExhausted(
+                f"admission control: reservation of {nbytes} bytes{ctx} "
+                f"exceeds the whole memory pool capacity of "
+                f"{self.capacity_bytes} bytes ({self.describe()}; set the "
+                "memory_pool_bytes session property to raise it)"
+            )
+        t0 = time.monotonic()
+        deadline = None if timeout_s is None else t0 + timeout_s
+        ticket = object()
+        waited = False
+        with self._cv:
+            self._queue.append(ticket)
+            try:
+                while not (
+                    self._queue[0] is ticket
+                    and sum(self._reservations.values()) + nbytes
+                    <= self.capacity_bytes
+                ):
+                    remaining = (
+                        None if deadline is None
+                        else deadline - time.monotonic()
+                    )
+                    if remaining is not None and remaining <= 0:
+                        REGISTRY.counter("memory.queue_timeouts").add()
+                        REGISTRY.counter("memory.queued").add()
+                        # the longest waits are exactly the ones that
+                        # time out — they must show in the histogram
+                        REGISTRY.histogram("memory.queued_s").add(
+                            time.monotonic() - t0
+                        )
+                        raise ResourceExhausted(
+                            f"admission queue timeout: {query_id} waited "
+                            f"{timeout_s}s to reserve {nbytes} bytes{ctx} "
+                            f"({self.describe()}; raise "
+                            "admission_queue_timeout_s or "
+                            "memory_pool_bytes)"
+                        )
+                    waited = True
+                    self._cv.wait(remaining)
+                self._reservations[query_id] = (
+                    self._reservations.get(query_id, 0) + nbytes
+                )
+            finally:
+                self._queue.remove(ticket)
+                self._cv.notify_all()
+        queued_s = time.monotonic() - t0
+        REGISTRY.counter("memory.reserved").add()
+        if waited:
+            REGISTRY.counter("memory.queued").add()
+            REGISTRY.histogram("memory.queued_s").add(queued_s)
+        return queued_s
+
+    def release(self, query_id: str) -> int:
+        """Drop ``query_id``'s reservation (idempotent; every terminal
+        state calls this). Returns the bytes freed."""
+        with self._cv:
+            freed = self._reservations.pop(query_id, None)
+            self._cv.notify_all()
+        if freed is None:
+            return 0
+        REGISTRY.counter("memory.released").add()
+        return freed
+
+
+_GLOBAL_POOL: MemoryPool | None = None
+_GLOBAL_POOL_LOCK = threading.Lock()
+
+
+def global_pool() -> MemoryPool:
+    """The process-wide default pool every Session without an explicit
+    pool (or ``memory_pool_bytes`` override) arbitrates through —
+    concurrent sessions in one process share the device, so they share
+    the pool. Sized lazily at first use."""
+    global _GLOBAL_POOL
+    with _GLOBAL_POOL_LOCK:
+        if _GLOBAL_POOL is None:
+            _GLOBAL_POOL = MemoryPool(
+                device_budget_bytes() * DEFAULT_POOL_HEADROOM, name="global"
+            )
+        return _GLOBAL_POOL
+
+
+def pool_leaks() -> "dict[str, int]":
+    """Reservations still held in the global pool (the test-suite
+    leak-check: every terminal query state must have released)."""
+    return {} if _GLOBAL_POOL is None else _GLOBAL_POOL.reservations()
 
 
 def column_bytes(dtype: DataType) -> int:

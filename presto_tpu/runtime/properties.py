@@ -144,6 +144,36 @@ SESSION_PROPERTIES: dict[str, PropertyDef] = {
             _positive,
         ),
         PropertyDef(
+            "memory_pool_bytes", int, None,
+            "Capacity of the memory pool this session arbitrates "
+            "admission through. None (default): the PROCESS-wide shared "
+            "pool (64x the device budget) — concurrent sessions share "
+            "the device, so they share the pool. Setting it gives the "
+            "session a private pool of that size (tests, tenant "
+            "isolation); passing Session(memory_pool=...) shares an "
+            "explicit pool object across sessions.",
+            _positive,
+        ),
+        PropertyDef(
+            "admission_queue_timeout_s", float, 30.0,
+            "How long a query may wait in the memory pool's FIFO "
+            "admission queue for its byte reservation before failing "
+            "with ResourceExhausted. Concurrent queries that together "
+            "exceed the pool block-then-run instead of failing; the "
+            "timeout bounds the wait. 0 restores reject-or-nothing.",
+            _non_negative,
+        ),
+        PropertyDef(
+            "oom_ladder_max", int, 4,
+            "Rungs of the adaptive runtime-OOM degradation ladder: a "
+            "backend RESOURCE_EXHAUSTED at a jitted-step dispatch "
+            "re-plans the query with grouped (bucketed) execution, then "
+            "doubled bucket counts / halved probe chunks, and re-runs — "
+            "up to this many times before the DeviceOutOfMemory "
+            "surfaces. 0 disables runtime OOM recovery.",
+            _non_negative,
+        ),
+        PropertyDef(
             "retry_count", int, 0,
             "Fragment-level retries for RETRYABLE failures (injected "
             "faults, transient device loss — see runtime/errors.py): a "

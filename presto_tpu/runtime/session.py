@@ -44,12 +44,16 @@ _query_seq = itertools.count(1)
 
 class Session:
     def __init__(self, connectors: Mapping[str, object], properties=None,
-                 mesh=None, trace_token: Optional[str] = None):
+                 mesh=None, trace_token: Optional[str] = None,
+                 memory_pool=None):
         """``mesh=None`` runs single-device (the LocalQueryRunner shape);
         passing a ``jax.sharding.Mesh`` runs every query distributed
         over its ``workers`` axis (the DistributedQueryRunner shape).
         Session properties override engine defaults per query, the
-        reference's SystemSessionProperties rule [SURVEY §5.6]."""
+        reference's SystemSessionProperties rule [SURVEY §5.6].
+        ``memory_pool`` shares an explicit ``runtime.memory.MemoryPool``
+        across sessions (default: the process-wide pool, or a private
+        one when ``memory_pool_bytes`` is set)."""
         from presto_tpu.connectors.memory import MemoryConnector
         from presto_tpu.connectors.system import SystemConnector
         from presto_tpu.runtime.properties import validate_properties
@@ -77,6 +81,17 @@ class Session:
         #: lifecycle mechanics: admission control, deadlines, fragment
         #: retry, distributed->local degradation (runtime/lifecycle.py)
         self.query_manager = QueryManager(self)
+        #: explicit shared memory pool (None: ``pool()`` resolves to
+        #: the private pool below or the process-wide one). The private
+        #: pool is built EAGERLY — lazy creation would race concurrent
+        #: first queries into two pools, doubling the admission bound
+        self._memory_pool = memory_pool
+        self._private_pool = None
+        cap = self.prop("memory_pool_bytes")
+        if cap is not None:
+            from presto_tpu.runtime.memory import MemoryPool
+
+            self._private_pool = MemoryPool(cap, name="session")
         #: versioned result cache (cache/result_cache.py) — per session:
         #: sessions own private memory catalogs, so equal fingerprints
         #: across sessions do not imply equal data. DDL drops entries
@@ -113,6 +128,15 @@ class Session:
             # the history ring is sized at construction; a changed
             # limit must take effect, not silently keep the old bound
             self.history.resize(self.prop(name))
+        if name == "memory_pool_bytes":
+            # rebuild the private pool here — not lazily in pool() —
+            # so concurrent queries always see exactly one pool
+            from presto_tpu.runtime.memory import MemoryPool
+
+            cap = self.prop(name)
+            self._private_pool = (
+                None if cap is None else MemoryPool(cap, name="session")
+            )
 
     def show_session(self) -> "list[tuple[str, object, str]]":
         """(name, effective value, description) rows, SHOW SESSION."""
@@ -122,6 +146,21 @@ class Session:
             (d.name, self.prop(d.name), d.description)
             for d in SESSION_PROPERTIES.values()
         ]
+    def pool(self):
+        """The memory pool this session's queries reserve from: an
+        explicit shared pool if one was passed, else the private pool
+        built from ``memory_pool_bytes``, else the process-wide pool
+        (``runtime.memory.global_pool``). Read-only — pools are built
+        in ``__init__``/``set_property``, never here, so concurrent
+        queries can race this accessor safely."""
+        from presto_tpu.runtime.memory import global_pool
+
+        if self._memory_pool is not None:
+            return self._memory_pool
+        if self._private_pool is not None:
+            return self._private_pool
+        return global_pool()
+
     @property
     def executor(self):
         """A freshly-configured executor reflecting current session
@@ -420,8 +459,10 @@ class Session:
         executor = self._make_executor()
         executor.recorder = recorder
         try:
-            with REGISTRY.histogram("query.execution_s").time(), \
-                    self._profiled():
+            # the query.execution_s histogram is timed inside run_plan
+            # AFTER admission, so pool queue wait lands in queued_s /
+            # memory.queued_s, never in execution percentiles
+            with self._profiled():
                 df = self.query_manager.run_plan(executor, plan, info,
                                                  recorder)
             info.state = "FINISHED"

@@ -183,6 +183,12 @@ class QueryInfo:
     fragment_retries: int = 0
     #: True when a failed distributed run degraded to the local pipeline
     degraded: bool = False
+    #: rungs taken down the runtime-OOM degradation ladder (0 = none)
+    oom_retries: int = 0
+    #: seconds spent queued on the shared memory pool at admission
+    memory_queued_s: float = 0.0
+    #: bytes reserved from the pool (the peak stats estimate)
+    memory_reserved_bytes: int = 0
     #: True when the result was served from the versioned result cache
     #: (no execution happened; node_stats stay empty)
     cache_hit: bool = False
@@ -236,6 +242,9 @@ class QueryInfo:
                 "retryable": self.retryable,
                 "fragmentRetries": self.fragment_retries,
                 "degraded": self.degraded,
+                "oomRetries": self.oom_retries,
+                "memoryQueuedS": round(self.memory_queued_s, 6),
+                "memoryReservedBytes": self.memory_reserved_bytes,
                 "cacheHit": self.cache_hit,
                 "outputRows": self.output_rows,
                 "nodeStats": self.node_stats,

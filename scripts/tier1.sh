@@ -13,7 +13,12 @@
 #      virtual mesh must export valid Chrome-trace JSON with >= 1 span
 #      per executed plan node and nonzero exchange bytes (ISSUE-3
 #      acceptance).
-#   4. The tier-1 pytest suite on the CPU backend (virtual-device
+#   4. Chaos smoke: a fixed-seed slice of the chaos suite (randomized
+#      fault schedules incl. the backend-shaped `oom` kind) — every
+#      round must match the fault-free oracle or fail with a TYPED
+#      error, with zero memory-pool reservation leaks (ISSUE-4
+#      acceptance).
+#   5. The tier-1 pytest suite on the CPU backend (virtual-device
 #      distributed tests included; `slow` marks excluded), with the
 #      same flags and timeout the driver uses.
 #
@@ -96,6 +101,29 @@ assert ex_bytes > 0, "no exchange bytes recorded for a distributed run"
 assert REGISTRY.snapshot().get("exchange.bytes", 0) > 0
 print("trace smoke: %d spans, %d plan nodes, %d exchange bytes"
       % (len(spans), want, ex_bytes))
+PY
+
+timeout -k 10 480 env JAX_PLATFORMS=cpu JAX_ENABLE_X64=1 python - <<'PY' || exit $?
+import sys
+
+sys.path.insert(0, ".")
+sys.path.insert(0, "tests")
+from presto_tpu.connectors.tpch import TpchConnector
+from presto_tpu.runtime.memory import global_pool
+from test_chaos import build_oracle, run_chaos_round
+
+conn = TpchConnector(sf=0.005)
+oracle = build_oracle(conn)
+# fixed seeds: deterministic schedules (query + session props + faults
+# all derive from the seed; probability faults draw from the
+# injector's own seeded stream). Each round asserts correct-or-typed,
+# a bounded wall, and a drained pool.
+outcomes = [run_chaos_round(conn, oracle, seed) for seed in range(10)]
+assert global_pool().reserved_bytes == 0, "global pool reservation leak"
+ok = sum(o.startswith("ok:") for o in outcomes)
+assert ok >= 1, outcomes
+print("chaos smoke: %d/%d correct, %d typed failures, pool balance 0"
+      % (ok, len(outcomes), len(outcomes) - ok))
 PY
 
 rm -f /tmp/_t1.log

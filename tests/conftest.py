@@ -27,3 +27,17 @@ import pytest  # noqa: E402
 @pytest.fixture(scope="session")
 def rng():
     return np.random.default_rng(7)
+
+
+@pytest.fixture(autouse=True)
+def _memory_pool_leak_check():
+    """Pool-accounting invariant, enforced suite-wide: every query
+    reaching a terminal state must have released its memory-pool
+    reservation (runtime/lifecycle.py releases in the run_plan
+    ``finally``). A leak here means some failure path skipped release —
+    the bug class the chaos suite exists to catch."""
+    yield
+    from presto_tpu.runtime.memory import pool_leaks
+
+    leaks = pool_leaks()
+    assert not leaks, f"memory pool reservation leak: {leaks}"
