@@ -31,7 +31,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from presto_tpu.types import DataType, TypeKind
+from presto_tpu.types import DataType, TypeKind, check_narrow_range
 
 
 class Dictionary:
@@ -201,7 +201,18 @@ class Batch:
         dictionaries: Mapping[str, Dictionary] | None = None,
         capacity: int | None = None,
     ) -> "Batch":
-        """Build a device Batch from host arrays, padding to ``capacity``."""
+        """Build a device Batch from host arrays, padding to ``capacity``.
+
+        Columns with no explicit NULL mask (and ``count == n``) SHARE
+        the batch's live array as their validity — the identity narrow
+        consumers key on (``ops.pallas_q1.supported``: a column whose
+        ``valid is batch.live`` is proven NULL-free over live rows),
+        and one mask fewer per column on device.
+
+        Narrowed physical types (``DataType.phys``) range-check their
+        input here: connector stats are *declared* bounds, and a value
+        outside the narrowed dtype must fail loudly, never wrap.
+        """
         n = len(next(iter(arrays.values())))
         count = n if count is None else count
         cap = capacity or n
@@ -210,6 +221,9 @@ class Batch:
                 f"capacity {cap} < {n} input rows: batches never silently "
                 "truncate; pick a larger capacity bucket"
             )
+        live = np.zeros(cap, dtype=np.bool_)
+        live[:count] = True
+        live = jnp.asarray(live)
         cols = {}
         for name, arr in arrays.items():
             t = types[name]
@@ -218,18 +232,22 @@ class Batch:
                 padded = np.zeros((cap, t.width), dtype=np.uint8)
                 padded[: arr.shape[0], : arr.shape[1]] = arr[:cap]
             else:
+                check_narrow_range(name, t, arr)
                 padded = np.zeros(cap, dtype=t.np_dtype)
                 padded[:n] = arr.astype(t.np_dtype, copy=False)[:cap]
-            v = np.zeros(cap, dtype=np.bool_)
             if valids is not None and name in valids and valids[name] is not None:
+                v = np.zeros(cap, dtype=np.bool_)
                 v[:n] = valids[name][:cap]
+                v = jnp.asarray(v)
+            elif count == n:
+                v = live  # NULL-free column: share the live mask object
             else:
+                v = np.zeros(cap, dtype=np.bool_)
                 v[:n] = True
+                v = jnp.asarray(v)
             d = dictionaries.get(name) if dictionaries else None
-            cols[name] = Column(jnp.asarray(padded), jnp.asarray(v), t, d)
-        live = np.zeros(cap, dtype=np.bool_)
-        live[:count] = True
-        return cls(cols, jnp.asarray(live))
+            cols[name] = Column(jnp.asarray(padded), v, t, d)
+        return cls(cols, live)
 
     def to_pandas(self, decode_strings: bool = True, logical: bool = True):
         """Materialize live rows as a pandas DataFrame (tests / client)."""
@@ -289,7 +307,10 @@ def decode_values(
         vals = (np.datetime64("1970-01-01T00:00:00", "us")
                 + data.astype("timedelta64[us]"))
     else:
-        vals = data
+        # narrowed physical storage must decode to the LOGICAL width:
+        # every host sink (pandas frames, oracles, the client) compares
+        # dtypes, and int16-stored BIGINTs are still bigints
+        vals = data.astype(t.canonical_np_dtype) if t.is_narrowed else data
     if valid is not None and not valid.all():
         vals = np.asarray(vals, dtype=object)
         vals[~np.asarray(valid)] = None

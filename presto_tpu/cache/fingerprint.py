@@ -50,6 +50,11 @@ CODEGEN_PROPERTIES = (
     "join_build_budget_bytes",
     "direct_group_limit",
     "pallas_strings",
+    # narrow_storage is deliberately NOT here: the fingerprint folds the
+    # RESOLVED physical scan schemas (physical_scan_schemas below), which
+    # capture the switch through the types it resolves to — keying on the
+    # raw property would make an explicit narrow_storage=true session
+    # miss caches shared with a default-on session of identical plans.
 )
 
 
@@ -231,6 +236,37 @@ def table_versions(plan, catalog) -> "tuple[tuple[str, int], ...]":
     )
 
 
+def physical_scan_schemas(plan, catalog) -> tuple:
+    """The RESOLVED physical storage of every scanned column:
+    (connector, table, ((col, 'bigint:int16'), ...)) per TableScan.
+    Folded into the plan fingerprint so the chosen physical dtypes ARE
+    part of a query's identity — toggling ``narrow_storage`` (a
+    process-wide env-mirrored switch whose session-property value can
+    be unset) changes the fingerprint through the types it resolves to,
+    never silently reusing a cached plan compiled for other widths."""
+    from presto_tpu.plan import nodes as N
+
+    out = []
+
+    def walk(node):
+        if isinstance(node, N.TableScan):
+            conn = catalog.connectors.get(node.connector)
+            cols = [s for _n, s in node.columns]
+            if conn is not None and hasattr(conn, "physical_schema"):
+                try:
+                    sch = conn.physical_schema(node.table, cols)
+                    out.append((node.connector, node.table,
+                                tuple((c, sch[c].physical_str())
+                                      for c in cols)))
+                except KeyError:
+                    pass  # dropped table mid-plan: versions catch it
+        for c in node.children:
+            walk(c)
+
+    walk(plan)
+    return tuple(sorted(out))
+
+
 def _mesh_shape(mesh) -> tuple:
     if mesh is None:
         return ()
@@ -259,6 +295,7 @@ def plan_fingerprint(plan, catalog, properties: dict | None = None,
         plan,
         table_versions(plan, catalog),
         referenced_tables(plan),
+        physical_scan_schemas(plan, catalog),
         _mesh_shape(mesh),
         props,
     )

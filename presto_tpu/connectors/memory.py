@@ -22,7 +22,13 @@ import numpy as np
 
 from presto_tpu.batch import Batch, Dictionary
 from presto_tpu.runtime.errors import UserError
-from presto_tpu.spi import Split, batch_capacity, split_valids
+from presto_tpu.spi import (
+    ColumnStats,
+    Split,
+    batch_capacity,
+    narrowed_schema,
+    split_valids,
+)
 from presto_tpu.types import (
     BIGINT,
     BOOLEAN,
@@ -218,11 +224,27 @@ class MemoryConnector:
                 cols[c + "$valid"] = valid
             if d is not None:
                 dicts[c] = d
+        # exact per-column min/max over NON-NULL values, computed once
+        # per store: written tables get the same stats-driven planning
+        # (join-key packing, narrow physical storage) as the generator
+        # connectors — a write IS the stats refresh
+        stats: dict[str, ColumnStats] = {}
+        for c in df.columns:
+            t = types[c]
+            data, valid = cols[c], cols.get(c + "$valid")
+            if t.kind in (TypeKind.INTEGER, TypeKind.BIGINT, TypeKind.DATE):
+                vals = data if valid is None else data[valid]
+                ndv = float(len(np.unique(vals))) if len(vals) else 0.0
+                if len(vals):
+                    stats[c] = ColumnStats(ndv, int(vals.min()),
+                                           int(vals.max()))
+                else:
+                    stats[c] = ColumnStats(0.0)
         # the source frame is kept so appends re-infer from original
         # values (no decode round trip, no lossy re-inference)
         self._tables[table] = {
             "arrays": cols, "types": types, "dicts": dicts, "rows": len(df),
-            "df": df.reset_index(drop=True),
+            "df": df.reset_index(drop=True), "stats": stats,
         }
         self._notify_ddl(table)
 
@@ -244,6 +266,19 @@ class MemoryConnector:
 
     def func_deps(self, table: str):
         return {}
+
+    def stats(self, table: str, column: str):
+        return self._tables[table].get("stats", {}).get(column)
+
+    def physical_schema(self, table: str,
+                        columns: Sequence[str] | None = None) -> dict:
+        t = self._tables[table]
+        cols = list(columns) if columns is not None else list(t["types"])
+        return narrowed_schema(
+            {c: t["types"][c] for c in cols},
+            lambda c: self.stats(table, c),
+            t["dicts"],
+        )
 
     # ---- read path ------------------------------------------------------
     def splits(self, table: str, target_splits: int = 0) -> Sequence[Split]:
@@ -278,7 +313,7 @@ class MemoryConnector:
         arrays, valids = split_valids(self.scan_numpy(split, columns))
         n = split.hi - split.lo
         cap = capacity or batch_capacity(max(n, 1))
-        types = {c: t["types"][c] for c in arrays}
+        types = self.physical_schema(split.table, list(arrays))
         dicts = {c: d for c, d in t["dicts"].items() if c in arrays}
         return Batch.from_numpy(
             arrays, types, capacity=cap, dictionaries=dicts, valids=valids

@@ -13,7 +13,7 @@ import numpy as np
 from presto_tpu.batch import Batch, Dictionary
 from presto_tpu.connectors.ssb import schema as S
 from presto_tpu.connectors.ssb.generator import SsbGenerator
-from presto_tpu.spi import Split, batch_capacity
+from presto_tpu.spi import Split, batch_capacity, narrowed_schema
 
 
 class SsbConnector:
@@ -43,6 +43,20 @@ class SsbConnector:
     def unique_keys(self, table: str):
         return S.UNIQUE_KEYS.get(table, ())
 
+    def stats(self, table: str, column: str):
+        return S.column_stats(table, column, self.sf)
+
+    def physical_schema(self, table: str,
+                        columns: Sequence[str] | None = None) -> dict:
+        """Stats-narrowed per-column physical types (see the TPC-H
+        connector's physical_schema — same contract)."""
+        cols = list(columns) if columns is not None else list(S.TABLES[table])
+        return narrowed_schema(
+            {c: S.TABLES[table][c] for c in cols},
+            lambda c: self.stats(table, c),
+            S.table_dicts(table),
+        )
+
     # ---- splits ---------------------------------------------------------
     def splits(self, table: str, target_splits: int = 0) -> Sequence[Split]:
         units = self.gen.base_rows(table)
@@ -70,7 +84,7 @@ class SsbConnector:
         arrays = dict(self.scan_numpy(split, columns))
         n = len(next(iter(arrays.values())))
         cap = capacity or batch_capacity(n)
-        types = {c: S.TABLES[split.table][c] for c in arrays}
+        types = self.physical_schema(split.table, list(arrays))
         dicts = {c: d for c, d in S.table_dicts(split.table).items() if c in arrays}
         return Batch.from_numpy(arrays, types, capacity=cap, dictionaries=dicts)
 

@@ -12,6 +12,7 @@ from __future__ import annotations
 import numpy as np
 
 from presto_tpu.batch import Dictionary
+from presto_tpu.spi import ColumnStats
 from presto_tpu.types import (
     BIGINT,
     DATE,
@@ -204,3 +205,57 @@ def row_count(table: str, sf: float) -> int:
 
 def table_dicts(table: str) -> dict[str, Dictionary]:
     return {c: DICTS[c] for c in TABLES[table] if c in DICTS}
+
+
+def column_stats(table: str, column: str, sf: float) -> "ColumnStats":
+    """Exact per-column domains (generator.py formulas; SSB spec). The
+    bounds drive both join-key packing widths and narrow physical
+    storage, so they must COVER the generator output — from_numpy
+    range-checks narrowed columns and fails loudly on violation."""
+    n = row_count(table, sf)
+    # lineorder keys: idx // 4 + 1 over idx in [0, n)
+    lo_maxorder = (row_count("lineorder", sf) - 1) // 4 + 1
+    special = {
+        ("lineorder", "lo_orderkey"): ColumnStats(lo_maxorder, 1, lo_maxorder),
+        ("lineorder", "lo_linenumber"): ColumnStats(4, 1, 4),
+        ("lineorder", "lo_custkey"): ColumnStats(
+            row_count("customer", sf), 1, row_count("customer", sf)),
+        ("lineorder", "lo_partkey"): ColumnStats(
+            row_count("part", sf), 1, row_count("part", sf)),
+        ("lineorder", "lo_suppkey"): ColumnStats(
+            row_count("supplier", sf), 1, row_count("supplier", sf)),
+        ("lineorder", "lo_orderdate"): ColumnStats(
+            DATE_ROWS, 19920101, 19981231),
+        ("lineorder", "lo_commitdate"): ColumnStats(
+            DATE_ROWS, 19920101, 19981231),
+        ("lineorder", "lo_shippriority"): ColumnStats(1, 0, 0),
+        ("lineorder", "lo_quantity"): ColumnStats(50, 1, 50),
+        # ext = qty * (price_cents // 100) // 10 with price_cents in
+        # [90001, 1999999]: max 50 * 19999 // 10 = 99995 cents
+        ("lineorder", "lo_extendedprice"): ColumnStats(900_000, 0.90, 999.95),
+        ("lineorder", "lo_ordtotalprice"): ColumnStats(900_000, 3.60, 3999.80),
+        # SSB discount/tax are WHOLE numbers (1.00 = "1%"), unlike
+        # TPC-H's fractional l_discount: generator stores disc*100
+        ("lineorder", "lo_discount"): ColumnStats(11, 0.0, 10.0),
+        ("lineorder", "lo_revenue"): ColumnStats(900_000, 0.81, 999.95),
+        ("lineorder", "lo_supplycost"): ColumnStats(20_000, 5.40, 119.99),
+        ("lineorder", "lo_tax"): ColumnStats(9, 0.0, 8.0),
+        ("date", "d_datekey"): ColumnStats(DATE_ROWS, 19920101, 19981231),
+        ("date", "d_date"): ColumnStats(DATE_ROWS, STARTDATE, ENDDATE),
+        ("date", "d_year"): ColumnStats(7, 1992, 1998),
+        ("date", "d_yearmonthnum"): ColumnStats(84, 199201, 199812),
+        ("date", "d_daynuminweek"): ColumnStats(7, 1, 7),
+        ("date", "d_daynuminmonth"): ColumnStats(31, 1, 31),
+        ("date", "d_daynuminyear"): ColumnStats(366, 1, 366),
+        ("date", "d_monthnuminyear"): ColumnStats(12, 1, 12),
+        ("date", "d_weeknuminyear"): ColumnStats(53, 1, 53),
+        ("date", "d_holidayfl"): ColumnStats(2, 0, 1),
+        ("date", "d_weekdayfl"): ColumnStats(2, 0, 1),
+        ("customer", "c_custkey"): ColumnStats(n, 1, n),
+        ("supplier", "s_suppkey"): ColumnStats(n, 1, n),
+        ("part", "p_partkey"): ColumnStats(n, 1, n),
+        ("part", "p_size"): ColumnStats(50, 1, 50),
+    }
+    if (table, column) in special:
+        return special[(table, column)]
+    return ColumnStats(min(n, 1 << 20))

@@ -17,7 +17,7 @@ import numpy as np
 from presto_tpu.batch import Batch, Dictionary
 from presto_tpu.connectors.tpcds import schema as S
 from presto_tpu.connectors.tpcds.generator import TpcdsGenerator
-from presto_tpu.spi import Split, batch_capacity, split_valids
+from presto_tpu.spi import Split, batch_capacity, narrowed_schema, split_valids
 
 
 class TpcdsConnector:
@@ -50,6 +50,19 @@ class TpcdsConnector:
     def func_deps(self, table: str):
         return S.FUNC_DEPS.get(table, {})
 
+    def physical_schema(self, table: str,
+                        columns: Sequence[str] | None = None) -> dict:
+        """Per-column physical types: TPC-DS declares no numeric column
+        stats yet, so only dictionary-encoded VARCHAR columns narrow
+        (their code domain is exactly the dictionary length — int8/int16
+        instead of int32 for every low-cardinality dimension string)."""
+        cols = list(columns) if columns is not None else list(S.TABLES[table])
+        return narrowed_schema(
+            {c: S.TABLES[table][c] for c in cols},
+            lambda c: None,
+            S.table_dicts(table),
+        )
+
     # ---- splits ---------------------------------------------------------
     def splits(self, table: str, target_splits: int = 0) -> Sequence[Split]:
         units = self.gen.base_rows(table)
@@ -77,7 +90,7 @@ class TpcdsConnector:
         arrays, valids = split_valids(self.scan_numpy(split, columns))
         n = len(next(iter(arrays.values())))
         cap = capacity or batch_capacity(n)
-        types = {c: S.TABLES[split.table][c] for c in arrays}
+        types = self.physical_schema(split.table, list(arrays))
         dicts = {c: d for c, d in S.table_dicts(split.table).items() if c in arrays}
         return Batch.from_numpy(
             arrays, types, capacity=cap, dictionaries=dicts, valids=valids

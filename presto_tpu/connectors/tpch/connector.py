@@ -18,7 +18,7 @@ import numpy as np
 from presto_tpu.batch import Batch, Dictionary
 from presto_tpu.connectors.tpch import schema as S
 from presto_tpu.connectors.tpch.generator import TpchGenerator
-from presto_tpu.spi import Split, batch_capacity
+from presto_tpu.spi import Split, batch_capacity, narrowed_schema
 from presto_tpu.types import DataType
 
 
@@ -71,6 +71,19 @@ class TpchConnector:
     ) -> Mapping[str, np.ndarray]:
         return self.gen.generate(split.table, split.chunk, split.lo, split.hi, columns)
 
+    def physical_schema(self, table: str,
+                        columns: Sequence[str] | None = None) -> dict:
+        """Per-column PHYSICAL types for device materialization: the
+        generator's exact value domains (column_stats) narrow each
+        column to its smallest sufficient signed-int storage — the
+        stats-driven narrow-storage lever (ISSUE-5; notes/PERF.md §6)."""
+        cols = list(columns) if columns is not None else list(S.TABLES[table])
+        return narrowed_schema(
+            {c: S.TABLES[table][c] for c in cols},
+            lambda c: self.stats(table, c),
+            S.table_dicts(table),
+        )
+
     def scan(
         self,
         split: Split,
@@ -80,7 +93,7 @@ class TpchConnector:
         arrays = dict(self.scan_numpy(split, columns))
         n = len(next(iter(arrays.values())))
         cap = capacity or batch_capacity(n)
-        types = {c: S.TABLES[split.table][c] for c in arrays}
+        types = self.physical_schema(split.table, list(arrays))
         dicts = {c: d for c, d in S.table_dicts(split.table).items() if c in arrays}
         return Batch.from_numpy(arrays, types, capacity=cap, dictionaries=dicts)
 

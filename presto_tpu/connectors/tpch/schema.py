@@ -268,6 +268,12 @@ def column_stats(table: str, column: str, sf: float) -> ColumnStats:
         ("lineitem", "l_discount"): ColumnStats(11, 0.0, 0.10),
         ("lineitem", "l_tax"): ColumnStats(9, 0.0, 0.08),
         ("lineitem", "l_shipdate"): ColumnStats(2526, STARTDATE, ENDDATE),
+        # commitdate = odate + [30, 90], receiptdate = shipdate + [1, 30]:
+        # both inside the [STARTDATE, ENDDATE] calendar (generator.py)
+        ("lineitem", "l_commitdate"): ColumnStats(2526, STARTDATE, ENDDATE),
+        ("lineitem", "l_receiptdate"): ColumnStats(2526, STARTDATE, ENDDATE),
+        ("lineitem", "l_linenumber"): ColumnStats(7, 1, 7),
+        ("orders", "o_shippriority"): ColumnStats(1, 0, 0),
         ("lineitem", "l_returnflag"): ColumnStats(3),
         ("lineitem", "l_linestatus"): ColumnStats(2),
         ("lineitem", "l_shipmode"): ColumnStats(7),

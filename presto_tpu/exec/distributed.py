@@ -90,7 +90,7 @@ from presto_tpu.runtime.trace import (
 )
 from presto_tpu.runtime.trace import span as trace_span
 from presto_tpu.spi import batch_capacity
-from presto_tpu.types import TypeKind
+from presto_tpu.types import TypeKind, check_narrow_range
 
 MAX_RETRIES = 6
 
@@ -370,7 +370,14 @@ class DistributedExecutor(OomLadderMixin):
             max(max(sum(s.row_hint for s in sp) for sp in assign), 1),
             minimum=128,
         )
-        types = {c: conn.schema(node.table)[c] for c in src_cols}
+        # stats-narrowed physical types: per-device shards materialize
+        # (and every downstream exchange moves) int8/int16/int32 columns
+        # wherever connector bounds permit — same contract as the local
+        # tier's connector scan path
+        if hasattr(conn, "physical_schema"):
+            types = conn.physical_schema(node.table, src_cols)
+        else:
+            types = {c: conn.schema(node.table)[c] for c in src_cols}
         dicts = {c: d for c, d in conn.dictionaries(node.table).items() if c in types}
         devices = list(self.mesh.devices.flat)
         # multi-process: each host generates and places ONLY its own
@@ -416,6 +423,7 @@ class DistributedExecutor(OomLadderMixin):
                         if a.ndim > 1:  # BYTES rows may be narrower
                             padded[c][rows : rows + srows, : a.shape[1]] = a
                         else:
+                            check_narrow_range(c, types[c], a)
                             padded[c][rows : rows + srows] = a
                     vm = valids.get(c)
                     vmasks[c][rows : rows + srows] = True if vm is None else vm
@@ -753,7 +761,7 @@ class DistributedExecutor(OomLadderMixin):
         # budget on the ACTUAL materialized build size (the batch is in
         # hand — a stats overestimate must not force a host spill of a
         # build that fits)
-        est = build_rows * node_row_bytes(node.right)
+        est = build_rows * node_row_bytes(node.right, self.catalog)
         spill = est > self.join_build_budget
         if spill or (self.oom_rung > 0 and not verify):
             if verify:
@@ -1368,7 +1376,7 @@ class DistributedExecutor(OomLadderMixin):
         from presto_tpu.runtime.memory import node_row_bytes
 
         build_rows = live_count(right.batch)
-        est = build_rows * node_row_bytes(node.right)
+        est = build_rows * node_row_bytes(node.right, self.catalog)
         if est > self.join_build_budget or self.oom_rung > 0:
             # bucketing is exact for semi AND anti: a probe key's
             # existence is decided entirely within its own bucket

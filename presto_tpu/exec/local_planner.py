@@ -307,6 +307,24 @@ class LocalExecutor(OomLadderMixin):
         from presto_tpu.ops.groupby import ValueBitsOverflow
         from presto_tpu.plan.bounds import agg_value_bits
 
+        # HandTpchQuery1 parity: a Q1-shaped leaf fragment over
+        # stats-bounded NULL-free columns runs as ONE fused step per
+        # scan batch (the Pallas kernel on TPU) instead of the operator
+        # chain — exec/q1_route.py. Skipped under a stats recorder
+        # (EXPLAIN ANALYZE needs true per-node actuals); a runtime
+        # value_overflow falls back to the generic route below.
+        if self.recorder is None:
+            from presto_tpu.exec.q1_route import (
+                execute_q1_route,
+                match_q1_fragment,
+            )
+
+            route = match_q1_fragment(node, self.catalog)
+            if route is not None:
+                routed = execute_q1_route(route, self.catalog, node.aggs)
+                if routed is not None:
+                    return BatchStream.of(routed)
+
         child = self._exec(node.child, scalars)
         from presto_tpu.runtime.faults import fault_point
 
@@ -614,7 +632,8 @@ class LocalExecutor(OomLadderMixin):
         # probe chunks sized so a chunk stays well under the budget
         probe_chunk = self._oom_probe_chunk(max(
             1 << 14,
-            self.join_build_budget // max(node_row_bytes(node.left), 1) // 4,
+            self.join_build_budget
+            // max(node_row_bytes(node.left, self.catalog), 1) // 4,
         ))
         rspill = spill_stream(right_stream, rkey, nbuckets)
         lspill = spill_stream(left, lkey, nbuckets)

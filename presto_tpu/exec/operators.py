@@ -149,7 +149,10 @@ class FilterProjectOperator(Operator):
                         e.dtype, d,
                     )
                     continue
-                cols[name] = Column(v.data, v.valid, e.dtype, v.dictionary)
+                # v.dtype, not e.dtype: evaluate() syncs the physical
+                # field to the actual storage, so pass-through narrow
+                # columns keep truthful metadata through projections
+                cols[name] = Column(v.data, v.valid, v.dtype, v.dictionary)
             return Batch(cols, live)
 
         return step
@@ -701,6 +704,10 @@ class GlobalAggregationOperator(Operator):
             ident = _identity(kind, vals.dtype)
             masked = jnp.where(contrib, vals, ident)
             if kind == "sum":
+                # accumulate in the state's (canonical) dtype: narrow
+                # physical inputs must widen BEFORE the reduction, or
+                # the running sum wraps inside the input width
+                masked = masked.astype(state[a.name].dtype)
                 new[a.name] = state[a.name] + jnp.sum(masked).astype(state[a.name].dtype)
             elif kind == "min":
                 new[a.name] = jnp.minimum(state[a.name], jnp.min(masked))
@@ -1088,7 +1095,10 @@ class WindowOperator(CollectingOperator):
                         )
                     data = v.data[src]
                     valid = ok & cvalid[src] & live
-                    cols[f.name] = Column(data, valid, f.dtype, v.dictionary)
+                    # v.dtype carries the truthful physical storage of
+                    # the shifted column (narrow scan data passes
+                    # through the gather unchanged)
+                    cols[f.name] = Column(data, valid, v.dtype, v.dictionary)
                     continue
                 if f.kind == "row_number":
                     cols[f.name] = Column(row_number, all_valid, f.dtype)

@@ -269,6 +269,11 @@ def _to_physical(v: Val, target: DataType):
     if (target.kind is TypeKind.BYTES and src.kind is TypeKind.BYTES
             and src.width == target.width):
         return data
+    if target.kind is TypeKind.VARCHAR and src.kind is TypeKind.VARCHAR:
+        # dictionary codes pass through regardless of physical width
+        # (narrowed int8/int16 codes promote wherever they mix with
+        # canonical int32 ones; code spaces are the caller's concern)
+        return data
     raise TypeError(f"cannot convert {src} -> {target}")
 
 
@@ -1551,8 +1556,25 @@ def evaluate(expr: Expr, batch: Batch) -> Val:
                 if a.dictionary is not None:
                     dictionary = a.dictionary
                     break
-        return Val(data, valid, expr.dtype, dictionary)
+        return Val(data, valid, _sync_physical(expr.dtype, data), dictionary)
     raise TypeError(f"unknown expr node {type(expr)}")
+
+
+def _sync_physical(dtype: DataType, data) -> DataType:
+    """Metadata must tell the truth about storage: pass-through impls
+    (trim, min/max-style selections, identity projections) hand narrow
+    column data onward under the expr's canonical claimed type — sync
+    the physical field to the actual device dtype so downstream
+    ``_to_physical`` widening keys on reality, not on the claim.
+    Host-side values (string literals) and non-narrowable kinds pass
+    through unchanged."""
+    if not hasattr(data, "dtype") or dtype.kind in (
+        TypeKind.BYTES, TypeKind.BOOLEAN, TypeKind.DOUBLE
+    ):
+        return dtype
+    if data.dtype == dtype.np_dtype:
+        return dtype
+    return dtype.with_physical(data.dtype)
 
 
 def _encode_string_literals(fn: str, args: list[Val]) -> list[Val]:

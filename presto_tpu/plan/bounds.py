@@ -160,14 +160,13 @@ def expr_interval(e: Expr, env: dict[str, Interval]) -> Interval:
 
 
 def _stats_interval(stats, dtype: DataType) -> Interval:
-    if stats is None or stats.min_value is None or stats.max_value is None:
-        return None
-    if dtype.kind is TypeKind.DECIMAL:
-        f = 10**dtype.scale
-        return (math.floor(stats.min_value * f), math.ceil(stats.max_value * f))
-    if dtype.kind in (TypeKind.INTEGER, TypeKind.BIGINT, TypeKind.DATE):
-        return (math.floor(stats.min_value), math.ceil(stats.max_value))
-    return None
+    # the ONE logical->physical stats scaling rule, shared with scan
+    # narrowing (spi.narrowed_schema): intervals and narrowed storage
+    # must be derived identically or a narrowed column could hold
+    # values its declared interval excludes
+    from presto_tpu.spi import stats_physical_interval
+
+    return stats_physical_interval(stats, dtype)
 
 
 def node_intervals(node: N.PlanNode, catalog) -> dict[str, Interval]:

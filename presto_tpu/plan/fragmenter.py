@@ -145,6 +145,8 @@ class FragmentPlan:
     #: id(Join node) -> sound build-row upper bound (replication
     #: capacity sizing without a device sync)
     join_rows_ub: dict
+    #: catalog used for planning (renders scan columns' physical types)
+    catalog: object = None
 
     def render(self) -> str:
         # roots of other fragments are rendering stop points: each
@@ -159,7 +161,20 @@ class FragmentPlan:
         def label(n: N.PlanNode) -> str:
             t = type(n).__name__
             if isinstance(n, N.TableScan):
-                return f"{t}[{n.connector}.{n.table}]"
+                phys = ""
+                if self.catalog is not None:
+                    from presto_tpu.plan.nodes import scan_physical_types
+
+                    narrowed = {
+                        s: dt for s, dt in
+                        scan_physical_types(n, self.catalog).items()
+                        if dt.is_narrowed
+                    }
+                    if narrowed:
+                        phys = " physical={" + ", ".join(
+                            f"{s}:{dt.phys}" for s, dt in sorted(
+                                narrowed.items())) + "}"
+                return f"{t}[{n.connector}.{n.table}]{phys}"
             if isinstance(n, N.Aggregate):
                 return f"{t}[keys={[k for k, _ in n.keys]}]"
             if isinstance(n, N.Join):
@@ -221,8 +236,10 @@ def fragment_plan(plan: N.PlanNode, catalog, broadcast_limit: int,
             # probe side stays in this fragment; build side becomes its
             # own fragment delivered by broadcast or hash exchange
             ubr = upper_bound_rows(node.right, catalog)
+            # physical (narrowed) widths, matching the runtime build
+            # estimates — plan-time and run-time sizing must agree
             bytes_ub = (None if ubr is None
-                        else ubr * node_row_bytes(node.right))
+                        else ubr * node_row_bytes(node.right, catalog))
             if ubr is not None and ubr <= broadcast_limit:
                 join_strategy[id(node)] = "broadcast"
                 ex = Exchange("broadcast")
@@ -299,4 +316,5 @@ def fragment_plan(plan: N.PlanNode, catalog, broadcast_limit: int,
 
     root = new_fragment(plan, "single")
     visit(plan, root)
-    return FragmentPlan(fragments, join_strategy, join_fits, join_rows_ub)
+    return FragmentPlan(fragments, join_strategy, join_fits, join_rows_ub,
+                        catalog=catalog)

@@ -304,13 +304,36 @@ class Output(PlanNode):
         )
 
 
-def plan_tree_str(node: PlanNode, indent: int = 0) -> str:
-    """EXPLAIN-style rendering (reference: PlanPrinter)."""
+def scan_physical_types(node: "TableScan", catalog) -> dict:
+    """source column -> resolved physical DataType for a scan, via the
+    owning connector's stats narrowing (empty when unavailable)."""
+    try:
+        conn = catalog.connectors.get(node.connector)
+    except AttributeError:
+        return {}
+    if conn is None or not hasattr(conn, "physical_schema"):
+        return {}
+    try:
+        return conn.physical_schema(node.table, [s for _n, s in node.columns])
+    except KeyError:
+        return {}
+
+
+def plan_tree_str(node: PlanNode, indent: int = 0, catalog=None) -> str:
+    """EXPLAIN-style rendering (reference: PlanPrinter). With a
+    ``catalog``, scan columns render their chosen PHYSICAL storage
+    (``l_shipdate:date:int16``) so narrowing decisions are visible."""
     pad = "  " * indent
     name = type(node).__name__
     detail = ""
     if isinstance(node, TableScan):
-        detail = f" {node.table}{' [pred]' if node.predicate is not None else ''} -> {[c for c, _ in node.columns]}"
+        phys = scan_physical_types(node, catalog) if catalog is not None else {}
+        cols = [
+            f"{c}:{phys[s].physical_str()}" if s in phys and phys[s].is_narrowed
+            else c
+            for c, s in node.columns
+        ]
+        detail = f" {node.table}{' [pred]' if node.predicate is not None else ''} -> {cols}"
     elif isinstance(node, Aggregate):
         detail = f" keys={[n for n, _ in node.keys]} aggs={[a.name for a in node.aggs]}"
     elif isinstance(node, (Join,)):
@@ -329,5 +352,5 @@ def plan_tree_str(node: PlanNode, indent: int = 0) -> str:
         detail = f" {[n for n, _ in node.exprs]}"
     out = f"{pad}{name}{detail}\n"
     for c in node.children:
-        out += plan_tree_str(c, indent + 1)
+        out += plan_tree_str(c, indent + 1, catalog=catalog)
     return out

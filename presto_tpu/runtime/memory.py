@@ -257,14 +257,42 @@ def column_bytes(dtype: DataType) -> int:
     return dtype.np_dtype.itemsize + 1
 
 
-def node_row_bytes(node: N.PlanNode) -> int:
-    """Per-row device bytes of a node's output (+1 for the live mask)."""
-    return sum(column_bytes(f.dtype) for f in node.fields) + 1
+def node_row_bytes(node: N.PlanNode, catalog=None) -> int:
+    """Per-row device bytes of a node's output (+1 for the live mask).
+
+    With a ``catalog``, columns that resolve to a source scan column
+    count at their narrowed PHYSICAL width (the storage the scan
+    actually materializes), so admission estimates and join-build
+    budget decisions track real device bytes instead of canonical
+    widths; computed columns stay canonical (arithmetic widens)."""
+    total = 1
+    for f in node.fields:
+        dt = f.dtype
+        if catalog is not None and not dt.is_narrowed:
+            dt = _physical_field_type(node, f.name, dt, catalog)
+        total += column_bytes(dt)
+    return total
+
+
+def _physical_field_type(node, name: str, dtype: DataType, catalog) -> DataType:
+    from presto_tpu.plan.bounds import resolve_source_column
+
+    src = resolve_source_column(node, name)
+    if src is None:
+        return dtype
+    conn = catalog.connectors.get(src[0])
+    if conn is None or not hasattr(conn, "physical_schema"):
+        return dtype
+    try:
+        return conn.physical_schema(src[1], [src[2]])[src[2]]
+    except KeyError:
+        return dtype
 
 
 def estimate_node_bytes(node: N.PlanNode, catalog) -> int:
     """Estimated device-resident bytes if the node's output were fully
-    materialized (stats-based; the grouped-execution trigger)."""
+    materialized (stats-based, physical-width-aware; the
+    grouped-execution trigger)."""
     from presto_tpu.plan.bounds import estimate_rows
 
-    return estimate_rows(node, catalog) * node_row_bytes(node)
+    return estimate_rows(node, catalog) * node_row_bytes(node, catalog)

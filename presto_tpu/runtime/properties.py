@@ -260,6 +260,17 @@ SESSION_PROPERTIES: dict[str, PropertyDef] = {
             "ANALYZE's host-level per-operator stats.",
         ),
         PropertyDef(
+            "narrow_storage", bool, None,
+            "Stats-driven narrow physical column storage: scans "
+            "materialize int8/int16/int32 device columns wherever "
+            "connector value bounds permit (HBM-bandwidth lever, "
+            "~4x on bandwidth-bound aggregation — notes/PERF.md §6). "
+            "Process-wide, mirrors the PRESTO_TPU_NARROW environment "
+            "variable; default: on. Turn off to bisect narrowing "
+            "against canonical int64 storage — results must be "
+            "bit-identical either way.",
+        ),
+        PropertyDef(
             "pallas_strings", bool, None,
             "Force the Pallas string-predicate kernels on or off "
             "(process-wide; default: on when running on TPU). Mirrors "

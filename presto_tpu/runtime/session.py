@@ -187,6 +187,11 @@ class Session:
             # the string-kernel probe reads the env at trace time;
             # mirror the property there (documented as process-wide)
             os.environ["PRESTO_TPU_PALLAS"] = "1" if pallas else "0"
+        narrow = self.prop("narrow_storage")
+        if narrow is not None:
+            # connectors read the switch at scan time (spi.narrow_enabled);
+            # mirror the property there (documented as process-wide)
+            os.environ["PRESTO_TPU_NARROW"] = "1" if narrow else "0"
         if self.mesh is None:
             budget = self.prop("join_build_budget_bytes")
             return LocalExecutor(
@@ -236,7 +241,7 @@ class Session:
         return prune(logical)
 
     def explain(self, sql: str) -> str:
-        return plan_tree_str(self.plan(sql))
+        return plan_tree_str(self.plan(sql), catalog=self.catalog)
 
     def explain_distributed(self, sql: str) -> str:
         """Fragment/exchange rendering (reference: EXPLAIN (TYPE

@@ -51,7 +51,7 @@ need them, is pinned down so the data model does not dead-end:
 from __future__ import annotations
 
 import enum
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 
 import jax.numpy as jnp
 import numpy as np
@@ -71,21 +71,61 @@ class TypeKind(enum.Enum):
 
 @dataclass(frozen=True)
 class DataType:
-    """A logical SQL type plus the parameters that pin its physical layout."""
+    """A logical SQL type plus the parameters that pin its physical layout.
+
+    ``phys`` decouples the *physical* device dtype from the logical
+    kind: a connector whose stats bound a column's value domain narrows
+    its storage (BIGINT carried as int16, DECIMAL cents as int32, ...)
+    — the HBM-bandwidth lever the bench measured at ~4x on Q1
+    (notes/PERF.md §6-§8). The empty string means the canonical
+    mapping below. Narrowed types ride Column/Batch pytree aux, so jit
+    signatures key on the physical layout; the LOGICAL identity is the
+    canonical form — ``common_super_type`` and every coercion resolve
+    to canonical types, which is what makes arithmetic widen narrow
+    reads before any overflow is possible (see ``canonical()``).
+    """
 
     kind: TypeKind
     precision: int = 0  # DECIMAL precision
     scale: int = 0  # DECIMAL scale
     width: int = 0  # BYTES fixed width
+    phys: str = ""  # physical dtype override (numpy name); "" = canonical
 
     # ---- physical layout ------------------------------------------------
     @property
     def np_dtype(self) -> np.dtype:
+        if self.phys:
+            return np.dtype(self.phys)
         return np.dtype(_PHYSICAL[self.kind])
 
     @property
     def jnp_dtype(self):
+        if self.phys:
+            return jnp.dtype(self.phys)
         return jnp.dtype(_PHYSICAL[self.kind])
+
+    @property
+    def canonical_np_dtype(self) -> np.dtype:
+        return np.dtype(_PHYSICAL[self.kind])
+
+    @property
+    def is_narrowed(self) -> bool:
+        return bool(self.phys)
+
+    def canonical(self) -> "DataType":
+        """The logical identity: this type with canonical storage."""
+        return replace(self, phys="") if self.phys else self
+
+    def with_physical(self, np_dtype) -> "DataType":
+        """This type stored as ``np_dtype`` (None/canonical -> clears
+        the override, keeping narrowed == canonical an impossibility
+        for equal layouts)."""
+        if np_dtype is None:
+            return self.canonical()
+        dt = np.dtype(np_dtype)
+        if dt == self.canonical_np_dtype:
+            return self.canonical()
+        return replace(self, phys=dt.name)
 
     @property
     def is_string(self) -> bool:
@@ -161,6 +201,12 @@ class DataType:
             return f"bytes({self.width})"
         return self.kind.value
 
+    def physical_str(self) -> str:
+        """Rendering with the physical storage made visible (EXPLAIN):
+        ``bigint`` canonically, ``bigint:int16`` when narrowed."""
+        base = str(self)
+        return f"{base}:{self.phys}" if self.phys else base
+
 
 _PHYSICAL = {
     TypeKind.BOOLEAN: np.bool_,
@@ -197,8 +243,72 @@ def fixed_bytes(width: int) -> DataType:
     return DataType(TypeKind.BYTES, width=width)
 
 
+#: kinds whose physical storage may be narrowed from stats bounds —
+#: fixed-point/integer representations where a narrower signed int is
+#: value-identical. DOUBLE/BOOLEAN/BYTES never narrow.
+NARROWABLE_KINDS = frozenset({
+    TypeKind.INTEGER, TypeKind.BIGINT, TypeKind.DECIMAL, TypeKind.DATE,
+    TypeKind.TIMESTAMP, TypeKind.VARCHAR,
+})
+
+_NARROW_LADDER = (np.int8, np.int16, np.int32, np.int64)
+
+
+def narrow_physical(dtype: DataType, lo: int, hi: int) -> DataType:
+    """The narrowest signed-int storage of ``dtype`` whose range covers
+    the PHYSICAL-value interval [lo, hi] — scaled ints for DECIMAL, day
+    numbers for DATE, dictionary codes for VARCHAR. Never wider than
+    canonical, and never a dtype whose extreme the domain touches
+    (``max(|lo|, |hi|) < 2^(bits-1)``), so unary negation of any
+    in-domain value stays exact. Returns ``dtype`` unchanged for
+    un-narrowable kinds or unbounded/oversized domains."""
+    if dtype.kind not in NARROWABLE_KINDS or dtype.phys:
+        return dtype
+    lo, hi = int(lo), int(hi)
+    if lo > hi:
+        return dtype
+    canonical_size = dtype.canonical_np_dtype.itemsize
+    bound = max(abs(lo), abs(hi))
+    for cand in _NARROW_LADDER:
+        info = np.iinfo(cand)
+        if np.dtype(cand).itemsize >= canonical_size:
+            return dtype
+        if bound < -int(info.min):  # strict: the extreme slot stays free
+            return dtype.with_physical(cand)
+    return dtype
+
+
+def check_narrow_range(name: str, dtype: DataType, arr) -> None:
+    """The narrow-storage soundness guard, shared by every host->device
+    materialization site (Batch.from_numpy, the distributed scan):
+    connector bounds are *declared*, so a value outside a narrowed
+    column's physical dtype must fail LOUDLY here — assigning it into
+    the narrow buffer would wrap silently."""
+    if not dtype.is_narrowed or getattr(arr, "size", 0) == 0:
+        return
+    info = np.iinfo(dtype.np_dtype)
+    lo, hi = arr.min(), arr.max()
+    if lo < info.min or hi > info.max:
+        raise ValueError(
+            f"column {name!r}: value range [{lo}, {hi}] exceeds its "
+            f"narrowed physical storage {dtype.np_dtype} — wrong/stale "
+            "connector stats"
+        )
+
+
 def common_super_type(a: DataType, b: DataType) -> DataType:
-    """Implicit-coercion lattice (reference: TypeCoercion in sql.analyzer)."""
+    """Implicit-coercion lattice (reference: TypeCoercion in sql.analyzer).
+
+    Resolves over the LOGICAL identities: narrowed physical storage
+    never propagates through coercion — mixed-width operands meet in
+    the canonical type, so comparisons/arithmetic widen narrow reads
+    instead of truncating the wider side. (Two identically-narrowed
+    types still meet in themselves via the ``a == b`` fast path, which
+    is exact: same storage, same domain.)"""
+    if a == b:
+        return a
+    a = a.canonical()
+    b = b.canonical()
     if a == b:
         return a
     order = {

@@ -52,8 +52,11 @@ def q1_exprs():
 # Per-row |value| bit bounds from the TPC-H spec (§4.2.3 data ranges):
 # quantity <= 50.00 (scaled 5e3 -> 13 bits), extendedprice <= ~105k
 # (scaled ~1.05e7 -> 24 bits), disc_price/charge at scale 4 <= ~1.2e9
-# (31 bits). Bounds feed the lane-split aggregation (fewer passes).
-Q1_BITS = {"sum_qty": 13, "sum_base_price": 24, "sum_disc_price": 31, "sum_charge": 31}
+# (31 bits), discount <= 0.10 (scaled 10 -> 4 bits; 7 declared to match
+# the kernel's one-lane [0, 100] guard). Bounds feed the lane-split
+# aggregation (fewer passes).
+Q1_BITS = {"sum_qty": 13, "sum_base_price": 24, "sum_disc_price": 31,
+           "sum_charge": 31, "sum_disc": 7}
 
 
 def q1_aggs():
@@ -127,13 +130,15 @@ def q1_fused_step(batch: Batch):
     )
     qty = batch["l_quantity"].data
     ep = batch["l_extendedprice"].data
+    disc = batch["l_discount"].data
     dp = evaluate(disc_price, batch).data
     ch = evaluate(charge, batch).data
-    names = ["sum_qty", "sum_base_price", "sum_disc_price", "sum_charge"]
+    names = ["sum_qty", "sum_base_price", "sum_disc_price", "sum_charge",
+             "sum_disc"]
     sums, counts, _, oflow = fused_small_sums(
-        [qty, ep, dp, ch],
+        [qty, ep, dp, ch, disc],
         [Q1_BITS[n] for n in names],
-        [live] * 4,
+        [live] * 5,
         gids,
         Q1_GROUPS,
     )

@@ -18,7 +18,12 @@
 #      round must match the fault-free oracle or fail with a TYPED
 #      error, with zero memory-pool reservation leaks (ISSUE-4
 #      acceptance).
-#   5. The tier-1 pytest suite on the CPU backend (virtual-device
+#   5. Narrowing smoke: one fixed query with stats-driven narrow
+#      physical storage ON vs OFF must return identical rows, the
+#      narrow plan must route TPC-H Q1 through the fused-fragment
+#      kernel path, and a warm narrow repeat must re-trace ZERO steps
+#      (fingerprints carry the physical dtypes — ISSUE-5 acceptance).
+#   6. The tier-1 pytest suite on the CPU backend (virtual-device
 #      distributed tests included; `slow` marks excluded), with the
 #      same flags and timeout the driver uses.
 #
@@ -124,6 +129,36 @@ ok = sum(o.startswith("ok:") for o in outcomes)
 assert ok >= 1, outcomes
 print("chaos smoke: %d/%d correct, %d typed failures, pool balance 0"
       % (ok, len(outcomes), len(outcomes) - ok))
+PY
+
+timeout -k 10 300 env JAX_PLATFORMS=cpu JAX_ENABLE_X64=1 python - <<'PY' || exit $?
+import os
+import sys
+
+sys.path.insert(0, ".")
+os.environ.pop("PRESTO_TPU_NARROW", None)
+from presto_tpu.connectors.tpch import TpchConnector
+from presto_tpu.connectors.tpch.queries import QUERIES
+from presto_tpu.runtime.metrics import REGISTRY
+from presto_tpu.runtime.session import Session
+
+conn = TpchConnector(sf=0.005)
+q = QUERIES["q1"]
+s_on = Session({"tpch": conn}, properties={"result_cache_enabled": False})
+a = s_on.sql(q)
+assert REGISTRY.snapshot().get("exec.q1_fused_route", 0) >= 1, \
+    "narrow Q1 did not route through the fused fragment kernel path"
+t0 = REGISTRY.snapshot().get("exec.traces", 0)
+b = s_on.sql(q)
+t1 = REGISTRY.snapshot().get("exec.traces", 0)
+assert t1 == t0, f"warm narrow repeat re-traced ({t1 - t0} new traces)"
+s_off = Session({"tpch": conn}, properties={"narrow_storage": False,
+                                            "result_cache_enabled": False})
+c = s_off.sql(q)
+os.environ.pop("PRESTO_TPU_NARROW", None)
+assert a.equals(b) and a.equals(c), "narrowing on/off results differ"
+print("narrowing smoke: on/off identical, fused Q1 route hit, "
+      "0 warm re-traces")
 PY
 
 rm -f /tmp/_t1.log

@@ -21,7 +21,10 @@ from presto_tpu.parallel.mesh import make_mesh
 from presto_tpu.runtime.session import Session
 
 SF = 0.002
-TINY_BUDGET = 2048  # bytes: far below every relation at SF 0.002
+# bytes: far below every relation at SF 0.002 — including the 300-row
+# customer build side now that admission estimates count NARROW physical
+# widths (a single int16 key column estimates ~4 B/row -> ~1.2 KB)
+TINY_BUDGET = 512
 
 GROUPED_QUERIES = {
     "inner_unique": (

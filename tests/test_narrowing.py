@@ -1,0 +1,465 @@
+"""Stats-driven narrow physical column storage (ISSUE-5).
+
+The engine's native scan representation is now the narrowest signed-int
+storage each column's declared bounds permit. These tests pin the
+soundness contract: results are BIT-IDENTICAL with narrowing on vs off
+— at the exact declared bound min/max, through scan -> filter -> join
+-> aggregation -> sort -> host decode, per narrowable TypeKind
+(BIGINT, INTEGER, DATE, TIMESTAMP, DECIMAL, VARCHAR codes) — plus the
+plumbing invariants: range-guarded materialization, physical dtypes in
+plan fingerprints, physical-width admission estimates, narrow wire
+tensors on the distributed exchange, and the fused Q1 fragment route.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+import numpy as np
+import pandas.testing as pdt
+import pytest
+
+from presto_tpu.batch import Batch, Dictionary
+from presto_tpu.spi import ColumnStats, Split, batch_capacity, narrowed_schema
+from presto_tpu.types import (
+    BIGINT,
+    DATE,
+    DOUBLE,
+    INTEGER,
+    TIMESTAMP,
+    DataType,
+    TypeKind,
+    decimal,
+    narrow_physical,
+    varchar,
+)
+
+
+@contextlib.contextmanager
+def narrow_env(value):
+    """Pin PRESTO_TPU_NARROW for a block, restoring the prior state
+    (sessions mirror the narrow_storage property into the env, so tests
+    must not leak the switch)."""
+    prior = os.environ.pop("PRESTO_TPU_NARROW", None)
+    if value is not None:
+        os.environ["PRESTO_TPU_NARROW"] = value
+    try:
+        yield
+    finally:
+        if value is not None or "PRESTO_TPU_NARROW" in os.environ:
+            os.environ.pop("PRESTO_TPU_NARROW", None)
+        if prior is not None:
+            os.environ["PRESTO_TPU_NARROW"] = prior
+
+
+# ---------------------------------------------------------------------------
+# unit: the narrow chooser and schema derivation
+# ---------------------------------------------------------------------------
+
+
+def test_narrow_physical_chooser():
+    assert narrow_physical(BIGINT, 0, 100).np_dtype == np.dtype(np.int8)
+    assert narrow_physical(BIGINT, -127, 127).np_dtype == np.dtype(np.int8)
+    # the dtype extreme stays free (unary negation must stay exact)
+    assert narrow_physical(BIGINT, -128, 0).np_dtype == np.dtype(np.int16)
+    assert narrow_physical(BIGINT, 0, 32767).np_dtype == np.dtype(np.int16)
+    assert narrow_physical(BIGINT, 0, 32768).np_dtype == np.dtype(np.int32)
+    assert narrow_physical(BIGINT, 0, 2**31 - 1).np_dtype == np.dtype(np.int32)
+    assert not narrow_physical(BIGINT, 0, 2**31).is_narrowed
+    # INTEGER (canonical int32) narrows to int8/int16 but never "to" int32
+    assert narrow_physical(INTEGER, 0, 1000).np_dtype == np.dtype(np.int16)
+    assert not narrow_physical(INTEGER, 0, 100000).is_narrowed
+    # narrowed != canonical, canonical() round-trips, str stays logical
+    t = narrow_physical(DATE, 0, 10000)
+    assert t != DATE and t.canonical() == DATE
+    assert str(t) == "date" and t.physical_str() == "date:int16"
+    assert not narrow_physical(DOUBLE, 0, 1).is_narrowed
+
+
+def test_narrowed_schema_switch_and_dictionary():
+    types = {"a": BIGINT, "v": varchar()}
+    dicts = {"v": Dictionary([f"s{i:03d}" for i in range(300)])}
+    stats = {"a": ColumnStats(10, 0, 9)}
+    with narrow_env(None):
+        out = narrowed_schema(types, stats.get, dicts)
+        assert out["a"].np_dtype == np.dtype(np.int8)
+        assert out["v"].np_dtype == np.dtype(np.int16)  # 300 codes
+    with narrow_env("0"):
+        out = narrowed_schema(types, stats.get, dicts)
+        assert not out["a"].is_narrowed and not out["v"].is_narrowed
+
+
+def test_from_numpy_range_guard():
+    t = narrow_physical(BIGINT, 0, 100)
+    assert t.np_dtype == np.dtype(np.int8)
+    with pytest.raises(ValueError, match="narrowed physical storage"):
+        Batch.from_numpy({"a": np.array([0, 500], np.int64)}, {"a": t})
+
+
+def test_scan_shares_live_validity():
+    """NULL-free from_numpy columns share the live mask object — the
+    identity the fused Q1 kernel's eligibility check keys on."""
+    b = Batch.from_numpy({"a": np.arange(8)}, {"a": BIGINT}, capacity=16)
+    assert b["a"].valid is b.live
+    # an explicit NULL mask still gets its own validity array
+    b2 = Batch.from_numpy(
+        {"a": np.arange(8)}, {"a": BIGINT}, capacity=16,
+        valids={"a": np.array([True] * 7 + [False])},
+    )
+    assert b2["a"].valid is not b2.live
+
+
+# ---------------------------------------------------------------------------
+# the bound-edge differential connector: every narrowable TypeKind with
+# values AT the declared stats min/max
+# ---------------------------------------------------------------------------
+
+_N = 60
+
+
+class EdgeConnector:
+    """Two tiny tables whose declared stats are EXACT and whose data
+    sits at the declared bound min/max for each narrowable kind."""
+
+    name = "edge"
+
+    def __init__(self):
+        n = _N
+        k = np.arange(n, dtype=np.int64)
+        i16 = np.where(k % 2 == 0, -32767, 32767).astype(np.int64)
+        i16[0], i16[1] = -32767, 32767
+        i32 = np.where(k % 2 == 0, -(2**31 - 2), 2**31 - 2).astype(np.int64)
+        dec = np.where(k % 3 == 0, -30000, 30000).astype(np.int64)  # +-300.00
+        d = np.where(k % 2 == 0, -127, 127).astype(np.int64)
+        ts = np.where(k % 2 == 0, -(10**6), 10**6).astype(np.int64)
+        self._vdict = Dictionary([f"s{i:03d}" for i in range(200)])
+        v = np.where(k % 2 == 0, 0, 199).astype(np.int64)
+        nn = k.copy()
+        nn_valid = (k % 5 != 0)
+        self._tables = {
+            "edge": {
+                "arrays": {"k": k, "i16": i16, "i32": i32, "dec": dec,
+                           "d": d, "ts": ts, "v": v, "nn": nn,
+                           "nn$valid": nn_valid},
+                "types": {"k": BIGINT, "i16": BIGINT, "i32": BIGINT,
+                          "dec": decimal(12, 2), "d": DATE,
+                          "ts": TIMESTAMP, "v": varchar(), "nn": BIGINT},
+                "dicts": {"v": self._vdict},
+                "stats": {
+                    "k": ColumnStats(n, 0, n - 1),
+                    "i16": ColumnStats(2, -32767, 32767),
+                    "i32": ColumnStats(2, -(2**31 - 2), 2**31 - 2),
+                    "dec": ColumnStats(2, -300.0, 300.0),
+                    "d": ColumnStats(2, -127, 127),
+                    "ts": ColumnStats(2, -(10**6), 10**6),
+                    "nn": ColumnStats(n, 0, n - 1, null_fraction=0.2),
+                },
+            },
+            "dim": {
+                "arrays": {"dk": k, "tag": np.where(k % 2 == 0, 0, 1)
+                           .astype(np.int64)},
+                "types": {"dk": BIGINT, "tag": varchar()},
+                "dicts": {"tag": Dictionary(["even", "odd"])},
+                "stats": {"dk": ColumnStats(n, 0, n - 1)},
+            },
+        }
+
+    def tables(self):
+        return list(self._tables)
+
+    def schema(self, table):
+        return self._tables[table]["types"]
+
+    def dictionaries(self, table):
+        return self._tables[table]["dicts"]
+
+    def row_count(self, table):
+        return _N
+
+    def stats(self, table, column):
+        return self._tables[table]["stats"].get(column)
+
+    def physical_schema(self, table, columns=None):
+        t = self._tables[table]
+        cols = list(columns) if columns is not None else list(t["types"])
+        return narrowed_schema({c: t["types"][c] for c in cols},
+                               lambda c: self.stats(table, c), t["dicts"])
+
+    def splits(self, table, target_splits=0):
+        return [Split(table, 0, 0, _N, _N)]
+
+    def scan_numpy(self, split, columns=None):
+        t = self._tables[split.table]
+        keep = list(t["types"]) if columns is None else list(columns)
+        out = {}
+        for c in keep:
+            out[c] = t["arrays"][c][split.lo:split.hi]
+            if c + "$valid" in t["arrays"]:
+                out[c + "$valid"] = t["arrays"][c + "$valid"][split.lo:split.hi]
+        return out
+
+    def scan(self, split, columns=None, capacity=None):
+        from presto_tpu.spi import split_valids
+
+        arrays, valids = split_valids(self.scan_numpy(split, columns))
+        cap = capacity or batch_capacity(max(split.hi - split.lo, 1))
+        types = self.physical_schema(split.table, list(arrays))
+        t = self._tables[split.table]
+        return Batch.from_numpy(
+            arrays, types, capacity=cap, valids=valids,
+            dictionaries={c: d for c, d in t["dicts"].items() if c in arrays},
+        )
+
+
+_EDGE_QUERY = """
+select tag,
+       sum(i16) s16, sum(i32) s32, sum(dec) sdec,
+       min(d) dmin, max(d) dmax, min(ts) tsmin, max(ts) tsmax,
+       min(v) vmin, max(v) vmax,
+       count(nn) nncnt, sum(nn) nnsum, count(*) c
+from edge join dim on k = dk
+where i16 >= -32767 and d <= date '1970-05-07'
+group by tag
+order by tag
+"""
+
+
+def _run_edge(narrow: bool):
+    from presto_tpu.runtime.session import Session
+
+    with narrow_env("1" if narrow else "0"):
+        s = Session({"edge": EdgeConnector()},
+                    properties={"result_cache_enabled": False})
+        df = s.sql(_EDGE_QUERY)
+        phys = s.catalog.connector("edge").physical_schema("edge")
+    return df, phys
+
+
+def test_edge_bounds_differential():
+    """Values at the exact declared min/max of every narrowed kind
+    survive scan -> filter -> join -> agg -> sort -> decode identically
+    to the canonical int64 path (the running sums exceed each narrow
+    dtype's range, so any unwidened accumulation would wrap)."""
+    narrow_df, phys = _run_edge(True)
+    canon_df, canon_phys = _run_edge(False)
+    assert phys["i16"].np_dtype == np.dtype(np.int16)
+    assert phys["i32"].np_dtype == np.dtype(np.int32)
+    assert phys["dec"].np_dtype == np.dtype(np.int16)
+    assert phys["d"].np_dtype == np.dtype(np.int8)
+    assert phys["ts"].np_dtype == np.dtype(np.int32)
+    assert phys["v"].np_dtype == np.dtype(np.int16)
+    assert phys["k"].np_dtype == np.dtype(np.int8)
+    assert all(not t.is_narrowed for t in canon_phys.values())
+    pdt.assert_frame_equal(narrow_df, canon_df)
+
+
+def test_memory_connector_narrowing():
+    """Written (CTAS-path) tables compute exact min/max stats at store
+    time, so they narrow like generator tables — and round-trip
+    identically to canonical storage."""
+    import pandas as pd
+
+    from presto_tpu.connectors.memory import MemoryConnector
+    from presto_tpu.runtime.session import Session
+
+    df = pd.DataFrame({
+        "a": np.array([-32767, 32767, 5], np.int64),
+        "b": np.array([1, 2, 3], np.int64),
+        "s": ["x", "y", "x"],
+    })
+
+    def run(narrow):
+        with narrow_env("1" if narrow else "0"):
+            conn = MemoryConnector()
+            conn.create_table("t", df)
+            phys = conn.physical_schema("t")
+            s = Session({"memory": conn},
+                        properties={"result_cache_enabled": False})
+            out = s.sql("select s, sum(a) sa, sum(b) sb from t "
+                        "group by s order by s")
+        return out, phys
+
+    narrow_out, phys = run(True)
+    canon_out, _ = run(False)
+    assert phys["a"].np_dtype == np.dtype(np.int16)
+    assert phys["b"].np_dtype == np.dtype(np.int8)
+    pdt.assert_frame_equal(narrow_out, canon_out)
+
+
+# ---------------------------------------------------------------------------
+# the fused Q1 fragment route (eligibility on CPU; the kernel itself is
+# TPU-gated and exactness-tested in tests/test_pallas_q1.py)
+# ---------------------------------------------------------------------------
+
+
+def test_q1_route_eligibility_and_kernel_supported():
+    from presto_tpu.connectors.tpch import TpchConnector
+    from presto_tpu.connectors.tpch.queries import QUERIES
+    from presto_tpu.exec.q1_route import match_q1_fragment
+    from presto_tpu.ops import pallas_q1
+    from presto_tpu.plan import nodes as N
+    from presto_tpu.runtime.session import Session
+
+    conn = TpchConnector(sf=0.005)
+    with narrow_env("1"):
+        s = Session({"tpch": conn})
+        plan = s.plan(QUERIES["q1"])
+
+        agg = None
+
+        def find(n):
+            nonlocal agg
+            if isinstance(n, N.Aggregate):
+                agg = n
+            for c in n.children:
+                find(c)
+
+        find(plan)
+        assert agg is not None
+        route = match_q1_fragment(agg, s.catalog)
+        assert route is not None, "canonical TPC-H Q1 must match the route"
+        assert set(route.rename.values()) == set(
+            ("l_quantity", "l_extendedprice", "l_discount", "l_tax",
+             "l_returnflag", "l_linestatus", "l_shipdate"))
+        # the SQL-path scan batch is kernel-eligible at an aligned
+        # capacity: narrow dtypes + live-shared validity
+        split = conn.splits("lineitem")[0]
+        b = conn.scan(split, list(route.rename), 1 << 16).rename(route.rename)
+        assert pallas_q1.supported(b), (
+            "SQL-path canonical scan batch must be narrow-kernel eligible")
+        # and ineligible once narrowing is off (canonical int64 columns)
+    with narrow_env("0"):
+        b2 = conn.scan(split, list(route.rename), 1 << 16).rename(route.rename)
+        assert not pallas_q1.supported(b2)
+
+
+def test_q1_route_executes_and_matches_generic():
+    from presto_tpu.connectors.tpch import TpchConnector
+    from presto_tpu.connectors.tpch.queries import QUERIES
+    from presto_tpu.runtime.metrics import REGISTRY
+    from presto_tpu.runtime.session import Session
+
+    conn = TpchConnector(sf=0.005)
+    with narrow_env("1"):
+        before = REGISTRY.snapshot().get("exec.q1_fused_route", 0)
+        s = Session({"tpch": conn},
+                    properties={"result_cache_enabled": False})
+        routed = s.sql(QUERIES["q1"])
+        assert REGISTRY.snapshot().get("exec.q1_fused_route", 0) > before
+        # the stats recorder disables the route: same query, generic path
+        s2 = Session({"tpch": conn},
+                     properties={"result_cache_enabled": False,
+                                 "collect_node_stats": True})
+        generic = s2.sql(QUERIES["q1"])
+    pdt.assert_frame_equal(routed, generic)
+
+
+# ---------------------------------------------------------------------------
+# plumbing: fingerprints, admission estimates, EXPLAIN, exchange bytes
+# ---------------------------------------------------------------------------
+
+
+def test_plan_fingerprint_includes_physical_dtype():
+    from presto_tpu.cache.fingerprint import plan_fingerprint
+    from presto_tpu.connectors.tpch import TpchConnector
+    from presto_tpu.runtime.session import Session
+
+    conn = TpchConnector(sf=0.005)
+    q = "select sum(l_quantity) from lineitem"
+    with narrow_env("1"):
+        s = Session({"tpch": conn})
+        fp_narrow = plan_fingerprint(s.plan(q), s.catalog, s.properties)
+    with narrow_env("0"):
+        fp_canon = plan_fingerprint(s.plan(q), s.catalog, s.properties)
+    assert fp_narrow is not None and fp_canon is not None
+    assert fp_narrow != fp_canon, (
+        "physical dtypes must be part of the plan fingerprint")
+
+
+def test_admission_estimates_use_physical_widths():
+    from presto_tpu.connectors.tpch import TpchConnector
+    from presto_tpu.plan.catalog import Catalog
+    from presto_tpu.runtime.memory import estimate_node_bytes, node_row_bytes
+    from presto_tpu.runtime.session import Session
+
+    conn = TpchConnector(sf=0.005)
+    catalog = Catalog({"tpch": conn})
+    with narrow_env("1"):
+        s = Session({"tpch": conn})
+        plan = s.plan("select l_quantity, l_shipdate, l_suppkey from lineitem")
+        scan = plan.child
+        narrow_row = node_row_bytes(scan, catalog)
+        narrow_est = estimate_node_bytes(scan, catalog)
+    with narrow_env("0"):
+        canon_row = node_row_bytes(scan, catalog)
+        canon_est = estimate_node_bytes(scan, catalog)
+    # qty 8->2, shipdate 4->2, suppkey 8->2 (sf .005): > 2x narrower
+    assert narrow_row * 2 < canon_row
+    assert narrow_est * 2 < canon_est
+
+
+def test_explain_shows_physical_types():
+    from presto_tpu.connectors.tpch import TpchConnector
+    from presto_tpu.runtime.session import Session
+
+    with narrow_env("1"):
+        s = Session({"tpch": TpchConnector(sf=0.005)})
+        out = s.explain("select sum(l_quantity) q from lineitem "
+                        "where l_shipdate <= date '1998-09-02'")
+        assert "l_quantity:decimal(12,2):int16" in out
+        assert "l_shipdate:date:int16" in out
+        dist = s.explain_distributed(
+            "select sum(l_quantity) q from lineitem")
+        assert "l_quantity:int16" in dist
+
+
+def test_exchange_bytes_narrow_at_least_halves():
+    """An int32-boundable repartition payload moves >= 2x fewer wire
+    bytes than the int64 baseline (partitioned-window repartition of
+    raw narrow scan columns on the 8-device virtual mesh), with
+    identical rows."""
+    from presto_tpu.connectors.tpch import TpchConnector
+    from presto_tpu.parallel.mesh import make_mesh
+    from presto_tpu.runtime.metrics import REGISTRY
+    from presto_tpu.runtime.session import Session
+
+    q = ("select l_suppkey, l_quantity, l_shipdate, l_discount, l_tax, "
+         "l_commitdate, l_receiptdate, l_linenumber, "
+         "row_number() over (partition by l_suppkey order by l_quantity) rn "
+         "from lineitem")
+    conn = TpchConnector(sf=0.002)
+
+    def run(narrow):
+        with narrow_env("1" if narrow else "0"):
+            REGISTRY.reset()
+            s = Session({"tpch": conn}, mesh=make_mesh(8),
+                        properties={"result_cache_enabled": False})
+            df = s.sql(q)
+            nbytes = REGISTRY.snapshot().get("exchange.bytes", 0)
+        return df, nbytes
+
+    narrow_df, narrow_bytes = run(True)
+    canon_df, canon_bytes = run(False)
+    assert narrow_bytes > 0 and canon_bytes > 0
+    assert canon_bytes >= 2 * narrow_bytes, (
+        f"exchange.bytes narrow={narrow_bytes} canonical={canon_bytes}")
+    cols = list(narrow_df.columns)
+    pdt.assert_frame_equal(
+        narrow_df.sort_values(cols).reset_index(drop=True),
+        canon_df.sort_values(cols).reset_index(drop=True),
+    )
+
+
+def test_global_agg_widens_narrow_sums():
+    """An ungrouped sum over an int8-narrowed column whose total far
+    exceeds int8 must widen before accumulating."""
+    from presto_tpu.runtime.session import Session
+
+    conn = EdgeConnector()
+    with narrow_env("1"):
+        s = Session({"edge": conn},
+                    properties={"result_cache_enabled": False})
+        out = s.sql("select sum(k) s, min(k) mn, max(k) mx from edge")
+    assert int(out["s"][0]) == _N * (_N - 1) // 2
+    assert int(out["mn"][0]) == 0 and int(out["mx"][0]) == _N - 1
