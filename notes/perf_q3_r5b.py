@@ -1,6 +1,15 @@
 """Round-5 Q3 probe B: Pallas VMEM bitmask lookup via chained
 tpu.dynamic_gather.
 
+WARNING (round 6): the chained composition below is WRONG — the second
+gather evaluates w_hi at position (r, w_lo[r,l]), not (r, l), so
+z[r,l] = table[w_hi[r, w_lo[r,l]], w_lo[r,l]] != table[w_hi[r,l],
+w_lo[r,l]] whenever w_hi varies along the lane. This note was an
+unvalidated experiment; the SHIPPED kernels (ops/pallas_join.py) use
+LANE-REPLICATED tables (tab[s, l] = flat[s] for every l) so ONE
+per-lane sublane select resolves any flat slot exactly, at 128x VMEM
+cost for the table. Kept for the measurement context only.
+
 The XLA dense-table probe measured ~12 ns/element (733 ms / 60M) — the
 per-element HBM gather is the wall, independent of table size (a 750KB
 packed bitmask only bought 20%). Mosaic lowers jnp.take_along_axis to

@@ -53,6 +53,12 @@ class CacheEntry:
     df: object  # the stored pandas DataFrame (never handed out directly)
     versions: "tuple[tuple[str, int], ...]"  # (table, version) at populate
     nbytes: int
+    #: the populating run probed an approximate join sketch — a hit
+    #: must restore QueryInfo.approximate exactly as the original run
+    #: reported it (never inferred from the session property: an
+    #: approx-enabled session still produces EXACT results when no
+    #: sketch ever fired)
+    approximate: bool = False
 
 
 class ResultCache:
@@ -75,6 +81,13 @@ class ResultCache:
     def get(self, key: Optional[str], catalog):
         """The cached DataFrame (a defensive copy) or None. Version
         drift against the live catalog drops the entry."""
+        hit = self.get_entry(key, catalog)
+        return None if hit is None else hit[0]
+
+    def get_entry(self, key: Optional[str], catalog):
+        """(defensive df copy, CacheEntry) or None — the entry carries
+        populate-time metadata (``approximate``) the session restores
+        onto the hit's QueryInfo."""
         if key is None:
             # an admissible plan whose fingerprint failed: without this
             # the hit-rate metrics would silently overstate (exec_cache
@@ -92,11 +105,12 @@ class ResultCache:
             return None
         self._entries.move_to_end(key)
         REGISTRY.counter("result_cache.hit").add()
-        return entry.df.copy()
+        return entry.df.copy(), entry
 
     # ---- populate --------------------------------------------------------
     def put(self, key: Optional[str], df, versions,
-            max_bytes: Optional[int] = None) -> bool:
+            max_bytes: Optional[int] = None,
+            approximate: bool = False) -> bool:
         """Store a finished result (a copy — callers may mutate the
         frame they return to the client). ``max_bytes`` refreshes the
         budget from the session property at each populate."""
@@ -110,7 +124,8 @@ class ResultCache:
             return False
         if key in self._entries:
             self._drop(key)
-        self._entries[key] = CacheEntry(df.copy(), tuple(versions), nbytes)
+        self._entries[key] = CacheEntry(df.copy(), tuple(versions), nbytes,
+                                        approximate)
         self._bytes += nbytes
         while self._bytes > self.max_bytes and self._entries:
             old_key = next(iter(self._entries))

@@ -71,6 +71,15 @@ def minmax_key(catalog, node, key_expr) -> Optional[str]:
     )
 
 
+def peek(key: Optional[str]):
+    """The cached (min, max) for ``key`` without computing — lets the
+    join-build sideways pass feed its already-computed bounds in only
+    when absent (the readback is skipped entirely on a hit)."""
+    if key is None:
+        return None
+    return _entries.get(key)
+
+
 def cached_minmax(key: Optional[str],
                   compute: Callable[[], "tuple[int, int]"]):
     """The (min, max) for ``key``, computing (and storing) on miss."""

@@ -50,6 +50,7 @@ SCHEMAS: dict[str, dict[str, DataType]] = {
         "output_rows": BIGINT,
         "fragment_retries": BIGINT,
         "cache_hit": BIGINT,
+        "approximate": BIGINT,
         "degraded": BIGINT,
         "oom_retries": BIGINT,
         "memory_queued_s": DOUBLE,
@@ -152,6 +153,7 @@ class SystemConnector:
                 [i.output_rows for i in infos],
                 [i.fragment_retries for i in infos],
                 [int(i.cache_hit) for i in infos],
+                [int(i.approximate) for i in infos],
                 [int(i.degraded) for i in infos],
                 [i.oom_retries for i in infos],
                 [i.memory_queued_s for i in infos],
@@ -223,7 +225,8 @@ class SystemConnector:
             }
         elif table == "query_history":
             (qid, state, sql, tok, queued, planning, execution, elapsed,
-             outrows, retries, hits, degraded, oomr, memq, ecode) = rows
+             outrows, retries, hits, approx, degraded, oomr, memq,
+             ecode) = rows
             arrays = {
                 "query_id": _bytes_col(qid, 24),
                 "state": STATE_DICT.encode(state).astype(np.int32),
@@ -236,6 +239,7 @@ class SystemConnector:
                 "output_rows": np.asarray(outrows, np.int64),
                 "fragment_retries": np.asarray(retries, np.int64),
                 "cache_hit": np.asarray(hits, np.int64),
+                "approximate": np.asarray(approx, np.int64),
                 "degraded": np.asarray(degraded, np.int64),
                 "oom_retries": np.asarray(oomr, np.int64),
                 "memory_queued_s": np.asarray(memq, np.float64),

@@ -187,6 +187,18 @@ class DistributedExecutor(OomLadderMixin):
         from presto_tpu.exec.local_planner import DIRECT_LIMIT
 
         self.catalog = catalog
+        # The fused Pallas join probe (ops/pallas_join) never runs on
+        # this tier: the distributed probe steps are GSPMD-sharded
+        # jits where a pallas_call would not partition — the fused
+        # route fires on the LOCAL tier (and on distributed->local
+        # degraded runs, which read the session's pallas_join property
+        # directly), so no spec is ever passed to the broadcast build
+        # below. The OOM ladder keeps its contract either way: rung>0
+        # forces grouped (bucketed) joins, which never build fused
+        # tables — the robustness backstop stays the backstop.
+        #: QUERY-scoped join-key min/max memo (reset per run; hits
+        #: fire joinkeys.minmax_memo_hits — see exec/joinkeys.py)
+        self._minmax_memo: dict = {}
         self.mesh = mesh
         self.nworkers = int(mesh.devices.size)
         #: L9 budget (SURVEY §2.1 L9, §7.4 #5): a join build side or an
@@ -247,6 +259,8 @@ class DistributedExecutor(OomLadderMixin):
             self.join_build_budget)
         if self.recorder is not None:
             self.recorder.attach_plan(plan)
+        # query-scoped join-key min/max memo (see exec/joinkeys.py)
+        self._minmax_memo.clear()
         scalars: dict[str, Any] = {}
         with trace_span("node:Output", "node",
                         {"plan_node_id": self._nid(plan)}):
@@ -726,6 +740,7 @@ class DistributedExecutor(OomLadderMixin):
             node.left_keys, node.right_keys, scalars,
             catalog=self.catalog, lnode=node.left, rnode=node.right,
             runtime_minmax=runtime_minmax, runtime_dict=runtime_dict,
+            minmax_memo=self._minmax_memo,
         )
 
     def _exec_join(self, node: N.Join, scalars) -> DistBatch:

@@ -33,6 +33,22 @@ from presto_tpu.plan.bounds import expr_interval, key_dictionary, node_intervals
 from presto_tpu.types import TypeKind, fixed_bytes
 
 
+def declared_key_interval(node, key: Expr, catalog):
+    """Connector-DECLARED (min, max) physical interval of a join key
+    over a plan subtree, or None when unbounded.
+
+    This is the static half of probe-side min/max pruning: it rides
+    the same ``spi.stats_physical_interval`` scaling rule narrowing
+    uses (via ``plan/bounds``), so a stats-cache miss — no runtime
+    min/max readback has ever been paid for this build — still prunes
+    probe scans against the build's static domain. The runtime
+    products, when the build finishes, only tighten it."""
+    iv = expr_interval(key, node_intervals(node, catalog))
+    if iv is None:
+        return None
+    return (int(iv[0]), int(iv[1]))
+
+
 def join_key_exprs(
     lkeys: Sequence[Expr],
     rkeys: Sequence[Expr],
@@ -43,12 +59,23 @@ def join_key_exprs(
     rnode,
     runtime_minmax: Callable[[int, Expr], tuple[int, int]],
     runtime_dict: Callable[[int, Expr], object] | None = None,
+    minmax_memo: dict | None = None,
 ):
     """Normalize (left, right) key expr lists to ONE packed int64 pair.
 
     ``runtime_minmax(side, expr)`` -> (min, max) over live, valid rows
     of that side (side 0 = left/probe, 1 = right/build); only invoked
     for multi-key pairs whose stats intervals are unknown.
+
+    ``minmax_memo``: an optional QUERY-scoped dict the executor owns
+    (one per plan run) — repeated key-expr min/max lookups across the
+    query's joins then share one memo instead of rebuilding it per
+    call (the seed rebuilt a fresh per-call dict each time, so a query
+    joining the same key pair twice paid the fingerprint + stats-cache
+    walk twice). Hits fire the ``joinkeys.minmax_memo_hits`` counter.
+    Entries key on the CONTENT fingerprint (``stats_cache.minmax_key``
+    includes table versions), so a long-lived memo can never serve
+    stale bounds — a version bump changes the key.
 
     ``runtime_dict(side, expr)`` -> the Dictionary object the key
     column actually carries (or None) — the metadata-only fallback when
@@ -128,23 +155,38 @@ def join_key_exprs(
     renv = node_intervals(rnode, catalog)
 
     from presto_tpu.cache import stats_cache
+    from presto_tpu.runtime.metrics import REGISTRY
 
-    _minmax_cache: dict = {}
+    _minmax_cache: dict = {} if minmax_memo is None else minmax_memo
+    _local_cache: dict = {}  # per-call only: identity-keyed entries
 
     def cached_minmax(side, key):
-        # per-call memo (one fingerprint + readback per (side, key)
-        # across the width ladder) in front of the CROSS-QUERY stats
-        # cache, which keys by content fingerprint + table versions —
-        # the seed's id()-keyed dict missed equal-but-distinct exprs
-        # and nothing survived the call (cache/stats_cache.py)
-        k = (side, id(key))
-        if k not in _minmax_cache:
-            node = lnode if side == 0 else rnode
-            ck = stats_cache.minmax_key(catalog, node, key)
-            _minmax_cache[k] = stats_cache.cached_minmax(
+        # query-scoped memo (one readback per key content across the
+        # width ladder AND across the query's joins — the caller
+        # passes ``minmax_memo``; without it this degrades to the old
+        # per-call dict) in front of the CROSS-QUERY stats cache,
+        # which keys by content fingerprint + table versions — the
+        # seed's id()-keyed dict missed equal-but-distinct exprs and
+        # nothing survived the call (cache/stats_cache.py)
+        node = lnode if side == 0 else rnode
+        ck = stats_cache.minmax_key(catalog, node, key)
+        if ck is None:
+            # no content fingerprint: identity keys must NOT outlive
+            # this call — bind_scalars mints fresh expr objects per
+            # call, and a recycled id() in a longer-lived memo could
+            # serve another key's bounds (silently wrong packing)
+            k = (side, id(key))
+            if k not in _local_cache:
+                _local_cache[k] = stats_cache.cached_minmax(
+                    None, lambda: runtime_minmax(side, key))
+            return _local_cache[k]
+        if ck in _minmax_cache:
+            REGISTRY.counter("joinkeys.minmax_memo_hits").add()
+        else:
+            _minmax_cache[ck] = stats_cache.cached_minmax(
                 ck, lambda: runtime_minmax(side, key)
             )
-        return _minmax_cache[k]
+        return _minmax_cache[ck]
 
     def key_widths(use_stats: bool):
         """Per-key pack widths, or None when exact packing is

@@ -54,6 +54,51 @@ DIRECT_LIMIT = 4096
 MAX_GROUP_CAP = 1 << 20
 MAX_RETRIES = 6
 
+#: selectivity histogram buckets (fraction of scan rows KEPT by a
+#: runtime join filter; 1.0 = the filter pruned nothing)
+_SELECTIVITY_BOUNDS = (0.01, 0.05, 0.1, 0.25, 0.5, 0.75, 0.9, 0.99, 1.0)
+
+
+class JoinFilterSlot:
+    """One sideways-information-passing edge: join build -> probe scan.
+
+    Registered on the probe scan BEFORE the probe subtree executes;
+    starts with the build side's DECLARED key interval (connector
+    stats via ``exec/joinkeys.declared_key_interval``) so pruning works
+    even before — or without — the build's runtime products (the
+    stats-cache-miss case), then tightens to the exact runtime min/max
+    plus the Bloom membership bitmask when the build finishes. The
+    scan consults the slot per batch, so the lazy morsel loop picks up
+    the tightest available state at each yield."""
+
+    __slots__ = ("col", "declared", "minmax", "bloom", "_declared_dev",
+                 "stat_in", "stat_pruned")
+
+    def __init__(self, col: str, declared):
+        self.col = col
+        self.declared = declared
+        self.minmax = None  # (0-d min, 0-d max) device scalars
+        self.bloom = None  # Bloom words array
+        self._declared_dev = None
+        #: pruning stats accumulated as DEVICE scalars across the
+        #: scan stream — a per-batch int() readback would serialize
+        #: the async dispatch pipeline on the hot probe path, so the
+        #: host reads them back ONCE per query (_flush_filter_stats)
+        self.stat_in = None
+        self.stat_pruned = None
+
+    def bounds(self):
+        """(mn, mx) traced-friendly scalars, or None when nothing is
+        known yet (no declared stats, build not finished)."""
+        if self.minmax is not None:
+            return self.minmax
+        if self.declared is None:
+            return None
+        if self._declared_dev is None:
+            self._declared_dev = (jnp.asarray(self.declared[0], jnp.int64),
+                                  jnp.asarray(self.declared[1], jnp.int64))
+        return self._declared_dev
+
 
 def _probe_capacity(lspill, nbuckets: int, probe_chunk: int) -> int:
     """Compiled capacity of grouped-join probe chunks: bounded by the
@@ -124,8 +169,31 @@ def pick_group_strategy(keys, pax, dict_len, est_rows: int,
 
 class LocalExecutor(OomLadderMixin):
     def __init__(self, catalog: Catalog, join_build_budget: int | None = None,
-                 direct_group_limit: int = DIRECT_LIMIT):
+                 direct_group_limit: int = DIRECT_LIMIT,
+                 runtime_join_filters: bool = True,
+                 pallas_join_enabled: bool = True,
+                 approx_join: bool = False):
         self.catalog = catalog
+        #: sideways information passing: push join-build key bounds +
+        #: Bloom bitmasks into probe-side scans (semantics-preserving)
+        self.runtime_join_filters = runtime_join_filters
+        #: prefer the fused VMEM-table Pallas probe where stats permit
+        self.pallas_join_enabled = pallas_join_enabled
+        #: allow the APPROXIMATE sketch probe (semi joins; false
+        #: positives possible) where the exact table cannot fit
+        self.approx_join = approx_join
+        #: id(probe scan node) -> [JoinFilterSlot] (runtime filters
+        #: registered by ancestor joins before the probe side executes)
+        self._scan_filters: dict[int, list[JoinFilterSlot]] = {}
+        #: QUERY-scoped join-key min/max memo shared by every
+        #: join_key_exprs call in one plan run (reset per run_batches;
+        #: hits fire joinkeys.minmax_memo_hits — see exec/joinkeys.py)
+        self._minmax_memo: dict = {}
+        #: True when this run handed a SKETCH (approximate) spec to a
+        #: finished build that published tables: the query's semi-join
+        #: membership may contain Bloom false positives, and QueryInfo
+        #: must say so (never silently approximate)
+        self.used_approx = False
         #: optional StatsRecorder for the current query (set by the
         #: Session; powers QueryInfo node stats and EXPLAIN ANALYZE)
         self.recorder = None
@@ -170,6 +238,10 @@ class LocalExecutor(OomLadderMixin):
 
         if self.recorder is not None:
             self.recorder.attach_plan(plan)
+        # per-run state: the OOM ladder re-enters run() on the same
+        # executor, and each rung is its own plan run
+        self._minmax_memo.clear()
+        self.used_approx = False
         scalars: dict[str, Any] = {}
         child = plan.child
         batches = self._exec(child, scalars)
@@ -189,7 +261,11 @@ class LocalExecutor(OomLadderMixin):
 
         with trace_span("node:Output", "node",
                         {"plan_node_id": self._nid(plan)}):
-            return run_fragment("fragment:Output", drain), list(plan.names)
+            out = run_fragment("fragment:Output", drain)
+        # every lazy scan has drained by here: one readback flushes
+        # the runtime-join-filter pruning stats for the whole query
+        self._flush_filter_stats()
+        return out, list(plan.names)
 
     # ------------------------------------------------------------------
     def _exec(self, node: N.PlanNode, scalars: dict) -> BatchStream:
@@ -275,6 +351,7 @@ class LocalExecutor(OomLadderMixin):
             )
         splits = list(conn.splits(node.table))
         cap = batch_capacity(max(s.row_hint for s in splits))
+        fslots = self._scan_filters.get(id(node), ())
 
         def make():
             from presto_tpu.runtime.faults import fault_point
@@ -286,6 +363,8 @@ class LocalExecutor(OomLadderMixin):
                 b = conn.scan(split, src_cols, cap).rename(rename)
                 for op in ops:
                     b = op.process(b)[0]
+                for slot in fslots:
+                    b = self._apply_join_filter(slot, b)
                 yield b
 
         return BatchStream(make)
@@ -423,6 +502,7 @@ class LocalExecutor(OomLadderMixin):
             lkeys, rkeys, scalars,
             catalog=self.catalog, lnode=lnode, rnode=rnode,
             runtime_minmax=runtime_minmax, runtime_dict=runtime_dict,
+            minmax_memo=self._minmax_memo,
         )
 
     def _build_key_interval(self, node_right, right_keys):
@@ -459,7 +539,174 @@ class LocalExecutor(OomLadderMixin):
             return (iv[0], int(domain))
         return None
 
+    # ---- fused Pallas probe + sideways information passing ---------------
+    _PALLAS_PAYLOAD_KINDS = (TypeKind.INTEGER, TypeKind.BIGINT, TypeKind.DATE,
+                             TypeKind.DECIMAL, TypeKind.VARCHAR,
+                             TypeKind.BOOLEAN)
+
+    def _pallas_spec(self, iv, outs: tuple, rfields, unique: bool, kind: str):
+        """The fused-probe configuration for a join whose build-key
+        stats interval is ``iv`` (ops/pallas_join.PallasJoinSpec), or
+        None when no kernel mode fits. Exact modes first; the sketch
+        (approximate) mode only under ``approx_join``, only for semi
+        joins, and only when no exact table fits."""
+        if not self.pallas_join_enabled:
+            return None
+        from presto_tpu.ops import pallas_join
+
+        if iv is not None and pallas_join.interval_ok(int(iv[0]), int(iv[1])):
+            lo, hi = int(iv[0]), int(iv[1])
+            domain = hi - lo + 1
+            if outs:
+                kinds_ok = all(
+                    rfields.get(c) is not None
+                    and rfields[c].kind in self._PALLAS_PAYLOAD_KINDS
+                    for c in outs
+                )
+                if (unique and kind in ("inner", "left") and kinds_ok
+                        and pallas_join.payload_rows(domain, len(outs))):
+                    return pallas_join.PallasJoinSpec(
+                        "payload", lo, hi, payload=tuple(outs))
+            elif ((kind in ("semi", "anti") or (unique and kind == "inner"))
+                    and pallas_join.exists_words(domain)):
+                return pallas_join.PallasJoinSpec("exists", lo, hi)
+        if self.approx_join and kind == "semi" and not outs:
+            return pallas_join.PallasJoinSpec(
+                "sketch", nbits=pallas_join.SKETCH_BITS)
+        return None
+
+    def _register_join_filter(self, node):
+        """Create + register the probe-scan filter slot for an
+        INNER/SEMI join BEFORE its probe subtree executes. Structural
+        eligibility (kind, single numeric key, traceable probe scan)
+        is ``joinfilters.filter_edge_for`` — the SAME predicate
+        EXPLAIN renders, so placement can never drift between the two.
+        The slot starts from the build side's DECLARED key interval
+        (joinkeys.declared_key_interval -> spi.stats_physical_interval)
+        so static domains prune even when no runtime products ever
+        arrive — the stats-cache-miss posture."""
+        if not (self.runtime_join_filters and self.oom_rung == 0):
+            return None
+        from presto_tpu.plan.joinfilters import filter_edge_for
+
+        tgt = filter_edge_for(node)
+        if tgt is None:
+            return None
+        from presto_tpu.exec.joinkeys import declared_key_interval
+
+        scan, col = tgt
+        lst = self._scan_filters.setdefault(id(scan), [])
+        for s in lst:
+            if s.col == col:  # query retry re-planning the same node:
+                return s  # reuse (fill overwrites with fresh products)
+        slot = JoinFilterSlot(col, declared_key_interval(
+            node.right, node.right_keys[0], self.catalog))
+        lst.append(slot)
+        return slot
+
+    def _filter_bits(self, node_right) -> int:
+        """Bloom sizing: ~4 bits per estimated build row, clamped to
+        [2^13, 2^23] (1 KB..1 MB of words)."""
+        from presto_tpu.plan.bounds import estimate_rows
+
+        est = estimate_rows(node_right, self.catalog)
+        nbits = 1 << 13
+        while nbits < 4 * est and nbits < (1 << 23):
+            nbits <<= 1
+        return nbits
+
+    def _fill_join_filter(self, slot, build, node_right, rkey):
+        """Publish the finished build's runtime products into the
+        slot and feed the exact min/max into the cross-query stats
+        cache (the readback is paid once per plan content — later
+        queries' key packing reuses it)."""
+        if slot is None or build.filter_minmax is None:
+            return
+        slot.minmax = build.filter_minmax
+        slot.bloom = build.filter_bloom
+        from presto_tpu.cache import stats_cache
+
+        ck = stats_cache.minmax_key(self.catalog, node_right, rkey)
+        if ck is not None and stats_cache.peek(ck) is None:
+            mn, mx = int(slot.minmax[0]), int(slot.minmax[1])
+            if mn <= mx:  # non-empty build only: an empty build's
+                # sentinel interval would poison key packing
+                stats_cache.cached_minmax(ck, lambda: (mn, mx))
+
+    def _apply_join_filter(self, slot: JoinFilterSlot, b: Batch) -> Batch:
+        """AND the filter into the scan batch's live mask (range +
+        Bloom membership), counting pruned rows. Filtering is free
+        downstream — live is a selection vector — and pays off wherever
+        per-live-row work follows (expansion capacity, aggregation,
+        exchange compaction)."""
+        bounds = slot.bounds()
+        if bounds is None or slot.col not in b:
+            return b
+        if b[slot.col].data.ndim != 1:
+            return b  # defensive: bounds are over 1-D numeric domains
+        from presto_tpu.cache.exec_cache import EXEC_CACHE, trace_probe
+        from presto_tpu.runtime.metrics import REGISTRY
+        from presto_tpu.runtime.trace import span as trace_span
+
+        name = slot.col
+        words = slot.bloom
+
+        def make():
+            from presto_tpu.ops.hashing import bloom_test
+
+            @jax.jit
+            def step(b: Batch, mn, mx, *wrds):
+                trace_probe()
+                col = b[name]
+                k = col.data.astype(jnp.int64)
+                # NULL keys cannot match an inner/semi join: prune them
+                keep = (k >= mn) & (k <= mx) & col.valid
+                if wrds:
+                    keep = keep & bloom_test(wrds[0], col.data)
+                live = b.live & keep
+                n_in = jnp.sum(b.live.astype(jnp.int32))
+                pruned = jnp.sum((b.live & ~live).astype(jnp.int32))
+                return b.with_live(live), n_in, pruned
+
+            return step
+
+        step = EXEC_CACHE.get_or_build(
+            EXEC_CACHE.key_of("join_filter", name, words is not None),
+            make,
+        )
+        with trace_span("join_filter", "join", {"column": name}):
+            args = (bounds[0], bounds[1]) + ((words,) if words is not None
+                                             else ())
+            nb, n_in, pruned = step(b, *args)
+        # accumulate on DEVICE: an int() here would block the host on
+        # every scan batch (one round-trip per morsel just for
+        # metrics); the single readback happens at query drain
+        slot.stat_in = n_in if slot.stat_in is None else slot.stat_in + n_in
+        slot.stat_pruned = (pruned if slot.stat_pruned is None
+                            else slot.stat_pruned + pruned)
+        return nb
+
+    def _flush_filter_stats(self):
+        """The once-per-query host readback of the runtime-filter
+        pruning stats (counters + a per-slot selectivity observation);
+        accumulators reset so an OOM-ladder re-run never double-counts."""
+        from presto_tpu.runtime.metrics import REGISTRY
+
+        for slots in self._scan_filters.values():
+            for slot in slots:
+                if slot.stat_in is None:
+                    continue
+                n_in, pruned = int(slot.stat_in), int(slot.stat_pruned)
+                slot.stat_in = slot.stat_pruned = None
+                REGISTRY.counter("join.filter_rows_in").add(n_in)
+                REGISTRY.counter("join.filter_rows_pruned").add(pruned)
+                if n_in:
+                    REGISTRY.histogram("join.filter_selectivity",
+                                       bounds=_SELECTIVITY_BOUNDS).add(
+                        1.0 - pruned / n_in)
+
     def _exec_join(self, node: N.Join, scalars):
+        fslot = self._register_join_filter(node)
         left = self._exec(node.left, scalars)
         right_stream = self._exec(node.right, scalars)
         # L9 capacity planning: a build side whose estimated bytes
@@ -483,6 +730,9 @@ class LocalExecutor(OomLadderMixin):
                     "wide string keys in grouped (spilled) joins"
                 )
             if not verify:
+                from presto_tpu.runtime.metrics import REGISTRY
+
+                REGISTRY.counter("join.strategy.grouped").add()
                 return self._exec_grouped_join(
                     node, left, right_stream, lkey, rkey, est
                 )
@@ -505,12 +755,23 @@ class LocalExecutor(OomLadderMixin):
             )
         iv = (self._build_key_interval(node.right, node.right_keys)
               if node.unique else None)
+        # the fused Pallas probe (ops/pallas_join) is the PREFERRED
+        # strategy whenever stats bound the key domain inside the VMEM
+        # table budget; dense/packed stay as the next rungs (and the
+        # per-batch fallback targets) — hash-verified keys never route
+        spec = None if verify or node.kind == "full" else self._pallas_spec(
+            iv, tuple(node.output_right),
+            {f.name: f.dtype for f in node.right.fields},
+            node.unique, node.kind)
         # dense/packed only help the UNIQUE probe; other probe kinds
         # would pay the advisory-stats refusal for no benefit
         build = JoinBuildOperator(
             rkey, dense_domain=self._dense_domain(iv, right),
-            key_max=self._key_upper_bound(iv) if node.unique else None)
+            key_max=self._key_upper_bound(iv) if node.unique else None,
+            pallas=spec,
+            filter_bits=self._filter_bits(node.right) if fslot else 0)
         Pipeline(BatchSource(right), [build]).run()
+        self._fill_join_filter(fslot, build, node.right, rkey)
         outs = [BuildOutput(n, n) for n in node.output_right]
         if node.kind == "full":
             return self._exec_full_join(node, left, build, lkey, outs, right,
@@ -699,6 +960,7 @@ class LocalExecutor(OomLadderMixin):
         return BatchStream(make)
 
     def _exec_semijoin(self, node: N.SemiJoin, scalars):
+        fslot = self._register_join_filter(node)
         left = self._exec(node.left, scalars)
         right_stream = self._exec(node.right, scalars)
         jt = "anti" if node.negated else "semi"
@@ -716,6 +978,9 @@ class LocalExecutor(OomLadderMixin):
             )
             if verify:
                 raise NotImplementedError("wide string semi-join keys")
+            from presto_tpu.runtime.metrics import REGISTRY
+
+            REGISTRY.counter("join.strategy.grouped").add()
             return self._exec_grouped_semijoin(left, right_stream, lkey, rkey, est, jt)
         right = right_stream.materialize()
         from presto_tpu.runtime.faults import fault_point
@@ -729,12 +994,25 @@ class LocalExecutor(OomLadderMixin):
             # existence probes have no build_row to verify against;
             # hash collisions could flip semi/anti membership
             raise NotImplementedError("wide string semi-join keys")
-        # semi/anti existence probes use the dense table when stats
-        # allow; the packed build would be dead weight (probe_exists
-        # has no packed path)
+        # semi/anti existence probes prefer the fused Pallas bitmask
+        # (duplicate-safe), then the dense table when stats allow; the
+        # packed build would be dead weight (probe_exists has no
+        # packed path)
         iv = self._build_key_interval(node.right, node.right_keys)
-        build = JoinBuildOperator(rkey, dense_domain=self._dense_domain(iv, right))
+        spec = self._pallas_spec(iv, (), {}, True, jt)
+        build = JoinBuildOperator(
+            rkey, dense_domain=self._dense_domain(iv, right), pallas=spec,
+            filter_bits=self._filter_bits(node.right) if fslot else 0)
         Pipeline(BatchSource(right), [build]).run()
+        self._fill_join_filter(fslot, build, node.right, rkey)
+        if (spec is not None and spec.mode == "sketch"
+                and build.pallas_side is not None):
+            # the sketch tables were published: eligible probe batches
+            # will ride the Bloom sketch, so this query's result may
+            # carry false-positive rows — QueryInfo flags it
+            # (conservative: a per-batch capacity fallback could still
+            # make the run exact in practice; flagged is flagged)
+            self.used_approx = True
         op = LookupJoinOperator(build, lkey, (), jt)
         return left.map(lambda b: op.process(b)[0])
 

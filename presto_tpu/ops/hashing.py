@@ -47,6 +47,69 @@ def partition_ids(columns, num_partitions: int) -> jnp.ndarray:
     return (h % np.uint64(num_partitions)).astype(jnp.int32)
 
 
+# ---------------------------------------------------------------------------
+# 32-bit mixing for the join-sketch / runtime-filter Bloom bitmasks.
+# Everything below must trace under BOTH XLA and Mosaic (Pallas): int32
+# arithmetic only, arithmetic shifts masked back to logical, np.int32
+# literals (weak Python ints trace as i64 scalars Mosaic rejects — see
+# ops/pallas_groupby.py). Build (XLA scatter) and probe (in-kernel)
+# MUST use the same functions or bits and tests would disagree.
+# ---------------------------------------------------------------------------
+
+_M32A = np.int32(np.uint32(0x85EBCA6B).view(np.int32))
+_M32B = np.int32(np.uint32(0xC2B2AE35).view(np.int32))
+#: second-hash input perturbation for the two-bit Bloom
+SKETCH_SEED = np.int32(np.uint32(0x9E3779B9).view(np.int32))
+
+
+def mix32(x):
+    """murmur3 finalizer on int32 lanes (wrapping int32 multiplies;
+    logical shifts emulated as arithmetic-shift-then-mask). Keys wider
+    than 32 bits are truncated first — fine for membership sketches
+    (an aliased wide key can only add a false positive)."""
+    x = x.astype(jnp.int32)
+    x = x ^ ((x >> np.int32(16)) & np.int32(0xFFFF))
+    x = x * _M32A
+    x = x ^ ((x >> np.int32(13)) & np.int32((1 << 19) - 1))
+    x = x * _M32B
+    return x ^ ((x >> np.int32(16)) & np.int32(0xFFFF))
+
+
+def mix32_slots(keys, nbits: int):
+    """The two Bloom bit slots of each key in [0, nbits); ``nbits``
+    must be a power of two (the mask keeps slots non-negative)."""
+    assert nbits & (nbits - 1) == 0, "nbits must be a power of two"
+    mask = np.int32(nbits - 1)
+    k = keys.astype(jnp.int32)
+    return mix32(k) & mask, mix32(k ^ SKETCH_SEED) & mask
+
+
+def bloom_build(keys, live, nbits: int):
+    """[nbits/32] int32 packed two-hash Bloom words over the live keys
+    (XLA side: the runtime-join-filter build product). Bit packing
+    goes through a byte-per-bit scatter so duplicate keys OR cleanly."""
+    s1, s2 = mix32_slots(keys, nbits)
+    p = jnp.zeros(nbits, jnp.int8)
+    p = p.at[jnp.where(live, s1, nbits)].set(1, mode="drop")
+    p = p.at[jnp.where(live, s2, nbits)].set(1, mode="drop")
+    p = p.reshape(nbits // 32, 32).astype(jnp.int64)
+    return (p << jnp.arange(32, dtype=jnp.int64)).sum(
+        axis=1, dtype=jnp.int64).astype(jnp.int32)
+
+
+def bloom_test(words, keys):
+    """bool [n]: Bloom membership (false positives possible, never
+    false negatives). ``words`` from ``bloom_build``."""
+    nbits = words.shape[0] * 32
+    s1, s2 = mix32_slots(keys, nbits)
+
+    def bit(s):
+        w = words[(s >> np.int32(5)).astype(jnp.int32)]
+        return ((w >> (s & np.int32(31))) & np.int32(1)) != 0
+
+    return bit(s1) & bit(s2)
+
+
 _BUCKET_SEED = np.uint64(0xA24BAED4963EE407)
 
 

@@ -319,10 +319,24 @@ def scan_physical_types(node: "TableScan", catalog) -> dict:
         return {}
 
 
-def plan_tree_str(node: PlanNode, indent: int = 0, catalog=None) -> str:
+def plan_tree_str(node: PlanNode, indent: int = 0, catalog=None,
+                  _filters=None, approx_join: bool = False) -> str:
     """EXPLAIN-style rendering (reference: PlanPrinter). With a
     ``catalog``, scan columns render their chosen PHYSICAL storage
-    (``l_shipdate:date:int16``) so narrowing decisions are visible."""
+    (``l_shipdate:date:int16``), joins render the stats-planned probe
+    strategy (``strategy=pallas|dense|unique|expand|grouped``), and
+    probe-side scans render the runtime join filters that will be
+    pushed into them (``runtime_filter=[l_orderkey]``) — the sideways
+    information passing placement, visible before execution. With
+    ``approx_join`` (the session property), semi joins that would
+    probe the Bloom sketch render ``strategy=sketch(approx)`` — the
+    APPROXIMATE mode is never silent in EXPLAIN."""
+    if _filters is None and catalog is not None:
+        from presto_tpu.plan.joinfilters import filter_edges
+
+        _filters = {}
+        for _join, scan, col in filter_edges(node):
+            _filters.setdefault(id(scan), []).append(col)
     pad = "  " * indent
     name = type(node).__name__
     detail = ""
@@ -333,15 +347,20 @@ def plan_tree_str(node: PlanNode, indent: int = 0, catalog=None) -> str:
             else c
             for c, s in node.columns
         ]
-        detail = f" {node.table}{' [pred]' if node.predicate is not None else ''} -> {cols}"
+        rf = (_filters or {}).get(id(node))
+        rfs = f" runtime_filter={rf}" if rf else ""
+        detail = (f" {node.table}{' [pred]' if node.predicate is not None else ''}"
+                  f" -> {cols}{rfs}")
     elif isinstance(node, Aggregate):
         detail = f" keys={[n for n, _ in node.keys]} aggs={[a.name for a in node.aggs]}"
     elif isinstance(node, (Join,)):
         detail = f" {node.kind}{' unique' if node.unique else ''}"
+        detail += _strategy_str(node, catalog, approx_join)
     elif isinstance(node, Window):
         detail = f" funcs={[f.name for f in node.funcs]} frame={node.frame}"
     elif isinstance(node, SemiJoin):
         detail = f"{' anti' if node.negated else ''}"
+        detail += _strategy_str(node, catalog, approx_join)
     elif isinstance(node, (TopN,)):
         detail = f" n={node.count}"
     elif isinstance(node, Limit):
@@ -352,5 +371,18 @@ def plan_tree_str(node: PlanNode, indent: int = 0, catalog=None) -> str:
         detail = f" {[n for n, _ in node.exprs]}"
     out = f"{pad}{name}{detail}\n"
     for c in node.children:
-        out += plan_tree_str(c, indent + 1, catalog=catalog)
+        out += plan_tree_str(c, indent + 1, catalog=catalog,
+                             _filters=_filters or {}, approx_join=approx_join)
     return out
+
+
+def _strategy_str(node, catalog, approx_join: bool = False) -> str:
+    if catalog is None:
+        return ""
+    from presto_tpu.plan.joinfilters import planned_join_strategy
+
+    try:
+        return (" strategy="
+                f"{planned_join_strategy(node, catalog, approx_join=approx_join)}")
+    except Exception:  # noqa: BLE001 — EXPLAIN must render partial plans
+        return ""

@@ -351,7 +351,14 @@ class QueryManager:
         rung = 0
         while True:
             try:
-                return executor.run(plan)
+                result = executor.run(plan)
+                # approximate-join visibility: the executor records
+                # whether this run published a sketch (Bloom) probe —
+                # QueryInfo must flag possibly-approximate results so
+                # exactness is never silently degraded (ISSUE-7)
+                info.approximate = bool(
+                    getattr(executor, "used_approx", False))
+                return result
             except DeviceOutOfMemory as e:
                 degrade = getattr(executor, "degrade_for_oom", None)
                 if rung >= ladder_max or degrade is None or not degrade():
@@ -397,6 +404,9 @@ class QueryManager:
             self.session.catalog,
             join_build_budget=self.session.prop("join_build_budget_bytes"),
             direct_group_limit=self.session.prop("direct_group_limit"),
+            runtime_join_filters=self.session.prop("runtime_join_filters"),
+            pallas_join_enabled=self.session.prop("pallas_join"),
+            approx_join=self.session.prop("approx_join"),
         )
         if recorder is not None:
             # stats from the failed distributed attempt must not leak

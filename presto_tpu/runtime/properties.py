@@ -271,6 +271,39 @@ SESSION_PROPERTIES: dict[str, PropertyDef] = {
             "bit-identical either way.",
         ),
         PropertyDef(
+            "runtime_join_filters", bool, True,
+            "Sideways information passing: when a join build side "
+            "finishes, its key min/max plus a Bloom membership bitmask "
+            "are pushed into the probe-side table scan, pruning rows "
+            "that cannot join before downstream operators see them "
+            "(inner and semi joins only — outer/anti joins keep "
+            "unmatched probe rows). Semantics-preserving: results are "
+            "bit-identical on or off; observable via the "
+            "join.filter_rows_pruned / join.filter_selectivity "
+            "metrics and the join_filter trace span.",
+        ),
+        PropertyDef(
+            "pallas_join", bool, True,
+            "Prefer the fused Pallas VMEM-table probe for equi-joins "
+            "on narrow stats-bounded keys (build->probe->project in "
+            "one kernel; ops/pallas_join.py). Ineligible joins — wide "
+            "keys, over-budget domains, unblockable capacities — fall "
+            "back to the dense/sorted/expansion XLA probes with a "
+            "join.pallas_fallback counter; results are bit-identical "
+            "either way.",
+        ),
+        PropertyDef(
+            "approx_join", bool, False,
+            "APPROXIMATE semi joins: when the exact fused table cannot "
+            "fit VMEM, probe a two-hash Bloom sketch instead — false "
+            "positives possible (extra rows at roughly "
+            "(1-exp(-2n/m))^2 for n build keys in m=2^19 bits), never "
+            "false negatives, never row loss (anti joins are excluded "
+            "by construction). Changes results: the plan fingerprint "
+            "folds this property, so cached results never leak across "
+            "the exact/approximate boundary.",
+        ),
+        PropertyDef(
             "pallas_strings", bool, None,
             "Force the Pallas string-predicate kernels on or off "
             "(process-wide; default: on when running on TPU). Mirrors "

@@ -198,6 +198,9 @@ class Session:
                 self.catalog,
                 join_build_budget=budget,
                 direct_group_limit=self.prop("direct_group_limit"),
+                runtime_join_filters=self.prop("runtime_join_filters"),
+                pallas_join_enabled=self.prop("pallas_join"),
+                approx_join=self.prop("approx_join"),
             )
         from presto_tpu.exec.distributed import DistributedExecutor
 
@@ -241,7 +244,8 @@ class Session:
         return prune(logical)
 
     def explain(self, sql: str) -> str:
-        return plan_tree_str(self.plan(sql), catalog=self.catalog)
+        return plan_tree_str(self.plan(sql), catalog=self.catalog,
+                             approx_join=bool(self.prop("approx_join")))
 
     def explain_distributed(self, sql: str) -> str:
         """Fragment/exchange rendering (reference: EXPLAIN (TYPE
@@ -448,12 +452,19 @@ class Session:
                     REGISTRY.histogram("cache.result_lookup_s").time():
                 fp = plan_fingerprint(plan, self.catalog, self.properties,
                                       self.mesh)
-                cached = self.result_cache.get(fp, self.catalog)
+                hit = self.result_cache.get_entry(fp, self.catalog)
+                cached = None if hit is None else hit[0]
                 if sp is not None:
                     sp.args["hit"] = cached is not None
             if cached is not None:
                 info.state = "FINISHED"
                 info.cache_hit = True
+                # restore the flag the POPULATING run recorded — an
+                # approx-enabled session still produces exact results
+                # when no sketch fired, and the hit must not re-label
+                # them (the fingerprint folds approx_join, so exact
+                # and approximate sessions can never share entries)
+                info.approximate = hit[1].approximate
                 info.output_rows = len(cached)
                 info.finished_at = time.time()
                 info.finished_mono = time.monotonic()
@@ -480,6 +491,7 @@ class Session:
                     self.result_cache.put(
                         fp, df, table_versions(plan, self.catalog),
                         max_bytes=self.prop("result_cache_max_bytes"),
+                        approximate=info.approximate,
                     )
         except Exception as e:
             info.state = "FAILED"

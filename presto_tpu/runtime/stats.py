@@ -192,6 +192,12 @@ class QueryInfo:
     #: True when the result was served from the versioned result cache
     #: (no execution happened; node_stats stay empty)
     cache_hit: bool = False
+    #: True when the run probed an APPROXIMATE join sketch (the
+    #: ``approx_join`` session property routed a semi join through the
+    #: Bloom sketch): the result may contain false-positive rows.
+    #: Exact results are NEVER silently degraded — this flag (and the
+    #: EXPLAIN ``strategy=sketch(approx)`` rendering) is the contract
+    approximate: bool = False
     output_rows: int = -1
     node_stats: list = field(default_factory=list)  # list[NodeStats.to_dict()]
 
@@ -246,6 +252,7 @@ class QueryInfo:
                 "memoryQueuedS": round(self.memory_queued_s, 6),
                 "memoryReservedBytes": self.memory_reserved_bytes,
                 "cacheHit": self.cache_hit,
+                "approximate": self.approximate,
                 "outputRows": self.output_rows,
                 "nodeStats": self.node_stats,
             }
