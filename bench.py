@@ -703,6 +703,231 @@ def bench_q3_grouped(extra: dict) -> None:
     extra["tpch_q3_join_probe_grouped_rows_per_sec"] = round(n_li / secs)
 
 
+#: sustained-load template stream: a mixed replay shaped like a small
+#: dashboard workload — scan-heavy aggregation, selective filter-sum,
+#: a join, and a TopN — each with a couple of literal variants so the
+#: stream exercises more than one compiled signature. Literal variants
+#: change plan fingerprints, so with the result cache off every query
+#: really executes (the executable cache serves the compiled steps).
+SUSTAINED_TEMPLATES: "dict[str, list[str]]" = {
+    "agg": [
+        "select l_returnflag, l_linestatus, count(*) c, sum(l_quantity) q"
+        " from lineitem group by l_returnflag, l_linestatus"
+        " order by l_returnflag, l_linestatus",
+    ],
+    "filter_sum": [
+        "select sum(l_extendedprice * l_discount) rev from lineitem"
+        " where l_quantity < 24",
+        "select sum(l_extendedprice * l_discount) rev from lineitem"
+        " where l_quantity < 30",
+    ],
+    "join": [
+        "select o_orderpriority, count(*) c from lineitem"
+        " join orders on l_orderkey = o_orderkey"
+        " where l_quantity < 30 group by o_orderpriority"
+        " order by o_orderpriority",
+    ],
+    "topn": [
+        "select l_orderkey, l_extendedprice from lineitem"
+        " order by l_extendedprice desc, l_orderkey limit 10",
+    ],
+}
+
+
+def _pctl(sorted_vals: list, q: float) -> float:
+    """Exact percentile over a sorted sample (nearest-rank)."""
+    if not sorted_vals:
+        return 0.0
+    return sorted_vals[min(len(sorted_vals) - 1,
+                           int(q * len(sorted_vals)))]
+
+
+def run_sustained_load(n_sessions: int = 3, duration_s: float = 6.0,
+                       seed: int = 0, sf: float = 0.002, conn=None,
+                       chaos: bool = False, templates=None) -> dict:
+    """Sustained concurrent load: ``n_sessions`` sessions sharing ONE
+    MemoryPool, each replaying a seeded mixed TPC-H template stream
+    for ``duration_s`` — the throughput-under-concurrency measurement
+    ROADMAP item 4 calls currently unmeasured. Deterministic per seed
+    (schedules derive from it; the wall clock only bounds the loop).
+
+    Measures and returns queries/sec, p50/p95/p99/max latency,
+    admission-queue time (``memory.queued_s`` delta over the run),
+    and the executable-cache hit rate. The result cache is OFF in the
+    load sessions so every measured query actually executes — the
+    number regresses when the ENGINE slows down, not when a result
+    ring rotates.
+
+    ``chaos=True`` is the chaos-schedule variant: a driver thread
+    replays seeded ``tests/test_chaos.run_chaos_round`` rounds (the
+    tier-1 robustness contract: correct-or-typed, no hangs, no pool
+    leaks) while the load stream runs. The chaos injector is
+    process-global, so load queries fail TYPED when a fault lands in
+    their dispatch — counted, never fatal: the measurement is
+    throughput under the robust-execution posture (PAPERS.md
+    arXiv:2112.02480), not throughput in fair weather.
+    """
+    import random
+    import threading as _th
+    import time as _t
+
+    from presto_tpu.connectors.tpch import TpchConnector
+    from presto_tpu.runtime.errors import PrestoError
+    from presto_tpu.runtime.memory import (
+        DEFAULT_POOL_HEADROOM,
+        MemoryPool,
+        device_budget_bytes,
+    )
+    from presto_tpu.runtime.metrics import REGISTRY
+    from presto_tpu.runtime.session import Session
+
+    if conn is None:
+        conn = TpchConnector(sf=sf)
+    if templates is None:
+        templates = SUSTAINED_TEMPLATES
+    stream = [q for qs in templates.values() for q in qs]
+    pool = MemoryPool(device_budget_bytes() * DEFAULT_POOL_HEADROOM,
+                      name="sustained")
+    props = {"result_cache_enabled": False,
+             "admission_queue_timeout_s": 120.0}
+    sessions = [
+        Session({"tpch": conn}, memory_pool=pool, properties=props)
+        for _ in range(n_sessions)
+    ]
+    # warmup OUTSIDE the clock: compile every template once (the
+    # executable cache is process-wide, so all sessions run warm)
+    for q in stream:
+        sessions[0].sql(q)
+
+    latencies: list = []
+    ok = [0] * n_sessions
+    typed_failed = [0] * n_sessions
+    untyped: list = []
+    lat_lock = _th.Lock()
+    #: re-stamped right before the threads start (chaos setup compiles
+    #: must not eat the measured window); workers read it late-bound
+    deadline = _t.monotonic() + duration_s
+
+    def worker(wid: int):
+        rng = random.Random((seed << 8) + wid)
+        s = sessions[wid]
+        while _t.monotonic() < deadline:
+            q = rng.choice(stream)
+            t0 = _t.perf_counter()
+            try:
+                s.sql(q)
+            except PrestoError:
+                # expected only under chaos: the global injector's
+                # faults land in load dispatches too — typed, counted
+                typed_failed[wid] += 1
+                continue
+            except Exception as e:  # noqa: BLE001 — contract breach
+                untyped.append(f"w{wid}: {type(e).__name__}: {e}")
+                return
+            dt = _t.perf_counter() - t0
+            ok[wid] += 1
+            with lat_lock:
+                latencies.append(dt)
+
+    chaos_outcomes: list = []
+    chaos_thread = None
+    if chaos:
+        # oracle + chaos-query compiles happen BEFORE the clock starts:
+        # the measured window must hold load + chaos rounds, not setup
+        import os as _os
+        import sys as _sys
+
+        _sys.path.insert(0, _os.path.join(
+            _os.path.dirname(_os.path.abspath(__file__)), "tests"))
+        from test_chaos import build_oracle, run_chaos_round
+
+        oracle = build_oracle(conn)
+
+        def chaos_driver():
+            i = 0
+            # >= 1 round always: a smoke-sized duration must still
+            # exercise the chaos interaction it exists to measure
+            while i == 0 or _t.monotonic() < deadline:
+                try:
+                    chaos_outcomes.append(
+                        run_chaos_round(conn, oracle, (seed << 16) + i))
+                except Exception as e:  # noqa: BLE001 — contract breach
+                    untyped.append(
+                        f"chaos seed {i}: {type(e).__name__}: {e}")
+                    return
+                i += 1
+
+        chaos_thread = _th.Thread(target=chaos_driver, daemon=True)
+
+    before = REGISTRY.snapshot()
+    t_start = _t.perf_counter()
+    deadline = _t.monotonic() + duration_s
+    threads = [
+        _th.Thread(target=worker, args=(i,), daemon=True)
+        for i in range(n_sessions)
+    ]
+    if chaos_thread is not None:
+        threads.append(chaos_thread)
+    for t in threads:
+        t.start()
+    for t in threads:
+        # generous join bound: a hung worker must surface as a result,
+        # not hang the bench past the driver's timeout
+        t.join(timeout=max(duration_s * 10, 120.0))
+    hung = any(t.is_alive() for t in threads)
+    wall = _t.perf_counter() - t_start
+    after = REGISTRY.snapshot()
+
+    def delta(name):
+        return after.get(name, 0.0) - before.get(name, 0.0)
+
+    latencies.sort()
+    n_ok = sum(ok)
+    eh, em = delta("exec_cache.hit"), delta("exec_cache.miss")
+    if hung:
+        untyped.append("worker hung past join timeout")
+    out = {
+        "queries_per_sec": round(n_ok / wall, 2) if wall > 0 else 0.0,
+        "queries_ok": n_ok,
+        "queries_typed_failed": sum(typed_failed),
+        "latency_p50_ms": round(_pctl(latencies, 0.50) * 1e3, 2),
+        "latency_p95_ms": round(_pctl(latencies, 0.95) * 1e3, 2),
+        "latency_p99_ms": round(_pctl(latencies, 0.99) * 1e3, 2),
+        "latency_max_ms": round(latencies[-1] * 1e3, 2) if latencies else 0.0,
+        "admission_queued_s": round(delta("memory.queued_s.total"), 4),
+        "cache_hit_rate": round(eh / (eh + em), 4) if eh + em else None,
+        "sessions": n_sessions,
+        "duration_s": round(wall, 2),
+        "chaos": chaos,
+        "pool_drained": pool.reserved_bytes == 0 and not hung,
+        "untyped_failures": untyped,
+    }
+    if chaos:
+        out["chaos_rounds"] = len(chaos_outcomes)
+        out["chaos_ok"] = sum(
+            1 for o in chaos_outcomes if o.startswith("ok:"))
+    return out
+
+
+def bench_sustained_load(extra: dict) -> None:
+    """The sustained-load observability record (first-class ``metrics``
+    entries beside the kernel rates): fair-weather queries/sec + tail
+    latency, then the chaos-schedule variant while budget remains.
+    Regression-gated the same way the kernel numbers are — a PR that
+    tanks concurrent throughput or p99 shows it here."""
+    res = run_sustained_load(n_sessions=3, duration_s=6.0, seed=0,
+                             sf=0.002)
+    assert not res["untyped_failures"], res["untyped_failures"]
+    assert res["pool_drained"], "sustained load leaked pool reservations"
+    extra["sustained_load"] = res
+    if _remaining() > 30:
+        chaos_res = run_sustained_load(n_sessions=2, duration_s=5.0,
+                                       seed=1, sf=0.002, chaos=True)
+        assert not chaos_res["untyped_failures"], \
+            chaos_res["untyped_failures"]
+        extra["sustained_load_chaos"] = chaos_res
+
+
 def bench_shuffle(devices):
     """ICI all_to_all GB/s over the worker mesh (needs >1 device)."""
     import jax
@@ -1096,6 +1321,13 @@ def _run(sf: float, stream_mode: bool) -> None:
                     # cache subsystem hit-rate (tiny SF; a few compiles)
                     _phase("extras: cache cold-vs-warm")
                     bench_cache_warm(extra)
+                if _remaining() > 40:
+                    # sustained concurrent load: queries/sec + tail
+                    # latency under a shared memory pool (+ the chaos
+                    # variant while budget remains) — ROADMAP item 4's
+                    # previously-unmeasured number
+                    _phase("extras: sustained concurrent load")
+                    bench_sustained_load(extra)
                 _phase("extras done")
             except _ExtrasTimeout:
                 extra["note"] = "remaining extras skipped: wall-clock budget exhausted"
@@ -1127,6 +1359,30 @@ def _run(sf: float, stream_mode: bool) -> None:
             "value": extra["tpch_q3_join_probe_grouped_rows_per_sec"],
             "unit": "rows/s",
             "kernel": "grouped(host-spill ladder rung)",
+        })
+    if "sustained_load" in extra:
+        sl = extra["sustained_load"]
+        metrics.append({
+            "metric": "sustained_load_queries_per_sec",
+            "value": sl["queries_per_sec"],
+            "unit": "q/s",
+            "latency_p50_ms": sl["latency_p50_ms"],
+            "latency_p95_ms": sl["latency_p95_ms"],
+            "latency_p99_ms": sl["latency_p99_ms"],
+            "admission_queued_s": sl["admission_queued_s"],
+            "cache_hit_rate": sl["cache_hit_rate"],
+            "sessions": sl["sessions"],
+        })
+    if "sustained_load_chaos" in extra:
+        sl = extra["sustained_load_chaos"]
+        metrics.append({
+            "metric": "sustained_load_chaos_queries_per_sec",
+            "value": sl["queries_per_sec"],
+            "unit": "q/s",
+            "latency_p99_ms": sl["latency_p99_ms"],
+            "chaos_rounds": sl.get("chaos_rounds"),
+            "chaos_ok": sl.get("chaos_ok"),
+            "queries_typed_failed": sl["queries_typed_failed"],
         })
     RESULT["metrics"] = metrics
     if not extra:

@@ -163,6 +163,11 @@ def main(argv=None):
         prog="python -m presto_tpu", description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter,
     )
+    ap.add_argument("command", nargs="?", default=None,
+                    help="optional subcommand: 'metrics' prints the "
+                         "process metrics registry as OpenMetrics/"
+                         "Prometheus text after any -e/-f statements "
+                         "run, then exits")
     ap.add_argument("--catalog", default="tpch",
                     help="tpch | tpcds | ssb (default tpch)")
     ap.add_argument("--sf", type=float, default=0.01,
@@ -192,14 +197,25 @@ def main(argv=None):
     conn = make_connector(args.catalog, args.sf)
     session = Session({args.catalog: conn}, properties=props, mesh=mesh)
 
+    if args.command not in (None, "metrics"):
+        raise SystemExit(f"unknown command {args.command!r} ('metrics')")
+    ran = False
     if args.execute is not None:
         run_statement(session, args.execute, args.max_rows)
-        return
+        ran = True
     if args.file is not None:
         with open(args.file) as f:
             text = f.read()
         for stmt in split_statements(text):
             run_statement(session, stmt, args.max_rows)
+        ran = True
+    if args.command == "metrics":
+        # OpenMetrics exposition of the process registry — the -e/-f
+        # statements above run first, so `python -m presto_tpu metrics
+        # -e "<sql>"` scrapes the metrics that query moved
+        print(session.export_metrics(), end="")
+        return
+    if ran:
         return
     repl(session, args.max_rows)
 

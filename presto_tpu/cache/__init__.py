@@ -29,6 +29,12 @@ Three layers, coarse to fine:
 Plus :mod:`presto_tpu.cache.stats_cache`: cross-query reuse of the
 runtime join-key min/max readbacks (a device round trip per key), the
 promoted form of the per-call ``_minmax_cache`` in ``exec/joinkeys.py``.
+
+And :mod:`presto_tpu.cache.plan_stats`: the fingerprint-keyed
+estimate-vs-actual HISTORY store behind ``system.plan_stats`` — not a
+cache of results but of *observations*, invalidated through the same
+catalog version counters (history about data that changed is as stale
+as a cached result would be).
 """
 
 from presto_tpu.cache.exec_cache import EXEC_CACHE, ExecutableCache
@@ -39,11 +45,13 @@ from presto_tpu.cache.fingerprint import (
     referenced_tables,
     try_fingerprint,
 )
+from presto_tpu.cache.plan_stats import PlanStatsStore
 from presto_tpu.cache.result_cache import ResultCache
 
 __all__ = [
     "EXEC_CACHE",
     "ExecutableCache",
+    "PlanStatsStore",
     "ResultCache",
     "expr_fingerprint",
     "fingerprint",

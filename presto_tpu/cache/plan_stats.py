@@ -1,0 +1,138 @@
+"""Plan-fingerprint-keyed runtime statistics history.
+
+Reference parity: the history-based statistics the reference's CBO
+grows toward (HBO — recording per-plan-node actuals keyed by a
+canonical plan hash, consulted on the next planning of an equal plan)
+[SURVEY §2.1 optimizer row; reference tree unavailable]. This is the
+storage half the adaptive decisions of ROADMAP item 2 need: *"Partial
+Partial Aggregates"* (PAPERS.md) keys its regret-bounded switching on
+observed-vs-predicted cardinalities, which are exactly the records
+kept here.
+
+Each entry maps one ``plan_fingerprint`` to the latest
+estimate-vs-actual rows of a completed run (per node: estimated rows,
+actual rows, measured selectivity, chosen join strategy, misestimate
+ratio — ``StatsRecorder.estimate_vs_actual``), plus a ``runs`` counter
+so recurring plans are distinguishable from one-offs.
+
+Correctness model (the result cache's, reused deliberately):
+
+- the KEY encodes the data: ``plan_fingerprint`` folds every
+  referenced table's catalog version, so after DDL an identical query
+  records under a NEW fingerprint — stale history is never *returned
+  for* the new plan by construction;
+- the stored per-entry version snapshot is still re-checked at read,
+  and the catalog's invalidation listener eagerly drops entries on
+  DDL — ``system.plan_stats`` never shows rows for tables that have
+  changed since the run (defense in depth, same as the result cache);
+- volatile plans (system-table scans) are not recorded: their
+  cardinalities describe engine state, not data.
+
+The store is per-Session (fingerprints embed per-session memory-table
+versions) and bounded LRU by entry count (``plan_stats_limit``).
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Optional
+
+from presto_tpu.runtime.metrics import REGISTRY
+
+
+@dataclass
+class PlanStatsEntry:
+    fingerprint: str
+    query_id: str  # the latest recording run
+    versions: "tuple[tuple[str, int], ...]"  # (table, version) at record
+    #: per-node estimate-vs-actual dicts (StatsRecorder.estimate_vs_actual)
+    records: list = field(default_factory=list)
+    #: completed runs recorded under this fingerprint (records hold the
+    #: LATEST run; runs makes recurrence visible to adaptive consumers)
+    runs: int = 1
+
+
+class PlanStatsStore:
+    def __init__(self, max_entries: int = 512):
+        self.max_entries = max_entries
+        self._entries: "OrderedDict[str, PlanStatsEntry]" = OrderedDict()
+
+    def resize(self, max_entries: int) -> None:
+        """Apply a changed ``plan_stats_limit`` immediately: a shrink
+        evicts oldest entries NOW, not at the next recorded query (the
+        query_history_limit take-effect rule)."""
+        self.max_entries = max_entries
+        while len(self._entries) > self.max_entries:
+            self._entries.popitem(last=False)
+            REGISTRY.counter("plan_stats.evicted").add()
+
+    # ---- record ----------------------------------------------------------
+    def put(self, fp: Optional[str], query_id: str, versions,
+            records: list) -> bool:
+        """Record one completed run's per-node history (latest-wins per
+        fingerprint; ``runs`` accumulates). No-op for unfingerprintable
+        plans or runs that produced no estimate snapshot."""
+        if fp is None or not records:
+            return False
+        prev = self._entries.pop(fp, None)
+        self._entries[fp] = PlanStatsEntry(
+            fp, query_id, tuple(versions), list(records),
+            runs=1 if prev is None else prev.runs + 1,
+        )
+        while len(self._entries) > self.max_entries:
+            self._entries.popitem(last=False)
+            REGISTRY.counter("plan_stats.evicted").add()
+        REGISTRY.counter("plan_stats.recorded").add()
+        return True
+
+    # ---- read ------------------------------------------------------------
+    def get(self, fp: Optional[str],
+            catalog=None) -> Optional[PlanStatsEntry]:
+        """History for one fingerprint; with a ``catalog``, version
+        drift drops the entry (the lazy half of invalidation)."""
+        if fp is None:
+            return None
+        entry = self._entries.get(fp)
+        if entry is None:
+            return None
+        if catalog is not None and any(
+            catalog.version(t) != v for t, v in entry.versions
+        ):
+            self._entries.pop(fp, None)
+            REGISTRY.counter("plan_stats.invalidated").add()
+            return None
+        return entry
+
+    def entries(self, catalog=None) -> "list[PlanStatsEntry]":
+        """Every live entry, oldest first (with a ``catalog``,
+        version-stale entries are dropped on the way out — the
+        ``system.plan_stats`` scan path)."""
+        if catalog is not None:
+            for fp in [
+                fp for fp, e in self._entries.items()
+                if any(catalog.version(t) != v for t, v in e.versions)
+            ]:
+                self._entries.pop(fp, None)
+                REGISTRY.counter("plan_stats.invalidated").add()
+        return list(self._entries.values())
+
+    # ---- invalidation ----------------------------------------------------
+    def invalidate_table(self, table: str) -> None:
+        """Eagerly drop every entry whose run read ``table`` (wired to
+        the catalog's DDL invalidation listeners by the Session, the
+        same hook the result cache rides)."""
+        stale = [
+            fp for fp, e in self._entries.items()
+            if any(t == table for t, _v in e.versions)
+        ]
+        for fp in stale:
+            self._entries.pop(fp, None)
+            REGISTRY.counter("plan_stats.invalidated").add()
+
+    # ---- introspection ---------------------------------------------------
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def clear(self) -> None:
+        self._entries.clear()

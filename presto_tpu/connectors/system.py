@@ -55,6 +55,27 @@ SCHEMAS: dict[str, dict[str, DataType]] = {
         "oom_retries": BIGINT,
         "memory_queued_s": DOUBLE,
         "error_code": fixed_bytes(32),
+        # per-query metric-delta attribution (QueryInfo.attribute_metrics):
+        # before these, strategy/selectivity/rung were only recoverable
+        # from process-GLOBAL counters, useless under concurrency
+        "oom_rung": BIGINT,
+        "join_strategy": fixed_bytes(32),
+        "filter_selectivity": DOUBLE,
+    },
+    # estimate-vs-actual history per plan fingerprint and node
+    # (cache/plan_stats.py; rows carry the LATEST completed run of each
+    # retained fingerprint, version-invalidated on DDL)
+    "plan_stats": {
+        "fingerprint": fixed_bytes(64),
+        "query_id": fixed_bytes(24),
+        "node_id": BIGINT,
+        "node_type": fixed_bytes(24),
+        "est_rows": BIGINT,
+        "actual_rows": BIGINT,
+        "selectivity": DOUBLE,
+        "strategy": fixed_bytes(16),
+        "misest": DOUBLE,
+        "runs": BIGINT,
     },
     # live state of the memory pool this session admits through
     # (runtime/memory.MemoryPool): one row, materialized at scan time
@@ -158,7 +179,30 @@ class SystemConnector:
                 [i.oom_retries for i in infos],
                 [i.memory_queued_s for i in infos],
                 [i.error_code or "" for i in infos],
+                [i.oom_rung for i in infos],
+                [i.join_strategy for i in infos],
+                [i.filter_selectivity for i in infos],
             )
+        if table == "plan_stats":
+            entries = self._session.plan_stats.entries(
+                self._session.catalog)
+            fps, qids, nids, ntypes, ests, acts, sels, strats, mis, runs = (
+                [], [], [], [], [], [], [], [], [], []
+            )
+            for e in entries:
+                for r in e.records:
+                    fps.append(e.fingerprint)
+                    qids.append(e.query_id)
+                    nids.append(r["node_id"])
+                    ntypes.append(r["node_type"])
+                    ests.append(r["est_rows"])
+                    acts.append(r["actual_rows"])
+                    sels.append(r["selectivity"])
+                    strats.append(r["strategy"])
+                    mis.append(r["misest"])
+                    runs.append(e.runs)
+            return (fps, qids, nids, ntypes, ests, acts, sels, strats,
+                    mis, runs)
         if table == "memory_pool":
             pool = self._session.pool()
             snap = pool.snapshot()  # one lock: internally consistent
@@ -226,7 +270,7 @@ class SystemConnector:
         elif table == "query_history":
             (qid, state, sql, tok, queued, planning, execution, elapsed,
              outrows, retries, hits, approx, degraded, oomr, memq,
-             ecode) = rows
+             ecode, rung, jstrat, fsel) = rows
             arrays = {
                 "query_id": _bytes_col(qid, 24),
                 "state": STATE_DICT.encode(state).astype(np.int32),
@@ -244,6 +288,24 @@ class SystemConnector:
                 "oom_retries": np.asarray(oomr, np.int64),
                 "memory_queued_s": np.asarray(memq, np.float64),
                 "error_code": _bytes_col(ecode, 32),
+                "oom_rung": np.asarray(rung, np.int64),
+                "join_strategy": _bytes_col(jstrat, 32),
+                "filter_selectivity": np.asarray(fsel, np.float64),
+            }
+        elif table == "plan_stats":
+            (fps, qids, nids, ntypes, ests, acts, sels, strats, mis,
+             runs) = rows
+            arrays = {
+                "fingerprint": _bytes_col(fps, 64),
+                "query_id": _bytes_col(qids, 24),
+                "node_id": np.asarray(nids, np.int64),
+                "node_type": _bytes_col(ntypes, 24),
+                "est_rows": np.asarray(ests, np.int64),
+                "actual_rows": np.asarray(acts, np.int64),
+                "selectivity": np.asarray(sels, np.float64),
+                "strategy": _bytes_col(strats, 16),
+                "misest": np.asarray(mis, np.float64),
+                "runs": np.asarray(runs, np.int64),
             }
         elif table == "memory_pool":
             name, cap, reserved, free, active, queued = rows

@@ -743,6 +743,16 @@ class DistributedExecutor(OomLadderMixin):
             minmax_memo=self._minmax_memo,
         )
 
+    def _count_distribution(self, name: str) -> None:
+        """Join-distribution decision counter (``join.distribution.*``
+        — the distributed tier's analog of the local executors'
+        ``join.strategy.*``): with per-query metric attribution, the
+        chosen distribution becomes visible on the QueryInfo that made
+        it, not just in the process-global totals."""
+        from presto_tpu.runtime.metrics import REGISTRY
+
+        REGISTRY.counter(f"join.distribution.{name}").add()
+
     def _exec_join(self, node: N.Join, scalars) -> DistBatch:
         left = self._exec(node.left, scalars)
         right = self._exec(node.right, scalars)
@@ -768,6 +778,7 @@ class DistributedExecutor(OomLadderMixin):
             # limit AND <= join budget): skip the live_count device
             # sync and the budget readback entirely (plan/fragmenter.py)
             fault_point("step.join_build")
+            self._count_distribution("broadcast")
             return self._broadcast_join(node, left, right, lkey, rkey,
                                         verify,
                                         rows_hint=info.join_rows_ub.get(
@@ -788,6 +799,7 @@ class DistributedExecutor(OomLadderMixin):
             # is void while this frame still holds them)
             sides = [left, right]
             del left, right
+            self._count_distribution("grouped")
             return self._grouped_dist_join(node, sides, lkey, rkey, est)
         fault_point("step.join_build")
         if (
@@ -795,7 +807,9 @@ class DistributedExecutor(OomLadderMixin):
             or not right.sharded
             or not left.sharded
         ):
+            self._count_distribution("broadcast")
             return self._broadcast_join(node, left, right, lkey, rkey, verify)
+        self._count_distribution("repartition")
         return self._repartition_join(node, left, right, lkey, rkey, verify)
 
     def _concat_sharded(self, d: DistBatch, extra: Batch) -> DistBatch:

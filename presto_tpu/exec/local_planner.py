@@ -54,10 +54,6 @@ DIRECT_LIMIT = 4096
 MAX_GROUP_CAP = 1 << 20
 MAX_RETRIES = 6
 
-#: selectivity histogram buckets (fraction of scan rows KEPT by a
-#: runtime join filter; 1.0 = the filter pruned nothing)
-_SELECTIVITY_BOUNDS = (0.01, 0.05, 0.1, 0.25, 0.5, 0.75, 0.9, 0.99, 1.0)
-
 
 class JoinFilterSlot:
     """One sideways-information-passing edge: join build -> probe scan.
@@ -701,8 +697,10 @@ class LocalExecutor(OomLadderMixin):
                 REGISTRY.counter("join.filter_rows_in").add(n_in)
                 REGISTRY.counter("join.filter_rows_pruned").add(pruned)
                 if n_in:
-                    REGISTRY.histogram("join.filter_selectivity",
-                                       bounds=_SELECTIVITY_BOUNDS).add(
+                    # ratio-shaped buckets resolve from
+                    # metrics.HISTOGRAM_BOUNDS — the per-metric bounds
+                    # registry, not a per-call-site tuple
+                    REGISTRY.histogram("join.filter_selectivity").add(
                         1.0 - pruned / n_in)
 
     def _exec_join(self, node: N.Join, scalars):

@@ -300,6 +300,26 @@ def estimate_rows(node: N.PlanNode, catalog) -> int:
     return 1 << 10
 
 
+def estimate_record(node: N.PlanNode, catalog) -> dict:
+    """The planner's full row prediction for one node — the plan-time
+    half of estimate-vs-actual telemetry (runtime/stats.py snapshots
+    this per node before execution): the selectivity-guessing
+    ``estimate_rows``, the SOUND ``fragmenter.upper_bound_rows`` (None
+    when unprovable), and whether that bound is exact (no predicate
+    below — the proven-broadcast condition). Estimate quality is
+    legible only when both numbers travel together: actual > upper
+    bound means a soundness bug, actual far from est_rows means the
+    selectivity guesses misfired."""
+    from presto_tpu.plan.fragmenter import is_unfiltered, upper_bound_rows
+
+    ub = upper_bound_rows(node, catalog)
+    return {
+        "est_rows": estimate_rows(node, catalog),
+        "upper_bound_rows": ub,
+        "exact": ub is not None and is_unfiltered(node),
+    }
+
+
 def agg_value_bits(agg: N.Aggregate, catalog) -> list[int]:
     """``value_bits`` for each of ``agg.aggs`` (63 when unbounded)."""
     env = node_intervals(agg.child, catalog)

@@ -206,7 +206,16 @@ class FragmentPlan:
 
         out = []
         for f in self.fragments:
-            out.append(f"Fragment {f.fid} [{f.partitioning}]")
+            # the SOUND plan-time row bound per fragment root (the same
+            # number the estimate-vs-actual snapshot records), so the
+            # distributed rendering shows what the fragmenter's
+            # distribution decisions were actually based on
+            bound = ""
+            if self.catalog is not None:
+                ub = upper_bound_rows(f.root, self.catalog)
+                if ub is not None:
+                    bound = f" est<={ub:,} rows"
+            out.append(f"Fragment {f.fid} [{f.partitioning}]{bound}")
             out.extend(tree(f.root, f.fid, 0))
         out.append(
             "(exchanges compile INTO their consumer's shard_map step — a "

@@ -303,8 +303,32 @@ class QueryManager:
         (enforced at the executors' dispatch boundaries via the
         context), the adaptive OOM degradation ladder, and
         distributed->local degradation as the last resort. The pool
-        reservation is released on EVERY terminal state."""
+        reservation is released on EVERY terminal state.
+
+        This is also the per-query metric-attribution choke point: a
+        ``QueryMetricsDelta`` collector rides the context for the whole
+        admission+execution scope, so every process-global counter the
+        run moves (``join.strategy.*``, ``exec.*``, ``memory.*``,
+        cache and exchange stats) is ALSO captured as this query's
+        delta — ``info.metrics`` / ``info.join_strategy`` /
+        ``info.filter_selectivity`` / ``info.oom_rung`` — without any
+        cross-query bleed under concurrency (runtime/metrics.py)."""
+        from presto_tpu.runtime.metrics import (
+            QueryMetricsDelta,
+            install_delta,
+            uninstall_delta,
+        )
+
         pool = self.session.pool()
+        delta = QueryMetricsDelta()
+        delta_token = install_delta(delta)
+        try:
+            return self._run_admitted(executor, plan, info, recorder, pool)
+        finally:
+            uninstall_delta(delta_token)
+            info.attribute_metrics(delta.snapshot())
+
+    def _run_admitted(self, executor, plan, info, recorder, pool):
         try:
             with trace_span("admission", "lifecycle"):
                 self.admit(plan, info, pool)

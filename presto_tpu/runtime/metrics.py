@@ -5,7 +5,10 @@ Reference parity: Airlift's ``@Managed`` JMX beans — ``CounterStat``,
 queryable live through the JMX connector [SURVEY §5.5; reference tree
 unavailable]. Single-process, single-controller: a flat registry of
 named counters/timers/histograms, exposed as the
-``system.runtime_metrics`` table and snapshot-able as JSON.
+``system.runtime_metrics`` table, snapshot-able as JSON, and
+exportable as OpenMetrics/Prometheus text (:func:`to_openmetrics`,
+surfaced by ``Session.export_metrics`` and ``python -m presto_tpu
+metrics``).
 
 Thread safety: event listeners and prefetch workers may bump stats off
 the driver thread, so every ``add`` is atomic under a per-stat lock
@@ -13,6 +16,18 @@ the driver thread, so every ``add`` is atomic under a per-stat lock
 ``DistributionStat`` role on fixed buckets — p50/p95/p99 appear in
 snapshots — and hot timers (query execution, fragment dispatch,
 exchange dispatch, cache lookups) record onto it.
+
+Per-query attribution: the registry is process-global, so a raw
+before/after snapshot diff cannot attribute a counter move to a query
+once queries run concurrently. :class:`QueryMetricsDelta` closes that
+gap at the ``add`` site: the lifecycle layer installs a delta
+collector in a ``ContextVar`` around each query's ``run_plan`` scope,
+and every stat ``add`` ALSO lands in the collector of the context it
+ran under. Concurrent queries on separate driver threads carry
+separate contexts, so their deltas never bleed — the global totals
+stay the union. Adds from threads outside any query context (prefetch
+workers, like trace spans) update only the global stat; attribution is
+driver-thread-observed by design.
 """
 
 from __future__ import annotations
@@ -20,7 +35,53 @@ from __future__ import annotations
 import bisect
 import threading
 import time
+from contextvars import ContextVar
 from dataclasses import dataclass, field
+from typing import Optional
+
+
+_DELTA: ContextVar[Optional["QueryMetricsDelta"]] = ContextVar(
+    "presto_tpu_metrics_delta", default=None
+)
+
+
+class QueryMetricsDelta:
+    """A query-scoped view of every stat moved while this collector was
+    installed (``install_delta``/``uninstall_delta``). Counters land
+    under their plain name; timers under ``name.count``/``name.total_s``;
+    histograms under ``name.count``/``name.total`` — the same key shapes
+    ``MetricsRegistry.snapshot`` uses, so delta dicts and snapshot
+    diffs read identically. Locked: event listeners may add from a
+    thread that inherited the query's context."""
+
+    __slots__ = ("_vals", "_lock")
+
+    def __init__(self):
+        self._vals: dict[str, float] = {}
+        self._lock = threading.Lock()
+
+    def add(self, name: str, v: float) -> None:
+        with self._lock:
+            self._vals[name] = self._vals.get(name, 0.0) + v
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return dict(self._vals)
+
+
+def install_delta(collector: Optional[QueryMetricsDelta]):
+    """Install ``collector`` as the context's delta sink; returns the
+    reset token (nested queries from event listeners install their own
+    and restore the outer one on exit)."""
+    return _DELTA.set(collector)
+
+
+def uninstall_delta(token) -> None:
+    _DELTA.reset(token)
+
+
+def current_delta() -> Optional[QueryMetricsDelta]:
+    return _DELTA.get()
 
 
 @dataclass
@@ -34,6 +95,9 @@ class CounterStat:
     def add(self, v: float = 1.0):
         with self._lock:
             self.total += v
+        d = _DELTA.get()
+        if d is not None:
+            d.add(self.name, v)
 
 
 @dataclass
@@ -56,6 +120,10 @@ class TimeStat:
             self.total_s += seconds
             self.min_s = min(self.min_s, seconds)
             self.max_s = max(self.max_s, seconds)
+        d = _DELTA.get()
+        if d is not None:
+            d.add(self.name + ".count", 1.0)
+            d.add(self.name + ".total_s", seconds)
 
     def time(self):
         return _Timer(self)
@@ -65,6 +133,21 @@ class TimeStat:
 #: quarter-decade steps (wall times of everything from a span append to
 #: a cold distributed compile land inside; the last bucket is +inf)
 DEFAULT_BOUNDS = tuple(10.0 ** (-5 + i * 0.25) for i in range(29))
+
+#: ratio-shaped bounds for fraction metrics (selectivities, hit rates):
+#: values live on [0, 1], where the latency buckets would dump
+#: everything below 1.0 into two cells and destroy the percentiles
+SELECTIVITY_BOUNDS = (0.01, 0.05, 0.1, 0.25, 0.5, 0.75, 0.9, 0.99, 1.0)
+
+#: per-metric bucket shapes — THE place a histogram's boundary choice
+#: lives. ``MetricsRegistry.histogram(name)`` resolves bounds here, so
+#: every call site of a named metric agrees by construction (bounds are
+#: fixed at first creation; a second caller passing different explicit
+#: bounds would silently get the first shape). Latency-shaped
+#: DEFAULT_BOUNDS is the fallback for everything unlisted.
+HISTOGRAM_BOUNDS: dict[str, tuple] = {
+    "join.filter_selectivity": SELECTIVITY_BOUNDS,
+}
 
 
 class HistogramStat:
@@ -96,6 +179,10 @@ class HistogramStat:
             self.total += v
             if v > self.max:
                 self.max = v
+        d = _DELTA.get()
+        if d is not None:
+            d.add(self.name + ".count", 1.0)
+            d.add(self.name + ".total", v)
 
     def time(self):
         return _Timer(self)
@@ -155,9 +242,14 @@ class MetricsRegistry:
             return self.timers[name]
 
     def histogram(self, name: str,
-                  bounds: tuple = DEFAULT_BOUNDS) -> HistogramStat:
+                  bounds: Optional[tuple] = None) -> HistogramStat:
+        """``bounds=None`` resolves the metric's registered shape from
+        ``HISTOGRAM_BOUNDS`` (latency-shaped default) — call sites of a
+        named metric need not, and should not, repeat its boundaries."""
         with self._lock:
             if name not in self.histograms:
+                if bounds is None:
+                    bounds = HISTOGRAM_BOUNDS.get(name, DEFAULT_BOUNDS)
                 self.histograms[name] = HistogramStat(name, bounds)
             return self.histograms[name]
 
@@ -187,3 +279,70 @@ class MetricsRegistry:
 
 #: the process registry (reference: the JMX MBean server)
 REGISTRY = MetricsRegistry()
+
+
+# ---------------------------------------------------------------------------
+# OpenMetrics / Prometheus text exposition
+# ---------------------------------------------------------------------------
+
+#: metric-name prefix in the exposition (the reference's JMX beans map
+#: to a prometheus-jmx namespace the same way)
+EXPOSITION_PREFIX = "presto_tpu_"
+
+
+def _metric_name(name: str) -> str:
+    """Engine metric name -> exposition family name: dots and dashes
+    become underscores (the only characters our names use outside
+    ``[a-zA-Z0-9_]``)."""
+    return EXPOSITION_PREFIX + name.replace(".", "_").replace("-", "_")
+
+
+def _fmt(v: float) -> str:
+    """Canonical sample value: integral floats print as integers
+    (OpenMetrics allows either; stable text diffs nicely)."""
+    f = float(v)
+    return str(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+def to_openmetrics(registry: MetricsRegistry = None) -> str:
+    """The registry as OpenMetrics/Prometheus text exposition.
+
+    - counters -> ``# TYPE f counter`` with one ``f_total`` sample;
+    - timers -> ``# TYPE f_seconds summary`` (``_count``/``_sum``) plus
+      ``f_seconds_min``/``_max`` gauges (TimeStat keeps no quantiles);
+    - histograms -> ``# TYPE f summary`` with ``quantile`` labels
+      (p50/p95/p99 — bucket upper bounds, conservative) plus
+      ``_count``/``_sum`` and an ``f_max`` gauge.
+
+    Families are emitted in sorted name order and the text ends with
+    ``# EOF`` (the OpenMetrics terminator), so the output is both
+    scrape-able and deterministic for golden tests.
+    """
+    reg = REGISTRY if registry is None else registry
+    lines: list[str] = []
+    for c in sorted(reg.counters.values(), key=lambda s: s.name):
+        f = _metric_name(c.name)
+        lines.append(f"# TYPE {f} counter")
+        lines.append(f"{f}_total {_fmt(c.total)}")
+    for t in sorted(reg.timers.values(), key=lambda s: s.name):
+        f = _metric_name(t.name) + "_seconds"
+        lines.append(f"# TYPE {f} summary")
+        lines.append(f"{f}_count {_fmt(t.count)}")
+        lines.append(f"{f}_sum {_fmt(t.total_s)}")
+        if t.count:
+            lines.append(f"# TYPE {f}_min gauge")
+            lines.append(f"{f}_min {_fmt(t.min_s)}")
+            lines.append(f"# TYPE {f}_max gauge")
+            lines.append(f"{f}_max {_fmt(t.max_s)}")
+    for h in sorted(reg.histograms.values(), key=lambda s: s.name):
+        f = _metric_name(h.name)
+        lines.append(f"# TYPE {f} summary")
+        for q in (0.5, 0.95, 0.99):
+            lines.append(f'{f}{{quantile="{q}"}} {_fmt(h.quantile(q))}')
+        lines.append(f"{f}_count {_fmt(h.count)}")
+        lines.append(f"{f}_sum {_fmt(h.total)}")
+        if h.count:
+            lines.append(f"# TYPE {f}_max gauge")
+            lines.append(f"{f}_max {_fmt(h.max)}")
+    lines.append("# EOF")
+    return "\n".join(lines) + "\n"

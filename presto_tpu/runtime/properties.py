@@ -246,6 +246,14 @@ SESSION_PROPERTIES: dict[str, PropertyDef] = {
             _positive,
         ),
         PropertyDef(
+            "plan_stats_limit", int, 512,
+            "Plan fingerprints retained in the session's "
+            "estimate-vs-actual history store (the system.plan_stats "
+            "table; LRU by fingerprint, invalidated on DDL through the "
+            "catalog version listeners).",
+            _positive,
+        ),
+        PropertyDef(
             "profile_annotations", bool, False,
             "Wrap every trace span in a jax.profiler.TraceAnnotation "
             "named '<span>#<trace_token>' so xprof/TensorBoard device "

@@ -102,6 +102,16 @@ class Session:
         self.catalog.add_invalidation_listener(
             self.result_cache.invalidate_table
         )
+        #: estimate-vs-actual history keyed by plan fingerprint
+        #: (cache/plan_stats.py; system.plan_stats) — invalidated
+        #: through the same catalog DDL listeners as the result cache,
+        #: so stale history never survives a version bump
+        from presto_tpu.cache.plan_stats import PlanStatsStore
+
+        self.plan_stats = PlanStatsStore(self.prop("plan_stats_limit"))
+        self.catalog.add_invalidation_listener(
+            self.plan_stats.invalidate_table
+        )
         # every memory-connector write (CTAS store / INSERT commit /
         # DROP) bumps the catalog version even when issued through the
         # Python API rather than SQL DDL — stale metadata or cached
@@ -128,6 +138,11 @@ class Session:
             # the history ring is sized at construction; a changed
             # limit must take effect, not silently keep the old bound
             self.history.resize(self.prop(name))
+        if name == "plan_stats_limit":
+            # like the history ring above: a lowered bound must evict
+            # immediately, not silently keep the old size until the
+            # next recorded query
+            self.plan_stats.resize(self.prop(name))
         if name == "memory_pool_bytes":
             # rebuild the private pool here — not lazily in pool() —
             # so concurrent queries always see exactly one pool
@@ -472,6 +487,20 @@ class Session:
                 self.events.query_cached(info)
                 self.events.query_completed(info)
                 return cached, info
+        if recorder is not None:
+            # snapshot the planner's per-node predictions BEFORE
+            # execution (estimate-vs-actual telemetry: estimated rows,
+            # sound upper bound + exactness, chosen join strategy,
+            # physical widths), keyed by the same stable node ids.
+            # AFTER the cache lookup deliberately: a hit skips
+            # execution entirely, so paying the per-node estimate walk
+            # there would slow exactly the path the cache speeds up
+            with trace.span("plan_estimates", "stats"):
+                recorder.attach_estimates(
+                    plan, self.catalog,
+                    join_build_budget=self.prop("join_build_budget_bytes"),
+                    approx_join=bool(self.prop("approx_join")),
+                )
         executor = self._make_executor()
         executor.recorder = recorder
         try:
@@ -509,10 +538,55 @@ class Session:
                 info.node_stats = [
                     s.to_dict() for s in recorder.nodes.values()
                 ]
+                if info.state == "FINISHED":
+                    self._record_plan_stats(plan, info, recorder, fp)
             self.events.query_completed(info)
         return df, info
 
+    def _record_plan_stats(self, plan, info, recorder, fp) -> None:
+        """Persist the run's estimate-vs-actual records into the
+        fingerprint-keyed history store (system.plan_stats). Reuses the
+        result-cache lookup's fingerprint when one was computed;
+        volatile plans (system-table scans) are never recorded — their
+        cardinalities describe engine state, not data. Best-effort: a
+        recording failure must never fail a FINISHED query."""
+        from presto_tpu.cache.fingerprint import (
+            plan_fingerprint,
+            plan_is_deterministic,
+            table_versions,
+        )
+
+        try:
+            if not recorder.estimates:
+                return
+            if fp is None:
+                if not plan_is_deterministic(plan, self.catalog):
+                    return
+                fp = plan_fingerprint(plan, self.catalog, self.properties,
+                                      self.mesh)
+            with trace.span("plan_stats:record", "stats"):
+                self.plan_stats.put(
+                    fp, info.query_id, table_versions(plan, self.catalog),
+                    recorder.estimate_vs_actual(),
+                )
+        except Exception:  # noqa: BLE001 — observability never fails a query
+            REGISTRY.counter("plan_stats.record_errors").add()
+
     # ------------------------------------------------------------------
+    def export_metrics(self, path: Optional[str] = None) -> str:
+        """The process metrics registry as OpenMetrics/Prometheus text
+        exposition (counters, timers, histogram quantiles — see
+        ``runtime.metrics.to_openmetrics``). Returns the text; with
+        ``path``, also writes it there (the scrape-file shape;
+        ``python -m presto_tpu metrics`` is the CLI surface)."""
+        from presto_tpu.runtime.metrics import to_openmetrics
+
+        text = to_openmetrics()
+        if path is not None:
+            with open(path, "w") as f:
+                f.write(text)
+        return text
+
     def export_trace(self, path: str, query_id: Optional[str] = None) -> str:
         """Write retained span traces as Chrome ``trace_event`` JSON
         (load in Perfetto / chrome://tracing). ``query_id`` narrows the

@@ -63,6 +63,60 @@ class NodeIds:
         return self._ids.get(id(node))
 
 
+#: symmetric misestimate factor at which EXPLAIN ANALYZE flags a node
+#: loudly: estimate and actual disagree by >= this in either direction.
+#: 4x is past any capacity-retry slack the executors absorb silently —
+#: the point where the adaptive decisions (ROADMAP item 2) would have
+#: chosen differently with the truth.
+MISEST_FACTOR = 4.0
+
+
+def misestimate_ratio(est_rows: int, actual_rows: int) -> float:
+    """Symmetric est-vs-actual factor: ``max(actual/est, est/actual)``
+    (always >= 1 when both measured; 0.0 when either side is unknown).
+    ``actual == 0`` reports the estimate itself — predicting N rows and
+    seeing none is an N-fold miss, not a divide-by-zero."""
+    if est_rows is None or est_rows <= 0 or actual_rows < 0:
+        return 0.0
+    if actual_rows == 0:
+        return float(est_rows)
+    return max(actual_rows / est_rows, est_rows / actual_rows)
+
+
+@dataclass
+class NodeEstimate:
+    """Plan-time snapshot of what the planner PREDICTED for one node —
+    frozen before execution so the finalize-time comparison against
+    :class:`NodeStats` actuals can never be contaminated by runtime
+    state (the estimate-vs-actual telemetry's left-hand side)."""
+
+    node_id: int
+    node_type: str
+    #: bounds.estimate_rows — the selectivity-guessing estimate that
+    #: sizes group capacities and admission
+    est_rows: int
+    #: fragmenter.upper_bound_rows — the SOUND bound (None: unprovable)
+    upper_bound_rows: Optional[int] = None
+    #: True when the sound bound is EXACT (no predicate below — the
+    #: fragmenter's proven-broadcast condition)
+    exact: bool = False
+    #: joinfilters.planned_join_strategy for Join/SemiJoin nodes
+    strategy: str = ""
+    #: physical (narrowed) per-row output bytes the planner assumed
+    row_bytes: int = -1
+
+    def to_dict(self):
+        return {
+            "nodeId": self.node_id,
+            "node": self.node_type,
+            "est_rows": self.est_rows,
+            "upper_bound_rows": self.upper_bound_rows,
+            "exact": self.exact,
+            "strategy": self.strategy,
+            "row_bytes": self.row_bytes,
+        }
+
+
 @dataclass
 class NodeStats:
     """Actuals for one plan node (reference: OperatorStats)."""
@@ -76,6 +130,18 @@ class NodeStats:
     output_bytes: int = -1  # live-row payload bytes of the node's output
     device_bytes: int = -1  # peak device-buffer (capacity) bytes observed
     invocations: int = 0
+    #: plan-time predicted rows (copied from NodeEstimate at finalize;
+    #: -1 when no estimate snapshot was taken)
+    est_rows: int = -1
+    #: planner-chosen join strategy for Join/SemiJoin nodes ("" else)
+    strategy: str = ""
+
+    @property
+    def misest(self) -> float:
+        """Symmetric est-vs-actual factor (0.0 when unmeasured)."""
+        if self.est_rows < 0 or self.output_rows < 0:
+            return 0.0
+        return misestimate_ratio(self.est_rows, self.output_rows)
 
     def to_dict(self):
         return {
@@ -88,6 +154,9 @@ class NodeStats:
             "output_bytes": self.output_bytes,
             "device_bytes": self.device_bytes,
             "invocations": self.invocations,
+            "est_rows": self.est_rows,
+            "strategy": self.strategy,
+            "misest": round(self.misest, 3),
         }
 
 
@@ -97,12 +166,58 @@ class StatsRecorder:
     def __init__(self, measure_rows: bool = True):
         self.ids = NodeIds()
         self.nodes: dict[int, NodeStats] = {}
+        #: plan-time estimate snapshot, same node-id key space
+        self.estimates: dict[int, NodeEstimate] = {}
         self.measure_rows = measure_rows
 
     def attach_plan(self, plan) -> None:
         """Pre-assign deterministic pre-order ids for a plan about to
         execute (synthetic nodes dispatched later extend the space)."""
         self.ids.assign(plan)
+
+    def attach_estimates(self, plan, catalog,
+                         join_build_budget: Optional[int] = None,
+                         approx_join: bool = False) -> None:
+        """Snapshot the planner's per-node predictions BEFORE execution,
+        keyed by the same stable node ids the actuals use: estimated
+        rows (bounds.estimate_rows), the sound upper bound + exactness
+        (fragmenter.upper_bound_rows / is_unfiltered), the chosen join
+        strategy (joinfilters.planned_join_strategy), and the physical
+        row width. A per-node stats gap degrades that node's snapshot,
+        never the query (the admission-control posture)."""
+        from presto_tpu.plan import nodes as N
+        from presto_tpu.plan.bounds import estimate_record
+        from presto_tpu.plan.joinfilters import planned_join_strategy
+        from presto_tpu.runtime.memory import node_row_bytes
+
+        def walk(node):
+            nid = self.ids.of(node)
+            est, ub, exact = 1, None, False
+            try:
+                rec = estimate_record(node, catalog)
+                est, ub, exact = (rec["est_rows"],
+                                  rec["upper_bound_rows"], rec["exact"])
+            except Exception:  # noqa: BLE001 — stats gaps never block
+                pass
+            strategy = ""
+            if isinstance(node, (N.Join, N.SemiJoin)):
+                try:
+                    strategy = planned_join_strategy(
+                        node, catalog, join_build_budget=join_build_budget,
+                        approx_join=approx_join)
+                except Exception:  # noqa: BLE001
+                    strategy = ""
+            try:
+                rb = node_row_bytes(node, catalog)
+            except Exception:  # noqa: BLE001
+                rb = -1
+            self.estimates[nid] = NodeEstimate(
+                nid, type(node).__name__, int(est), ub, bool(exact),
+                strategy, rb)
+            for c in node.children:
+                walk(c)
+
+        walk(plan)
 
     def node_id(self, node) -> int:
         return self.ids.of(node)
@@ -117,7 +232,19 @@ class StatsRecorder:
         st.wall_s += wall_s
         st.invocations += 1
         if output_rows >= 0:
-            st.output_rows = output_rows
+            # accumulate like wall_s/output_bytes: a node invoked once
+            # per batch/bucket must report its TOTAL rows, not the last
+            # invocation's (the last-write-wins bug under-reported
+            # multi-batch nodes in EXPLAIN ANALYZE and the finalize
+            # input_rows rollup). Known trade-off shared with the
+            # bytes/wall accumulators: a fragment RETRY re-dispatches
+            # its subtree into the same recorder, so retried queries
+            # over-count (invocations says by how much); OOM-ladder
+            # re-runs don't — the lifecycle clears nodes per rung
+            st.output_rows = (
+                output_rows if st.output_rows < 0
+                else st.output_rows + output_rows
+            )
         if output_bytes >= 0:
             st.output_bytes = (
                 output_bytes if st.output_bytes < 0
@@ -130,9 +257,17 @@ class StatsRecorder:
         nid = self.ids.get(node)
         return None if nid is None else self.nodes.get(nid)
 
+    def estimate_for(self, node) -> Optional[NodeEstimate]:
+        nid = self.ids.get(node)
+        return None if nid is None else self.estimates.get(nid)
+
     def finalize(self, plan) -> None:
         """Derive each node's input_rows from its children's measured
-        output_rows (the Driver->Pipeline rollup direction)."""
+        output_rows (the Driver->Pipeline rollup direction), and close
+        the estimate-vs-actual loop: executed nodes with a plan-time
+        snapshot get ``est_rows``/``strategy`` copied onto their
+        NodeStats so QueryInfo JSON and EXPLAIN ANALYZE carry both
+        sides plus the misestimate ratio."""
 
         def walk(node):
             st = self.stats_for(node)
@@ -149,6 +284,37 @@ class StatsRecorder:
                 walk(c)
 
         walk(plan)
+        for nid, est in self.estimates.items():
+            st = self.nodes.get(nid)
+            if st is not None:
+                st.est_rows = est.est_rows
+                st.strategy = est.strategy
+
+    def estimate_vs_actual(self) -> list:
+        """Per-node (node_id, node_type, est, actual, selectivity,
+        strategy, misest) records — the rows the plan-stats history
+        store persists under the query's plan fingerprint. Selectivity
+        is the node's measured output/input row ratio (-1.0 when either
+        side is unmeasured)."""
+        out = []
+        for nid in sorted(self.estimates):
+            est = self.estimates[nid]
+            st = self.nodes.get(nid)
+            actual = -1 if st is None else st.output_rows
+            sel = -1.0
+            if (st is not None and st.input_rows > 0
+                    and st.output_rows >= 0):
+                sel = st.output_rows / st.input_rows
+            out.append({
+                "node_id": nid,
+                "node_type": est.node_type,
+                "est_rows": est.est_rows,
+                "actual_rows": actual,
+                "selectivity": sel,
+                "strategy": est.strategy,
+                "misest": misestimate_ratio(est.est_rows, actual),
+            })
+        return out
 
 
 @dataclass
@@ -200,6 +366,40 @@ class QueryInfo:
     approximate: bool = False
     output_rows: int = -1
     node_stats: list = field(default_factory=list)  # list[NodeStats.to_dict()]
+    #: per-query metric deltas (runtime/metrics.QueryMetricsDelta
+    #: snapshot captured at the run_plan choke point): every counter /
+    #: timer / histogram the query moved, attributed to THIS query even
+    #: under concurrency — cache hits skip run_plan and stay empty
+    metrics: dict = field(default_factory=dict)
+    #: strategies of the joins this run actually executed (comma-joined
+    #: ``join.strategy.*`` delta names, e.g. "grouped,pallas"; "")
+    join_strategy: str = ""
+    #: mean runtime-join-filter selectivity observed (fraction of probe
+    #: scan rows KEPT; -1.0 when no filter fired)
+    filter_selectivity: float = -1.0
+    #: final OOM-ladder rung the successful attempt ran at, derived
+    #: from the query's own ``query.oom_degraded`` delta (0 = no OOM)
+    oom_rung: int = 0
+
+    def attribute_metrics(self, deltas: dict) -> None:
+        """Fold a per-query metric-delta snapshot into this record:
+        the raw deltas land in ``metrics`` (zero-valued entries
+        dropped), and the derived columns ``system.query_history``
+        exposes — executed join strategies, mean filter selectivity,
+        final OOM rung — are computed here so every consumer (to_json,
+        history table, listeners) reads one attribution."""
+        self.metrics = {k: v for k, v in deltas.items() if v}
+        prefix = "join.strategy."
+        self.join_strategy = ",".join(sorted(
+            k[len(prefix):] for k, v in deltas.items()
+            if k.startswith(prefix) and v > 0
+        ))
+        n = deltas.get("join.filter_selectivity.count", 0.0)
+        self.filter_selectivity = (
+            deltas.get("join.filter_selectivity.total", 0.0) / n
+            if n else -1.0
+        )
+        self.oom_rung = int(deltas.get("query.oom_degraded", 0))
 
     @property
     def queued_s(self) -> float:
@@ -255,6 +455,10 @@ class QueryInfo:
                 "approximate": self.approximate,
                 "outputRows": self.output_rows,
                 "nodeStats": self.node_stats,
+                "metrics": {k: self.metrics[k] for k in sorted(self.metrics)},
+                "joinStrategy": self.join_strategy,
+                "filterSelectivity": round(self.filter_selectivity, 6),
+                "oomRung": self.oom_rung,
             }
         )
 
@@ -272,26 +476,46 @@ def _fmt_bytes(n: int) -> str:
 def render_analyzed_plan(plan, recorder: StatsRecorder,
                          tracer=None) -> str:
     """EXPLAIN ANALYZE rendering: the plan tree annotated with actuals
-    (reference: PlanPrinter.textDistributedPlan with stats), followed
-    by the query's exchange and cache span rollups when a trace
-    recorder is supplied."""
+    (reference: PlanPrinter.textDistributedPlan with stats), the
+    planner's row estimate against what actually happened — ``est
+    E->A (Nx)``, flagged ``MISEST`` past :data:`MISEST_FACTOR` — plus
+    the chosen join strategy, followed by the query's exchange and
+    cache span rollups when a trace recorder is supplied."""
     lines = []
+
+    def est_part(node, st) -> str:
+        est = recorder.estimate_for(node)
+        if est is None:
+            return ""
+        actual = -1 if st is None else st.output_rows
+        if actual < 0:
+            return f", est {est.est_rows:,}->?"
+        ratio = misestimate_ratio(est.est_rows, actual)
+        flag = " MISEST" if ratio >= MISEST_FACTOR else ""
+        return (f", est {est.est_rows:,}->{actual:,} "
+                f"({ratio:.1f}x{flag})")
 
     def walk(node, indent):
         pad = "  " * indent
         name = type(node).__name__
         st = recorder.stats_for(node)
+        est = recorder.estimate_for(node)
+        strat = (f"  strategy={est.strategy}"
+                 if est is not None and est.strategy else "")
         if st is not None:
             rows = "?" if st.output_rows < 0 else f"{st.output_rows:,}"
             in_rows = "?" if st.input_rows < 0 else f"{st.input_rows:,}"
             lines.append(
                 f"{pad}{name}  [wall {st.wall_s * 1e3:.1f}ms, "
-                f"rows {in_rows}->{rows}, "
+                f"rows {in_rows}->{rows}"
+                f"{est_part(node, st)}, "
                 f"bytes {_fmt_bytes(st.output_bytes)}, "
-                f"calls {st.invocations}]"
+                f"calls {st.invocations}]" + strat
             )
         else:
-            lines.append(f"{pad}{name}  [not executed]")
+            lines.append(
+                f"{pad}{name}  [not executed{est_part(node, st)}]" + strat
+            )
         for c in node.children:
             walk(c, indent + 1)
 

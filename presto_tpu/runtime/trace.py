@@ -52,6 +52,7 @@ CATEGORIES = (
     "retry",      # a fragment-retry backoff window
     "lifecycle",  # admission / degradation
     "driver",     # the local driver push loop
+    "stats",      # estimate snapshot / plan-stats history recording
 )
 
 _TRACE: ContextVar[Optional["TraceRecorder"]] = ContextVar(

@@ -27,7 +27,13 @@
 #      return identical rows, the fused Pallas join route must fire
 #      with measured probe-scan pruning, and a warm repeat must
 #      re-trace ZERO steps (ISSUE-7 acceptance).
-#   7. The tier-1 pytest suite on the CPU backend (virtual-device
+#   7. Observability smoke: the OpenMetrics exposition must parse with
+#      known counters present, EXPLAIN ANALYZE on TPC-H Q3 must render
+#      per-node est->actual with misestimate flags, system.plan_stats
+#      must populate after a tracked query and invalidate after DDL,
+#      and the fixed-seed sustained-load smoke must complete with a
+#      drained pool under the no-hang contract (ISSUE-8 acceptance).
+#   8. The tier-1 pytest suite on the CPU backend (virtual-device
 #      distributed tests included; `slow` marks excluded), with the
 #      same flags and timeout the driver uses.
 #
@@ -204,6 +210,79 @@ assert a.equals(b) and a.equals(c), \
 print("join smoke: filters on/off identical, pallas route hit, "
       "%d rows pruned, 0 warm re-traces"
       % int(REGISTRY.snapshot().get("join.filter_rows_pruned", 0)))
+PY
+
+timeout -k 10 420 env JAX_PLATFORMS=cpu JAX_ENABLE_X64=1 python - <<'PY' || exit $?
+# Observability smoke (ISSUE-8 acceptance): estimate-vs-actual
+# telemetry end to end + metrics exposition + the sustained-load
+# harness, all on fixed seeds.
+import re
+import sys
+
+sys.path.insert(0, ".")
+from presto_tpu.connectors.tpch import TpchConnector
+from presto_tpu.runtime.session import Session
+from presto_tpu.connectors.tpch.queries import QUERIES
+
+conn = TpchConnector(sf=0.005)
+s = Session({"tpch": conn}, properties={"result_cache_enabled": False})
+
+# 1) EXPLAIN ANALYZE Q3: every executed node renders `est E->A (Nx)`,
+#    misestimates are flagged, joins carry their chosen strategy
+out = s.explain_analyze(QUERIES["q3"])
+assert re.search(r"est [\d,]+->[\d,]+ \(", out), out
+assert "MISEST" in out, "no misestimate flagged on Q3 (estimates are /3 and /8 guesses — silence means the flag is broken)"
+assert "strategy=" in out, out
+
+# 2) system.plan_stats: fingerprint-keyed history populated by the run
+ps = s.sql("select fingerprint, node_type, est_rows, actual_rows, "
+           "misest from plan_stats")
+assert len(ps) > 0, "plan_stats empty after a tracked query"
+assert ps["fingerprint"].str.len().eq(64).all()
+
+# 3) DDL invalidation: history for a table dropped on its version bump
+s.sql("create table t1obs as select l_orderkey, l_quantity "
+      "from lineitem where l_quantity < 5")
+s.execute("select count(*) c from t1obs")
+n = len(s.plan_stats)
+s.sql("insert into t1obs select l_orderkey, l_quantity "
+      "from lineitem where l_quantity > 49")
+assert len(s.plan_stats) == n - 1, "DDL did not invalidate plan_stats"
+
+# 4) metrics exposition: parses line-by-line, known counters present
+text = s.export_metrics()
+lines = text.splitlines()
+assert lines[-1] == "# EOF"
+sample = re.compile(r'^[a-zA-Z_][a-zA-Z0-9_]*(\{quantile="0\.\d+"\})? '
+                    r'-?\d+(\.\d+)?(e-?\d+)?$')
+names = set()
+for line in lines[:-1]:
+    if line.startswith("# TYPE "):
+        continue
+    assert sample.match(line), f"unparseable exposition line: {line!r}"
+    names.add(line.split("{")[0].split(" ")[0])
+for want in ("presto_tpu_query_completed_total",
+             "presto_tpu_exec_traces_total",
+             "presto_tpu_plan_stats_recorded_total"):
+    assert want in names, f"{want} missing from exposition"
+
+# 5) fixed-seed sustained-load smoke (chaos variant): completes under
+#    the no-hang contract with a drained pool and typed-only failures
+from bench import run_sustained_load
+from presto_tpu.runtime.memory import global_pool
+
+res = run_sustained_load(n_sessions=2, duration_s=2.0, seed=0,
+                         sf=0.002, chaos=True)
+assert res["queries_ok"] > 0, res
+assert res["pool_drained"], "sustained load leaked pool reservations"
+assert not res["untyped_failures"], res["untyped_failures"]
+assert res["chaos_rounds"] >= 1, res
+assert global_pool().reserved_bytes == 0, "global pool reservation leak"
+print("observability smoke: est->actual+MISEST rendered, %d plan_stats "
+      "rows, DDL invalidation ok, exposition %d families, sustained "
+      "load %.1f q/s p99 %.0fms (%d chaos rounds)"
+      % (len(ps), len(names), res["queries_per_sec"],
+         res["latency_p99_ms"], res["chaos_rounds"]))
 PY
 
 rm -f /tmp/_t1.log
