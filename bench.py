@@ -703,6 +703,83 @@ def bench_q3_grouped(extra: dict) -> None:
     extra["tpch_q3_join_probe_grouped_rows_per_sec"] = round(n_li / secs)
 
 
+def bench_leaf_routes(extra: dict) -> None:
+    """Generalized fused-leaf route throughput through the real SQL
+    engine (ISSUE-9): TPC-H Q6 (keyless interval-filter leaf) and SSB
+    Q1.1 (membership-folded date join) via ``exec/leaf_route.py`` —
+    warm wall over the fact-table rows, with the route counter asserted
+    so the number always measures the FUSED path, never a silent
+    fallback. Kernel tag records whether the Pallas family compiled
+    (TPU) or the fused-XLA twin served (identical results either way).
+    Plus the partial-agg-bypass A/B: a near-unique CTAS GROUP BY with
+    the adaptive bypass on vs off — identical rows, both walls
+    recorded, the strategy counters proving which tier ran."""
+    import time as _t
+
+    from presto_tpu.connectors.ssb import SsbConnector
+    from presto_tpu.connectors.tpch import TpchConnector
+    from presto_tpu.connectors.tpch.queries import QUERIES as TQ
+    from presto_tpu.connectors.ssb.queries import QUERIES as SQ
+    from presto_tpu.runtime.metrics import REGISTRY
+    from presto_tpu.runtime.session import Session
+
+    def kernel_tag() -> str:
+        import jax
+
+        from presto_tpu.ops import pallas_agg
+
+        if jax.default_backend() == "tpu" and any(
+                pallas_agg._PROBE.values()):
+            return "leaf_fused(pallas)"
+        return "leaf_fused(xla)"
+
+    def timed_route(session, q, n_rows, key):
+        before = REGISTRY.snapshot().get("exec.leaf_fused_route", 0)
+        session.sql(q)  # cold: compiles
+        t0 = _t.perf_counter()
+        session.sql(q)
+        secs = _t.perf_counter() - t0
+        hits = REGISTRY.snapshot().get("exec.leaf_fused_route", 0) - before
+        assert hits >= 2, f"{key}: leaf fragment did not route ({hits})"
+        extra[key] = round(n_rows / secs)
+
+    sf = 0.01
+    tconn = TpchConnector(sf=sf)
+    sconn = SsbConnector(sf=sf)
+    s = Session({"tpch": tconn, "ssb": sconn},
+                properties={"result_cache_enabled": False})
+    n_li = int(tconn.row_count("lineitem"))
+    n_lo = int(sconn.row_count("lineorder"))
+    timed_route(s, TQ["q6"], n_li, "tpch_q6_rows_per_sec_per_chip")
+    timed_route(s, SQ["q1_1"], n_lo, "ssb_q11_rows_per_sec_per_chip")
+    extra["leaf_route_kernel"] = kernel_tag()
+
+    # ---- partial-agg bypass A/B --------------------------------------
+    s.sql("create table bypass_ab as select l_orderkey * 10 + "
+          "l_linenumber k, l_quantity v from lineitem")
+    q = "select k, sum(v) s, count(*) c from bypass_ab group by k"
+
+    def timed_ab(props, counter):
+        sess = Session({"memory": s.catalog.connector("memory")},
+                       properties={"result_cache_enabled": False, **props})
+        before = REGISTRY.snapshot().get(counter, 0)
+        sess.sql(q)  # cold
+        t0 = _t.perf_counter()
+        df = sess.sql(q)
+        secs = _t.perf_counter() - t0
+        assert REGISTRY.snapshot().get(counter, 0) >= before + 2, \
+            f"bypass A/B: {counter} did not fire"
+        return secs, df.sort_values("k").reset_index(drop=True)
+
+    on_s, a = timed_ab({"partial_agg_bypass": True}, "agg.strategy.bypass")
+    off_s, b = timed_ab({"partial_agg_bypass": False},
+                        "agg.strategy.partial")
+    assert a.equals(b), "agg bypass on/off returned different rows"
+    extra["agg_bypass_ab"] = {"bypass_s": round(on_s, 4),
+                              "partial_s": round(off_s, 4),
+                              "groups": int(len(a))}
+
+
 #: sustained-load template stream: a mixed replay shaped like a small
 #: dashboard workload — scan-heavy aggregation, selective filter-sum,
 #: a join, and a TopN — each with a couple of literal variants so the
@@ -1317,6 +1394,12 @@ def _run(sf: float, stream_mode: bool) -> None:
                         extra["ici_shuffle_gbps"] = round(bench_shuffle(devices), 2)
                     else:
                         extra["note"] = "shuffle skipped: budget exhausted"
+                if _remaining() > 40:
+                    # generalized fused-leaf routes (Q6 + SSB Q1.1) and
+                    # the partial-agg bypass A/B — ROADMAP item 2's
+                    # engine-wide numbers beside the Q1 hero metric
+                    _phase("extras: fused leaf routes + agg-bypass A/B")
+                    bench_leaf_routes(extra)
                 if _remaining() > 15:
                     # cache subsystem hit-rate (tiny SF; a few compiles)
                     _phase("extras: cache cold-vs-warm")
@@ -1360,6 +1443,16 @@ def _run(sf: float, stream_mode: bool) -> None:
             "unit": "rows/s",
             "kernel": "grouped(host-spill ladder rung)",
         })
+    for m in ("tpch_q6_rows_per_sec_per_chip",
+              "ssb_q11_rows_per_sec_per_chip"):
+        if m in extra:
+            metrics.append({
+                "metric": m,
+                "value": extra[m],
+                "unit": "rows/s",
+                "vs_baseline": round(extra[m] / BASELINE_ROWS_PER_SEC, 3),
+                "kernel": extra.get("leaf_route_kernel"),
+            })
     if "sustained_load" in extra:
         sl = extra["sustained_load"]
         metrics.append({

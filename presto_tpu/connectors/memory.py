@@ -235,11 +235,18 @@ class MemoryConnector:
             if t.kind in (TypeKind.INTEGER, TypeKind.BIGINT, TypeKind.DATE):
                 vals = data if valid is None else data[valid]
                 ndv = float(len(np.unique(vals))) if len(vals) else 0.0
+                # honest null_fraction: a stored valid mask means the
+                # column HAS NULLs, and declared NULL-freedom is what
+                # admits fused leaf routes — lying here would turn the
+                # loud-fallback contract into silent wrong answers
+                nf = (0.0 if valid is None or not len(data)
+                      else float(1.0 - len(vals) / len(data)))
                 if len(vals):
                     stats[c] = ColumnStats(ndv, int(vals.min()),
-                                           int(vals.max()))
+                                           int(vals.max()),
+                                           null_fraction=nf)
                 else:
-                    stats[c] = ColumnStats(0.0)
+                    stats[c] = ColumnStats(0.0, null_fraction=nf)
         # the source frame is kept so appends re-infer from original
         # values (no decode round trip, no lossy re-inference)
         self._tables[table] = {

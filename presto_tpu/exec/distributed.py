@@ -238,6 +238,10 @@ class DistributedExecutor(OomLadderMixin):
         self.recorder = None
         #: stable plan-node ids for trace spans without a recorder
         self._trace_ids = None
+        #: adaptive aggregation strategy inputs (see LocalExecutor):
+        #: plan-stats history hints + the partial_agg_bypass switch
+        self.plan_hints: dict = {}
+        self.agg_bypass = True
         #: adaptive OOM degradation ladder rung (exec/ladder.py): rung
         #: 1 forces grouped (bucketed) execution and disables the
         #: plan-time proven-broadcast shortcut; each further rung
@@ -492,6 +496,28 @@ class DistributedExecutor(OomLadderMixin):
         from presto_tpu.exec.operators import NullGroupKeys
         from presto_tpu.ops.groupby import ValueBitsOverflow
         from presto_tpu.plan.bounds import agg_value_bits
+        from presto_tpu.runtime.metrics import REGISTRY
+
+        # leaf-fragment route (exec/leaf_route.py): a matched
+        # scan -> filter -> partial-agg fragment runs as one shard_map'd
+        # fused step + psum — per-device Pallas partials (shard_map
+        # traces per-shard programs, so the kernels fire where GSPMD
+        # jits could not) and a [groups]-sized wire state instead of a
+        # partial/exchange/final round. Same guards as the local tier:
+        # recorder off, rung 0 only (degraded re-runs take the
+        # conservative tiers), value_overflow falls back loudly.
+        if self.recorder is None and self.oom_rung == 0:
+            from presto_tpu.exec import leaf_route as LR
+
+            route, reason = LR.match_leaf_fragment(node, self.catalog)
+            if route is not None:
+                routed = LR.execute_leaf_route_distributed(
+                    route, self, node, scalars)
+                if routed is not None:
+                    REGISTRY.counter("agg.strategy.fused").add()
+                    return DistBatch(routed, sharded=False)
+            elif reason is not None:
+                LR.count_fallback(reason)
 
         d = self._exec(node.child, scalars)
         fault_point("aggregation")
@@ -508,6 +534,7 @@ class DistributedExecutor(OomLadderMixin):
         if not keys and not pax:
             # global agg: jnp reductions over the sharded rows — XLA
             # inserts the cross-device reduce (psum) itself
+            REGISTRY.counter("agg.strategy.single").add()
             op = GlobalAggregationOperator(aggs)
             out = Pipeline(BatchSource([d.batch]), [op]).run()
             return DistBatch(out[0], sharded=False)
@@ -555,10 +582,26 @@ class DistributedExecutor(OomLadderMixin):
 
         est = estimate_node_bytes(node, self.catalog)
         if est > self.join_build_budget or self.oom_rung > 0:
+            REGISTRY.counter("agg.strategy.partial").add()
             return self._grouped_dist_agg(d.batch, keys, aggs, pax, est)
-        return self._dist_grouped_agg(d.batch, keys, aggs, pax)
+        # adaptive bypass (leaf_route.bypass_partial_agg): when group
+        # cardinality ~ input cardinality, the per-device partial
+        # group-sort reduces nothing before the shuffle — stream the
+        # raw rows through the exchange to ONE final aggregation pass
+        bypass = False
+        if self.agg_bypass and self.oom_rung == 0:
+            from presto_tpu.exec.leaf_route import bypass_partial_agg
 
-    def _dist_grouped_agg(self, b: Batch, keys, aggs, pax) -> DistBatch:
+            bypass = bypass_partial_agg(node, self.catalog,
+                                        hints=self.plan_hints)
+        REGISTRY.counter(
+            "agg.strategy.bypass" if bypass else "agg.strategy.partial"
+        ).add()
+        return self._dist_grouped_agg(d.batch, keys, aggs, pax,
+                                      bypass=bypass)
+
+    def _dist_grouped_agg(self, b: Batch, keys, aggs, pax,
+                          bypass: bool = False) -> DistBatch:
         """PARTIAL -> all_to_all(hash(keys)) -> FINAL, one compiled step.
 
         The exchange is the skew-aware multi-round shuffle: the wire
@@ -585,9 +628,9 @@ class DistributedExecutor(OomLadderMixin):
             mgf = mg_final
             step = EXEC_CACHE.get_or_build(
                 EXEC_CACHE.key_of("dist_agg", keys, aggs, pax, mg_partial,
-                                  quota, mgf, self._mesh_fp),
+                                  quota, mgf, self._mesh_fp, bypass),
                 lambda: self._make_agg_step(keys, aggs, pax, mg_partial,
-                                            quota, mgf),
+                                            quota, mgf, bypass=bypass),
             )
             t0 = _time.perf_counter()
             with trace_span("step:dist_agg", "step",
@@ -607,7 +650,8 @@ class DistributedExecutor(OomLadderMixin):
             mg_final *= 2
         raise CapacityOverflow("DistributedAggregate", mg_final)
 
-    def _make_agg_step(self, keys, aggs, pax, mg: int, quota: int, mgf: int):
+    def _make_agg_step(self, keys, aggs, pax, mg: int, quota: int, mgf: int,
+                       bypass: bool = False):
         Pn = self.nworkers
         mesh = self.mesh
         # the step lives in the process-wide executable cache: close
@@ -617,6 +661,42 @@ class DistributedExecutor(OomLadderMixin):
 
         from presto_tpu.cache.exec_cache import trace_probe
         from presto_tpu.exec.operators import null_safe_key
+
+        def bypass_phase(b: Batch):
+            """PARTIAL AGGREGATION BYPASS (*Partial Partial Aggregates*):
+            emit per-ROW 'partials' — each row a singleton group with
+            the same column layout the group-sorted partial phase
+            produces (zero-normalized value + $n merge count per agg) —
+            so the exchange and the final phase are unchanged. No
+            per-device group sort: when groups ~ rows the sort reduced
+            nothing and was pure overhead before the shuffle."""
+            cap = b.capacity
+            ones = jnp.ones(cap, jnp.bool_)
+            cols: dict[str, Column] = {}
+            for (n, e) in keys:
+                v = null_safe_key(evaluate(e, b))
+                cols[n] = Column(v.data, v.valid, e.dtype, v.dictionary)
+            for (n, e) in pax:
+                v = evaluate(e, b)
+                cols[n] = Column(v.data, v.valid, e.dtype, v.dictionary)
+            for a in aggs:
+                dt = _phys_dtype(a)
+                if a.kind == "count_star" or a.input is None:
+                    vals = jnp.ones(cap, dt)
+                    contrib = b.live
+                elif a.kind == "count":
+                    v = evaluate(a.input, b)
+                    vals = jnp.ones(cap, dt)
+                    contrib = b.live & v.valid
+                else:
+                    v = evaluate(a.input, b)
+                    vals = v.data.astype(dt)
+                    contrib = b.live & v.valid
+                cols[a.name] = Column(jnp.where(contrib, vals, 0), ones,
+                                      a.dtype)
+                cols[a.name + "$n"] = Column(contrib.astype(jnp.int64),
+                                             ones, BIGINT)
+            return Batch(cols, b.live), jnp.zeros((), jnp.bool_)
 
         def partial_phase(b: Batch):
             kvals = [null_safe_key(evaluate(e, b)) for _, e in keys]
@@ -701,7 +781,7 @@ class DistributedExecutor(OomLadderMixin):
         )
         def step(b: Batch):
             trace_probe()
-            part, ovf1 = partial_phase(b)
+            part, ovf1 = (bypass_phase(b) if bypass else partial_phase(b))
             key_sort = [c for n, _ in keys for c in _sortables(part[n])]
             pids = partition_ids(key_sort, Pn)
             exch, ovf2, rounds = exchange_multiround(

@@ -193,6 +193,11 @@ class LocalExecutor(OomLadderMixin):
         #: optional StatsRecorder for the current query (set by the
         #: Session; powers QueryInfo node stats and EXPLAIN ANALYZE)
         self.recorder = None
+        #: adaptive aggregation strategy: plan-stats history for this
+        #: plan's fingerprint ({id(plan node): record}, runs >= 2 only;
+        #: set by the Session) + the partial_agg_bypass session switch
+        self.plan_hints: dict = {}
+        self.agg_bypass = True
         #: stable plan-node ids for trace spans when no recorder is
         #: attached (the recorder's NodeIds wins so spans and NodeStats
         #: agree on plan_node_id)
@@ -382,23 +387,30 @@ class LocalExecutor(OomLadderMixin):
         from presto_tpu.ops.groupby import ValueBitsOverflow
         from presto_tpu.plan.bounds import agg_value_bits
 
-        # HandTpchQuery1 parity: a Q1-shaped leaf fragment over
-        # stats-bounded NULL-free columns runs as ONE fused step per
-        # scan batch (the Pallas kernel on TPU) instead of the operator
-        # chain — exec/q1_route.py. Skipped under a stats recorder
-        # (EXPLAIN ANALYZE needs true per-node actuals); a runtime
-        # value_overflow falls back to the generic route below.
-        if self.recorder is None:
-            from presto_tpu.exec.q1_route import (
-                execute_q1_route,
-                match_q1_fragment,
-            )
+        from presto_tpu.runtime.metrics import REGISTRY
 
-            route = match_q1_fragment(node, self.catalog)
+        # Leaf-fragment pattern framework (exec/leaf_route.py): a
+        # scan -> filter -> partial-agg fragment over stats-bounded
+        # NULL-free columns — the generalized Q1 route, including the
+        # strict Q1 matcher as its hand-built specialization — runs as
+        # ONE fused step per scan batch (the parameterized Pallas
+        # kernel family on TPU) instead of the operator chain. Skipped
+        # under a stats recorder (EXPLAIN ANALYZE needs true per-node
+        # actuals) and on OOM-ladder rungs > 0 (degraded re-runs take
+        # the conservative generic tiers — the backstop stays the
+        # backstop); a runtime value_overflow falls back to the generic
+        # route below, loudly (exec.leaf_route_fallback.*).
+        if self.recorder is None and self.oom_rung == 0:
+            from presto_tpu.exec import leaf_route as LR
+
+            route, reason = LR.match_leaf_fragment(node, self.catalog)
             if route is not None:
-                routed = execute_q1_route(route, self.catalog, node.aggs)
+                routed = LR.execute_leaf_route(route, self, node, scalars)
                 if routed is not None:
+                    REGISTRY.counter("agg.strategy.fused").add()
                     return BatchStream.of(routed)
+            elif reason is not None:
+                LR.count_fallback(reason)
 
         child = self._exec(node.child, scalars)
         from presto_tpu.runtime.faults import fault_point
@@ -417,9 +429,28 @@ class LocalExecutor(OomLadderMixin):
         if not keys and not pax:
             from presto_tpu.exec.operators import GlobalAggregationOperator
 
+            REGISTRY.counter("agg.strategy.single").add()
             op = GlobalAggregationOperator(aggs)
             return BatchStream.of(Pipeline(child, [op]).run())
         strategy = self._pick_group_strategy(keys, pax, node, child)
+        if isinstance(strategy, SortStrategy) and self._use_agg_bypass(node):
+            # adaptive bypass (leaf_route.bypass_partial_agg): group
+            # cardinality ~ input cardinality, so per-morsel partial
+            # folds reduce nothing — materialize the (replayable)
+            # child once and aggregate in ONE pass over the concatenated
+            # rows, with the group capacity sized by the TRUE row count
+            # (groups <= rows: overflow is impossible by construction)
+            REGISTRY.counter("agg.strategy.bypass").add()
+            batches = child.materialize()
+            rows = sum(live_count(b) for b in batches)
+            if batches:
+                from presto_tpu.exec.operators import concat_batches
+
+                child = BatchStream.of([concat_batches(batches)])
+            strategy = SortStrategy(
+                min(batch_capacity(max(rows, 16)), MAX_GROUP_CAP))
+        else:
+            REGISTRY.counter("agg.strategy.partial").add()
         fault_point("step.agg")
         for attempt in range(MAX_RETRIES):
             op = HashAggregationOperator(keys, aggs, strategy, passengers=pax)
@@ -445,6 +476,18 @@ class LocalExecutor(OomLadderMixin):
                     raise
                 strategy = SortStrategy(strategy.max_groups * 2)
         raise CapacityOverflow("Aggregate", strategy.max_groups)
+
+    def _use_agg_bypass(self, node: N.Aggregate) -> bool:
+        """The adaptive partial-aggregation bypass decision for one
+        keyed sort-strategy aggregation (estimates seeded, plan-stats
+        history corrected — exec/leaf_route.bypass_partial_agg)."""
+        if not self.agg_bypass or self.oom_rung > 0:
+            # rungs > 0: bypass concentrates the whole input in one
+            # pass — exactly what a degraded re-run must not do
+            return False
+        from presto_tpu.exec.leaf_route import bypass_partial_agg
+
+        return bypass_partial_agg(node, self.catalog, hints=self.plan_hints)
 
     def _pick_group_strategy(self, keys, pax, node: N.Aggregate,
                              child: BatchStream, force_sort: bool = False):

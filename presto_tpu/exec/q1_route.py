@@ -10,6 +10,13 @@ baseline). Stats-driven narrow storage (ISSUE-5) is what makes this
 fire for real queries: the canonical SQL scan now materializes exactly
 the narrow columns the kernel's eligibility check accepts.
 
+Since the leaf-fragment pattern framework landed (exec/leaf_route.py),
+this module is its Q1 *specialization*: ``match_leaf_fragment`` tries
+``match_q1_fragment`` first — the 3-factor ``charge`` product is
+outside the generic 2-term value grammar of ``ops/pallas_agg``, so Q1
+keeps its hand-built kernel (bit-identical, same counters) while Q6 /
+SSB Q1 / CTAS leaves lower through the parameterized family.
+
 Matching is STRICT and stats-guarded: every structural piece of the
 fragment (the shipdate cutoff literal, the ``ep*(1-disc)`` /
 ``ep*(1-disc)*(1+tax)`` product shapes, decimal scales, the 3x2
@@ -286,39 +293,62 @@ def execute_q1_route(route: Q1Route, catalog, aggs) -> Optional[list[Batch]]:
         return None
     cap = batch_capacity(max(s.row_hint for s in splits))
 
-    def _build():
+    def _build(pallas_ok: bool):
+        from presto_tpu.ops.pallas_agg import null_violation
+
         def step(batch: Batch):
             trace_probe()
-            return q1_fused_step(batch)
+            nulls = null_violation(batch)
+            state = q1_fused_step(batch, pallas_ok=pallas_ok)
+            state["value_overflow"] = state["value_overflow"] | nulls
+            return state
 
         return jax.jit(step)
 
-    from presto_tpu.ops.strings import use_pallas
-
-    step = EXEC_CACHE.get_or_build(
-        EXEC_CACHE.key_of("q1_route_step", use_pallas(),
-                          jax.default_backend()),
-        _build,
-    )
     fold = EXEC_CACHE.get_or_build(
         EXEC_CACHE.key_of("q1_route_fold"),
         lambda: jax.jit(combine_q1_states),
     )
     state = None
+    step = None
     for split in splits:
         fault_point("scan")
         check_deadline("scan")
         b = conn.scan(split, src_cols, cap).rename(route.rename)
+        if step is None:
+            # hoisted Pallas decision on the first CONCRETE batch —
+            # pallas_q1.supported's shared-mask identity check breaks
+            # on tracers, so deciding inside the jitted step would
+            # silently pin the route to the XLA twin on TPU
+            from presto_tpu.ops import pallas_q1
+            from presto_tpu.ops.strings import use_pallas
+
+            pallas_ok = (use_pallas() and jax.default_backend() == "tpu"
+                         and pallas_q1.supported(b)
+                         and pallas_q1.probe_supported(cap))
+            step = EXEC_CACHE.get_or_build(
+                EXEC_CACHE.key_of("q1_route_step", pallas_ok,
+                                  jax.default_backend()),
+                lambda: _build(pallas_ok),
+            )
         s = step(b)
         state = s if state is None else fold(state, s)
     if state is None or bool(state["value_overflow"]):
         REGISTRY.counter("exec.q1_route_fallback").add()
         return None
     REGISTRY.counter("exec.q1_fused_route").add()
+    return [decode_q1_state(route, conn, aggs, state)]
 
-    # ---- decode the [6] state into the Aggregate's output batch ------
+
+def decode_q1_state(route: Q1Route, conn, aggs, state) -> Batch:
+    """Decode a combined ``q1_fused_step`` [6]-group state into the
+    Aggregate's output batch (shared by the local split loop above and
+    the distributed leaf route's psum path)."""
+    import jax.numpy as jnp
+
     from presto_tpu.batch import Column
 
+    scan = route.scan
     G = 6
     dicts = conn.dictionaries(scan.table)
     out_to_src = dict(scan.columns)
@@ -341,4 +371,4 @@ def execute_q1_route(route: Q1Route, catalog, aggs) -> Optional[list[Batch]]:
             valid = present
             data = jnp.where(valid, data, 0)
         cols[a.name] = Column(data.astype(a.dtype.jnp_dtype), valid, a.dtype)
-    return [Batch(cols, present)]
+    return Batch(cols, present)

@@ -169,13 +169,32 @@ def _stats_interval(stats, dtype: DataType) -> Interval:
     return stats_physical_interval(stats, dtype)
 
 
-def node_intervals(node: N.PlanNode, catalog) -> dict[str, Interval]:
+def node_intervals(node: N.PlanNode, catalog,
+                   memo: Optional[dict] = None) -> dict[str, Interval]:
     """Per-output-column physical intervals for a plan subtree.
 
     Conservative: anything not provably bounded maps to None. Filters
     pass their child through un-refined (a tighter bound is never
     required for correctness — the runtime guard has the last word).
+
+    ``memo``: optional per-walk cache (keyed on ``id(node)`` — safe
+    only while the caller holds the plan alive, which every walk does).
+    Callers that visit every node of a plan (the estimate snapshot)
+    pass one dict so the walk is linear instead of quadratic; the
+    memoization is pure — identical results with or without it.
     """
+    if memo is not None:
+        hit = memo.get(("iv", id(node)))
+        if hit is not None:
+            return hit
+    out = _node_intervals(node, catalog, memo)
+    if memo is not None:
+        memo[("iv", id(node))] = out
+    return out
+
+
+def _node_intervals(node: N.PlanNode, catalog,
+                    memo: Optional[dict]) -> dict[str, Interval]:
     if isinstance(node, N.TableScan):
         out: dict[str, Interval] = {}
         for (name, src), t in zip(node.columns, node.types):
@@ -184,10 +203,10 @@ def node_intervals(node: N.PlanNode, catalog) -> dict[str, Interval]:
             )
         return out
     if isinstance(node, N.Project):
-        env = node_intervals(node.child, catalog)
+        env = node_intervals(node.child, catalog, memo)
         return {n: expr_interval(e, env) for n, e in node.exprs}
     if isinstance(node, N.Aggregate):
-        env = node_intervals(node.child, catalog)
+        env = node_intervals(node.child, catalog, memo)
         out = {n: expr_interval(e, env) for n, e in node.keys}
         for n, e in node.passengers:
             out[n] = expr_interval(e, env)
@@ -195,8 +214,8 @@ def node_intervals(node: N.PlanNode, catalog) -> dict[str, Interval]:
             out[a.name] = None  # running sums: unbounded without row counts
         return out
     if isinstance(node, (N.Join,)):
-        out = dict(node_intervals(node.left, catalog))
-        right = node_intervals(node.right, catalog)
+        out = dict(node_intervals(node.left, catalog, memo))
+        right = node_intervals(node.right, catalog, memo)
         if node.kind == "left":
             # unmatched probe rows carry the physical fill 0 on build cols
             right = {n: _hull(iv, (0, 0)) for n, iv in right.items()}
@@ -204,7 +223,7 @@ def node_intervals(node: N.PlanNode, catalog) -> dict[str, Interval]:
         return out
     children = node.children
     if len(children) == 1:
-        env = node_intervals(children[0], catalog)
+        env = node_intervals(children[0], catalog, memo)
         return {f.name: env.get(f.name) for f in node.fields}
     if children:
         # first child wins on name collisions: multi-child nodes other
@@ -213,7 +232,7 @@ def node_intervals(node: N.PlanNode, catalog) -> dict[str, Interval]:
         # shadow the left interval
         out = {}
         for c in children:
-            for n, iv in node_intervals(c, catalog).items():
+            for n, iv in node_intervals(c, catalog, memo).items():
                 out.setdefault(n, iv)
         return {f.name: out.get(f.name) for f in node.fields}
     return {f.name: None for f in node.fields}
@@ -267,40 +286,89 @@ def key_dictionary(node: N.PlanNode, name: str, catalog):
     return conn.dictionaries(table).get(col)
 
 
-def estimate_rows(node: N.PlanNode, catalog) -> int:
+def estimate_rows(node: N.PlanNode, catalog,
+                  memo: Optional[dict] = None) -> int:
     """Coarse output-row estimate from connector stats (the
     StatsCalculator role, radically simplified). Used to size sort-
     strategy group capacities and streaming morsel state up front;
     always backed by the capacity-overflow retry loop, so a bad
-    estimate costs a replay, never a wrong answer."""
+    estimate costs a replay, never a wrong answer.
+
+    ``memo``: optional per-walk cache (see :func:`node_intervals`) —
+    pure memoization, identical estimates with or without it."""
+    if memo is not None:
+        hit = memo.get(("rows", id(node)))
+        if hit is not None:
+            return hit
+    out = _estimate_rows(node, catalog, memo)
+    if memo is not None:
+        memo[("rows", id(node))] = out
+    return out
+
+
+def _estimate_rows(node: N.PlanNode, catalog, memo: Optional[dict]) -> int:
     if isinstance(node, N.TableScan):
         conn = catalog.connector(node.connector)
         rows = int(conn.row_count(node.table)) if hasattr(conn, "row_count") else 1 << 16
         return max(1, rows // (3 if node.predicate is not None else 1))
     if isinstance(node, N.Filter):
-        return max(1, estimate_rows(node.child, catalog) // 3)
+        return max(1, estimate_rows(node.child, catalog, memo) // 3)
     if isinstance(node, N.Aggregate):
-        return max(1, estimate_rows(node.child, catalog) // 8)
+        return max(1, estimate_rows(node.child, catalog, memo) // 8)
     if isinstance(node, N.Join):
-        left = estimate_rows(node.left, catalog)
+        left = estimate_rows(node.left, catalog, memo)
         if node.unique:
             return left
-        return max(left, estimate_rows(node.right, catalog))
+        return max(left, estimate_rows(node.right, catalog, memo))
     if isinstance(node, N.SemiJoin):
-        return estimate_rows(node.left, catalog)
+        return estimate_rows(node.left, catalog, memo)
     if isinstance(node, N.TopN):
         return node.count
     if isinstance(node, N.Limit):
         return node.count
     if isinstance(node, N.Union):
-        return sum(estimate_rows(c, catalog) for c in node.inputs)
+        return sum(estimate_rows(c, catalog, memo) for c in node.inputs)
     children = node.children
     if children:
-        return max(estimate_rows(c, catalog) for c in children)
+        return max(estimate_rows(c, catalog, memo) for c in children)
     return 1 << 10
 
 
-def estimate_record(node: N.PlanNode, catalog) -> dict:
+def estimate_groups(node: "N.Aggregate", catalog,
+                    memo: Optional[dict] = None) -> Optional[int]:
+    """NDV-based group-cardinality estimate for a keyed Aggregate, or
+    None when any key's distinct-value count is unknowable from
+    metadata. The product of per-key NDVs (dictionary domain size for
+    VARCHAR keys, connector ``stats.ndv`` for source-traceable numeric
+    keys), clamped by the child's estimated rows — the left-hand side
+    of the partial-aggregation bypass rule (*Partial Partial
+    Aggregates* / *Global Hash Tables Strike Back!*): when groups
+    approach rows, pre-aggregating per morsel reduces nothing."""
+    if not isinstance(node, N.Aggregate) or not node.keys:
+        return None
+    prod = 1
+    for name, e in node.keys:
+        if not isinstance(e, InputRef):
+            return None
+        d = key_dictionary(node.child, name, catalog)
+        if d is not None:
+            prod *= max(len(d), 1)
+            continue
+        src = resolve_source_column(node.child, name)
+        if src is None:
+            return None
+        stats = catalog.stats(*src)
+        ndv = getattr(stats, "ndv", None) if stats is not None else None
+        if not ndv:
+            return None
+        prod *= max(int(ndv), 1)
+        if prod > (1 << 40):  # clamp before the product explodes
+            break
+    return max(1, min(prod, estimate_rows(node.child, catalog, memo)))
+
+
+def estimate_record(node: N.PlanNode, catalog,
+                    memo: Optional[dict] = None) -> dict:
     """The planner's full row prediction for one node — the plan-time
     half of estimate-vs-actual telemetry (runtime/stats.py snapshots
     this per node before execution): the selectivity-guessing
@@ -314,7 +382,7 @@ def estimate_record(node: N.PlanNode, catalog) -> dict:
 
     ub = upper_bound_rows(node, catalog)
     return {
-        "est_rows": estimate_rows(node, catalog),
+        "est_rows": estimate_rows(node, catalog, memo),
         "upper_bound_rows": ub,
         "exact": ub is not None and is_unfiltered(node),
     }

@@ -132,7 +132,8 @@ def filter_edges(plan: N.PlanNode) -> list[tuple[object, N.TableScan, str]]:
 
 def planned_join_strategy(node, catalog,
                           join_build_budget: int | None = None,
-                          approx_join: bool = False) -> str:
+                          approx_join: bool = False,
+                          memo: "dict | None" = None) -> str:
     """The probe strategy the executors will pick for this join, from
     stats alone: grouped (build over budget) > pallas (fused VMEM
     probe) > dense (direct-address table) > unique (sorted probe) >
@@ -144,7 +145,11 @@ def planned_join_strategy(node, catalog,
     join whose exact fused table cannot fit then plans as
     ``sketch(approx)``, rendering the APPROXIMATE mode distinctly in
     EXPLAIN (the other half of the never-silently-approximate
-    contract; QueryInfo.approximate is the runtime half)."""
+    contract; QueryInfo.approximate is the runtime half).
+
+    ``memo``: optional per-walk estimate/interval cache
+    (plan/bounds) — the estimate snapshot passes one dict over the
+    whole plan so its per-join strategy calls stay linear."""
     from presto_tpu.ops import pallas_join
     from presto_tpu.plan.bounds import expr_interval, node_intervals
     from presto_tpu.runtime.memory import (
@@ -155,13 +160,13 @@ def planned_join_strategy(node, catalog,
     if join_build_budget is None:
         join_build_budget = device_budget_bytes() // 4
     semi = isinstance(node, N.SemiJoin)
-    if estimate_node_bytes(node.right, catalog) > join_build_budget \
+    if estimate_node_bytes(node.right, catalog, memo) > join_build_budget \
             and (semi or node.kind != "full"):
         return "grouped"
     iv = None
     if len(node.right_keys) == 1:
         iv = expr_interval(node.right_keys[0],
-                           node_intervals(node.right, catalog))
+                           node_intervals(node.right, catalog, memo))
     unique = True if semi else node.unique
     if iv is not None and pallas_join.interval_ok(iv[0], iv[1]):
         domain = iv[1] - iv[0] + 1

@@ -320,17 +320,22 @@ def scan_physical_types(node: "TableScan", catalog) -> dict:
 
 
 def plan_tree_str(node: PlanNode, indent: int = 0, catalog=None,
-                  _filters=None, approx_join: bool = False) -> str:
+                  _filters=None, approx_join: bool = False,
+                  plan_hints=None, agg_bypass: bool = True) -> str:
     """EXPLAIN-style rendering (reference: PlanPrinter). With a
     ``catalog``, scan columns render their chosen PHYSICAL storage
     (``l_shipdate:date:int16``), joins render the stats-planned probe
-    strategy (``strategy=pallas|dense|unique|expand|grouped``), and
-    probe-side scans render the runtime join filters that will be
-    pushed into them (``runtime_filter=[l_orderkey]``) — the sideways
-    information passing placement, visible before execution. With
-    ``approx_join`` (the session property), semi joins that would
-    probe the Bloom sketch render ``strategy=sketch(approx)`` — the
-    APPROXIMATE mode is never silent in EXPLAIN."""
+    strategy (``strategy=pallas|dense|unique|expand|grouped``),
+    aggregates render the adaptive aggregation strategy
+    (``agg_strategy=fused|bypass|partial|single`` — exec/leaf_route.py,
+    fed by ``plan_hints``: plan-stats history records for a recurring
+    fingerprint, keyed by ``id(plan node)``), and probe-side scans
+    render the runtime join filters that will be pushed into them
+    (``runtime_filter=[l_orderkey]``) — the sideways information
+    passing placement, visible before execution. With ``approx_join``
+    (the session property), semi joins that would probe the Bloom
+    sketch render ``strategy=sketch(approx)`` — the APPROXIMATE mode
+    is never silent in EXPLAIN."""
     if _filters is None and catalog is not None:
         from presto_tpu.plan.joinfilters import filter_edges
 
@@ -353,6 +358,16 @@ def plan_tree_str(node: PlanNode, indent: int = 0, catalog=None,
                   f" -> {cols}{rfs}")
     elif isinstance(node, Aggregate):
         detail = f" keys={[n for n, _ in node.keys]} aggs={[a.name for a in node.aggs]}"
+        if catalog is not None:
+            try:
+                from presto_tpu.exec.leaf_route import agg_strategy_for
+
+                s = agg_strategy_for(node, catalog, hints=plan_hints,
+                                     bypass_enabled=agg_bypass)
+            except Exception:  # noqa: BLE001 — EXPLAIN renders partial plans
+                s = ""
+            if s:
+                detail += f" agg_strategy={s}"
     elif isinstance(node, (Join,)):
         detail = f" {node.kind}{' unique' if node.unique else ''}"
         detail += _strategy_str(node, catalog, approx_join)
@@ -372,7 +387,8 @@ def plan_tree_str(node: PlanNode, indent: int = 0, catalog=None,
     out = f"{pad}{name}{detail}\n"
     for c in node.children:
         out += plan_tree_str(c, indent + 1, catalog=catalog,
-                             _filters=_filters or {}, approx_join=approx_join)
+                             _filters=_filters or {}, approx_join=approx_join,
+                             plan_hints=plan_hints, agg_bypass=agg_bypass)
     return out
 
 

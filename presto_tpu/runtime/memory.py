@@ -289,10 +289,11 @@ def _physical_field_type(node, name: str, dtype: DataType, catalog) -> DataType:
         return dtype
 
 
-def estimate_node_bytes(node: N.PlanNode, catalog) -> int:
+def estimate_node_bytes(node: N.PlanNode, catalog, memo=None) -> int:
     """Estimated device-resident bytes if the node's output were fully
     materialized (stats-based, physical-width-aware; the
-    grouped-execution trigger)."""
+    grouped-execution trigger). ``memo``: optional per-walk estimate
+    cache (plan/bounds.estimate_rows)."""
     from presto_tpu.plan.bounds import estimate_rows
 
-    return estimate_rows(node, catalog) * node_row_bytes(node, catalog)
+    return estimate_rows(node, catalog, memo) * node_row_bytes(node, catalog)

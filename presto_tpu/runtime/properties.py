@@ -110,6 +110,17 @@ SESSION_PROPERTIES: dict[str, PropertyDef] = {
             _positive,
         ),
         PropertyDef(
+            "partial_agg_bypass", bool, True,
+            "Adaptive aggregation strategy: bypass per-morsel partial "
+            "aggregation (stream rows straight to one final aggregation "
+            "pass) when the estimated — or plan-stats-observed — group "
+            "cardinality approaches the input cardinality. Identical "
+            "results for integer/decimal aggregates (exact arithmetic); "
+            "floating-point sums agree to rounding (the one-pass shape "
+            "changes summation order). Off pins keyed aggregations to "
+            "agg_strategy=partial.",
+        ),
+        PropertyDef(
             "collect_node_stats", bool, False,
             "Record per-plan-node wall time and output rows on every "
             "query (the EXPLAIN ANALYZE recorder, always on).",

@@ -259,8 +259,11 @@ class Session:
         return prune(logical)
 
     def explain(self, sql: str) -> str:
-        return plan_tree_str(self.plan(sql), catalog=self.catalog,
-                             approx_join=bool(self.prop("approx_join")))
+        plan = self.plan(sql)
+        return plan_tree_str(plan, catalog=self.catalog,
+                             approx_join=bool(self.prop("approx_join")),
+                             plan_hints=self._plan_hints(plan),
+                             agg_bypass=bool(self.prop("partial_agg_bypass")))
 
     def explain_distributed(self, sql: str) -> str:
         """Fragment/exchange rendering (reference: EXPLAIN (TYPE
@@ -487,10 +490,14 @@ class Session:
                 self.events.query_cached(info)
                 self.events.query_completed(info)
                 return cached, info
+        # plan-stats history hints for recurring fingerprints (runs>=2):
+        # the adaptive aggregation-strategy inputs, shared by the
+        # estimate snapshot, EXPLAIN, and the executors
+        hints = self._plan_hints(plan, fp)
         if recorder is not None:
             # snapshot the planner's per-node predictions BEFORE
             # execution (estimate-vs-actual telemetry: estimated rows,
-            # sound upper bound + exactness, chosen join strategy,
+            # sound upper bound + exactness, chosen join/agg strategy,
             # physical widths), keyed by the same stable node ids.
             # AFTER the cache lookup deliberately: a hit skips
             # execution entirely, so paying the per-node estimate walk
@@ -500,9 +507,24 @@ class Session:
                     plan, self.catalog,
                     join_build_budget=self.prop("join_build_budget_bytes"),
                     approx_join=bool(self.prop("approx_join")),
+                    plan_hints=hints,
+                    agg_bypass=bool(self.prop("partial_agg_bypass")),
                 )
         executor = self._make_executor()
         executor.recorder = recorder
+        executor.plan_hints = hints
+        executor.agg_bypass = bool(self.prop("partial_agg_bypass"))
+        # counters bumped AFTER run_plan returns (query.completed,
+        # result-cache populate, plan-stats record, completion events)
+        # land in an explicit ``post_run.`` metric bucket — closing the
+        # attribution gap run_plan's delta scope cannot see
+        from presto_tpu.runtime.metrics import (
+            QueryMetricsDelta,
+            install_delta,
+            uninstall_delta,
+        )
+
+        post = QueryMetricsDelta()
         try:
             # the query.execution_s histogram is timed inside run_plan
             # AFTER admission, so pool queue wait lands in queued_s /
@@ -510,38 +532,98 @@ class Session:
             with self._profiled():
                 df = self.query_manager.run_plan(executor, plan, info,
                                                  recorder)
-            info.state = "FINISHED"
-            info.output_rows = len(df)
-            REGISTRY.counter("query.completed").add()
-            # fp is only non-None when admission passed at lookup, and
-            # nothing in this synchronous path can change admissibility
-            if fp is not None:
-                with trace.span("result_cache:populate", "cache"):
-                    self.result_cache.put(
-                        fp, df, table_versions(plan, self.catalog),
-                        max_bytes=self.prop("result_cache_max_bytes"),
-                        approximate=info.approximate,
-                    )
+            token = install_delta(post)
+            try:
+                info.state = "FINISHED"
+                info.output_rows = len(df)
+                REGISTRY.counter("query.completed").add()
+                # fp is only non-None when admission passed at lookup,
+                # and nothing in this synchronous path can change
+                # admissibility
+                if fp is not None:
+                    with trace.span("result_cache:populate", "cache"):
+                        self.result_cache.put(
+                            fp, df, table_versions(plan, self.catalog),
+                            max_bytes=self.prop("result_cache_max_bytes"),
+                            approximate=info.approximate,
+                        )
+            finally:
+                uninstall_delta(token)
         except Exception as e:
             info.state = "FAILED"
             info.error = f"{type(e).__name__}: {e}"
             info.error_code = error_code(e)
             info.retryable = is_retryable(e)
-            REGISTRY.counter("query.failed").add()
-            self.events.query_failed(info)
+            token = install_delta(post)
+            try:
+                REGISTRY.counter("query.failed").add()
+                self.events.query_failed(info)
+            finally:
+                uninstall_delta(token)
             raise
         finally:
             info.finished_at = time.time()
             info.finished_mono = time.monotonic()
-            if recorder is not None:
-                recorder.finalize(plan)
-                info.node_stats = [
-                    s.to_dict() for s in recorder.nodes.values()
-                ]
-                if info.state == "FINISHED":
-                    self._record_plan_stats(plan, info, recorder, fp)
-            self.events.query_completed(info)
+            token = install_delta(post)
+            try:
+                if recorder is not None:
+                    recorder.finalize(plan)
+                    info.node_stats = [
+                        s.to_dict() for s in recorder.nodes.values()
+                    ]
+                    if info.state == "FINISHED":
+                        self._record_plan_stats(plan, info, recorder, fp)
+                self.events.query_completed(info)
+            finally:
+                uninstall_delta(token)
+            for k, v in post.snapshot().items():
+                if v:
+                    info.metrics["post_run." + k] = v
         return df, info
+
+    def _plan_hints(self, plan, fp=None) -> dict:
+        """Plan-stats history for this plan, keyed by the LIVE plan
+        nodes: ``{id(node): estimate-vs-actual record}`` when the
+        plan's fingerprint has recurred (``runs >= 2``), else empty.
+        Record node_ids are the recorder's pre-order ids
+        (``NodeIds.assign``), so a fresh pre-order walk of the
+        shape-identical plan maps them back onto nodes. Best-effort:
+        hints are advisory inputs to the adaptive aggregation strategy
+        — a failure here must never fail (or even slow) a query."""
+        try:
+            if len(self.plan_stats) == 0:
+                return {}
+            from presto_tpu.cache.fingerprint import (
+                plan_fingerprint,
+                plan_is_deterministic,
+            )
+
+            if fp is None:
+                if not plan_is_deterministic(plan, self.catalog):
+                    return {}
+                fp = plan_fingerprint(plan, self.catalog, self.properties,
+                                      self.mesh)
+            entry = self.plan_stats.get(fp, self.catalog)
+            if entry is None or entry.runs < 2:
+                return {}
+            from presto_tpu.runtime.stats import NodeIds
+
+            ids = NodeIds()
+            ids.assign(plan)
+            by_id = {}
+
+            def walk(n):
+                by_id[ids.of(n)] = n
+                for c in n.children:
+                    walk(c)
+
+            walk(plan)
+            return {
+                id(by_id[r["node_id"]]): r
+                for r in entry.records if r["node_id"] in by_id
+            }
+        except Exception:  # noqa: BLE001 — advisory only
+            return {}
 
     def _record_plan_stats(self, plan, info, recorder, fp) -> None:
         """Persist the run's estimate-vs-actual records into the

@@ -177,24 +177,35 @@ class StatsRecorder:
 
     def attach_estimates(self, plan, catalog,
                          join_build_budget: Optional[int] = None,
-                         approx_join: bool = False) -> None:
+                         approx_join: bool = False,
+                         plan_hints: Optional[dict] = None,
+                         agg_bypass: bool = True) -> None:
         """Snapshot the planner's per-node predictions BEFORE execution,
         keyed by the same stable node ids the actuals use: estimated
         rows (bounds.estimate_rows), the sound upper bound + exactness
         (fragmenter.upper_bound_rows / is_unfiltered), the chosen join
-        strategy (joinfilters.planned_join_strategy), and the physical
-        row width. A per-node stats gap degrades that node's snapshot,
-        never the query (the admission-control posture)."""
+        strategy (joinfilters.planned_join_strategy) or aggregation
+        strategy (leaf_route.agg_strategy_for, fed by ``plan_hints`` —
+        plan-stats history for recurring fingerprints), and the
+        physical row width. A per-node stats gap degrades that node's
+        snapshot, never the query (the admission-control posture).
+
+        One ``memo`` dict rides the whole walk: ``estimate_rows`` /
+        ``node_intervals`` are memoized per node id, so the snapshot is
+        linear in plan size instead of quadratic (pure memoization —
+        every rendered estimate is unchanged)."""
         from presto_tpu.plan import nodes as N
         from presto_tpu.plan.bounds import estimate_record
         from presto_tpu.plan.joinfilters import planned_join_strategy
         from presto_tpu.runtime.memory import node_row_bytes
 
+        memo: dict = {}
+
         def walk(node):
             nid = self.ids.of(node)
             est, ub, exact = 1, None, False
             try:
-                rec = estimate_record(node, catalog)
+                rec = estimate_record(node, catalog, memo=memo)
                 est, ub, exact = (rec["est_rows"],
                                   rec["upper_bound_rows"], rec["exact"])
             except Exception:  # noqa: BLE001 — stats gaps never block
@@ -204,7 +215,20 @@ class StatsRecorder:
                 try:
                     strategy = planned_join_strategy(
                         node, catalog, join_build_budget=join_build_budget,
-                        approx_join=approx_join)
+                        approx_join=approx_join, memo=memo)
+                except Exception:  # noqa: BLE001
+                    strategy = ""
+            elif isinstance(node, N.Aggregate):
+                try:
+                    from presto_tpu.exec.leaf_route import agg_strategy_for
+
+                    # fused_enabled=False: recorder runs take the
+                    # generic tiers (the executors skip the leaf route
+                    # so per-node actuals stay true), so the snapshot
+                    # records the strategy THIS run uses
+                    strategy = agg_strategy_for(
+                        node, catalog, hints=plan_hints, memo=memo,
+                        bypass_enabled=agg_bypass, fused_enabled=False)
                 except Exception:  # noqa: BLE001
                     strategy = ""
             try:

@@ -100,7 +100,7 @@ def q1_pipeline(conn: TpchConnector):
 # ---------------------------------------------------------------------------
 
 
-def q1_fused_step(batch: Batch):
+def q1_fused_step(batch: Batch, pallas_ok: bool | None = None):
     """One fully-fused Q1 partial-aggregation step over a batch.
 
     Returns a dict of [6]-arrays: sums per (returnflag x linestatus)
@@ -109,13 +109,20 @@ def q1_fused_step(batch: Batch):
     pass over the data (the MXU one-hot segment-sum), replacing the
     G x lanes masked-reduction passes of round 2. ``value_overflow``
     guards the declared Q1_BITS bounds at runtime.
+
+    ``pallas_ok``: hoisted Pallas decision. Callers tracing this step
+    inside jit/shard_map MUST pass it — ``pallas_q1.supported``'s
+    shared-mask identity check is only sound on concrete batches
+    (pytree flattening gives distinct tracers in-trace).
     """
     from presto_tpu.ops import pallas_q1
     from presto_tpu.ops.strings import use_pallas
 
-    if (use_pallas() and jax.default_backend() == "tpu"
-            and pallas_q1.supported(batch)
-            and pallas_q1.probe_supported(batch.capacity)):
+    if pallas_ok is None:
+        pallas_ok = (use_pallas() and jax.default_backend() == "tpu"
+                     and pallas_q1.supported(batch)
+                     and pallas_q1.probe_supported(batch.capacity))
+    if pallas_ok:
         # HandTpchQuery1 fast path: the whole fragment as one Pallas
         # pass (predicate, gid, decimals, lane split, segment sums in
         # VMEM — ops/pallas_q1.py). Narrow-storage TPU batches only;

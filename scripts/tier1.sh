@@ -33,7 +33,13 @@
 #      must populate after a tracked query and invalidate after DDL,
 #      and the fixed-seed sustained-load smoke must complete with a
 #      drained pool under the no-hang contract (ISSUE-8 acceptance).
-#   8. The tier-1 pytest suite on the CPU backend (virtual-device
+#   8. Leaf-route smoke: the generalized fused-leaf framework must
+#      route SQL-path TPC-H Q6 AND an SSB Q1-flight leaf (membership
+#      join folded) with rows identical to the generic route and ZERO
+#      warm re-traces, and the adaptive partial-aggregation bypass
+#      must trigger on a high-cardinality synthetic GROUP BY and be
+#      recorded in system.plan_stats (ISSUE-9 acceptance).
+#   9. The tier-1 pytest suite on the CPU backend (virtual-device
 #      distributed tests included; `slow` marks excluded), with the
 #      same flags and timeout the driver uses.
 #
@@ -283,6 +289,65 @@ print("observability smoke: est->actual+MISEST rendered, %d plan_stats "
       "load %.1f q/s p99 %.0fms (%d chaos rounds)"
       % (len(ps), len(names), res["queries_per_sec"],
          res["latency_p99_ms"], res["chaos_rounds"]))
+PY
+
+timeout -k 10 300 env JAX_PLATFORMS=cpu JAX_ENABLE_X64=1 python - <<'PY' || exit $?
+# Leaf-route smoke (ISSUE-9 acceptance): generalized fused-leaf route
+# on Q6 + SSB Q1.1, on/off identical rows, 0 warm re-traces, adaptive
+# partial-agg bypass on a high-cardinality GROUP BY recorded in
+# system.plan_stats. Env left exactly as found (narrowing discipline).
+import os
+import sys
+
+sys.path.insert(0, ".")
+os.environ.pop("PRESTO_TPU_NARROW", None)
+from presto_tpu.connectors.ssb import SsbConnector
+from presto_tpu.connectors.ssb.queries import QUERIES as SSB
+from presto_tpu.connectors.tpch import TpchConnector
+from presto_tpu.connectors.tpch.queries import QUERIES as TPCH
+from presto_tpu.runtime.metrics import REGISTRY
+from presto_tpu.runtime.session import Session
+
+tconn = TpchConnector(sf=0.005)
+sconn = SsbConnector(sf=0.005)
+s_on = Session({"tpch": tconn, "ssb": sconn},
+               properties={"result_cache_enabled": False})
+s_off = Session({"tpch": tconn, "ssb": sconn},
+                properties={"result_cache_enabled": False,
+                            "narrow_storage": False})
+routed = 0
+for q in (TPCH["q6"], SSB["q1_1"]):
+    before = REGISTRY.snapshot().get("exec.leaf_fused_route", 0)
+    a = s_on.sql(q)
+    hits = REGISTRY.snapshot().get("exec.leaf_fused_route", 0) - before
+    assert hits == 1, f"leaf fragment did not route (hits={hits})"
+    routed += hits
+    t0 = REGISTRY.snapshot().get("exec.traces", 0)
+    b = s_on.sql(q)
+    t1 = REGISTRY.snapshot().get("exec.traces", 0)
+    assert t1 == t0, f"warm leaf-route repeat re-traced ({t1 - t0})"
+    c = s_off.sql(q)
+    os.environ.pop("PRESTO_TPU_NARROW", None)
+    assert a.equals(b) and a.equals(c), "leaf route on/off results differ"
+# adaptive bypass: near-unique key (exact NDV from the memory
+# connector's store-time stats) -> agg_strategy=bypass, visible in
+# EXPLAIN, counted, and recorded in system.plan_stats
+s_on.sql("create table t9leaf as select l_orderkey * 10 + l_linenumber k,"
+         " l_quantity v from lineitem")
+bq = "select k, sum(v) s, count(*) c from t9leaf group by k"
+before = REGISTRY.snapshot().get("agg.strategy.bypass", 0)
+s_on.execute(bq)
+assert REGISTRY.snapshot().get("agg.strategy.bypass", 0) == before + 1, \
+    "high-cardinality GROUP BY did not bypass partial aggregation"
+assert "agg_strategy=bypass" in s_on.explain(bq)
+ps = s_on.sql("select node_type, strategy from plan_stats"
+              " where strategy = 'bypass'")
+assert len(ps) >= 1, "bypass strategy not recorded in system.plan_stats"
+fb = {k: v for k, v in REGISTRY.snapshot().items()
+      if k.startswith("exec.leaf_route_fallback")}
+print("leaf-route smoke: %d fragments routed (q6 + ssb q1_1), on/off "
+      "identical, 0 warm re-traces, bypass recorded in plan_stats, "
+      "fallbacks=%s" % (routed, fb or "{}"))
 PY
 
 rm -f /tmp/_t1.log

@@ -143,3 +143,67 @@ def test_value_bits_violation_retries_correctly(session):
         "group by l_returnflag order by l_returnflag"
     )
     assert got.equals(want)
+
+
+# ---------------------------------------------------------------------------
+# per-walk memoization (ISSUE-9 satellite): pure — identical results,
+# linear instead of quadratic estimate walks
+# ---------------------------------------------------------------------------
+
+
+def test_estimate_memo_is_pure(session):
+    from presto_tpu.connectors.tpch.queries import QUERIES
+    from presto_tpu.plan.bounds import estimate_record, estimate_rows
+
+    plan = session.plan(QUERIES["q3"])
+    memo: dict = {}
+
+    def walk(n):
+        assert estimate_rows(n, session.catalog, memo) == estimate_rows(
+            n, session.catalog)
+        assert node_intervals(n, session.catalog, memo) == node_intervals(
+            n, session.catalog)
+        assert estimate_record(n, session.catalog, memo=memo) == \
+            estimate_record(n, session.catalog)
+        for c in n.children:
+            walk(c)
+
+    walk(plan)
+    assert memo  # the walk actually populated (and reused) the memo
+
+
+def test_estimate_memo_hits_shared_subtrees(session):
+    from presto_tpu.connectors.tpch.queries import QUERIES
+    from presto_tpu.plan.bounds import estimate_rows
+
+    plan = session.plan(QUERIES["q3"])
+    memo: dict = {}
+    estimate_rows(plan, session.catalog, memo)
+    n_entries = len([k for k in memo if k[0] == "rows"])
+    # a second full-tree call is answered entirely from the memo
+    estimate_rows(plan, session.catalog, memo)
+    assert len([k for k in memo if k[0] == "rows"]) == n_entries
+
+
+def test_estimate_groups_from_ndv(session):
+    from presto_tpu.plan.bounds import estimate_groups
+    from presto_tpu.plan import nodes as N
+
+    plan = session.plan(
+        "select l_orderkey, count(*) c from lineitem group by l_orderkey")
+
+    def find_agg(n):
+        if isinstance(n, N.Aggregate):
+            return n
+        for c in n.children:
+            r = find_agg(c)
+            if r is not None:
+                return r
+
+    agg = find_agg(plan)
+    g = estimate_groups(agg, session.catalog)
+    assert g is not None and g > 1
+    # clamped by the child's estimated rows
+    from presto_tpu.plan.bounds import estimate_rows
+
+    assert g <= estimate_rows(agg.child, session.catalog)

@@ -283,8 +283,13 @@ def test_degraded_local_run_gets_its_own_ladder(conn, oracle):
     from presto_tpu.parallel.mesh import make_mesh
 
     # int group key -> sort strategy -> the exchange path (a dictionary
-    # key would take the direct psum path and never hit the fault site)
-    q = ("select s_nationkey k, count(*) c from supplier join nation "
+    # key would take the direct psum path and never hit the fault site).
+    # min(n_regionkey) keeps a build-side OUTPUT on the join: without
+    # one, the leaf-route framework (ISSUE-9) folds the filter-only
+    # unique join into a membership bitmap and the faulted
+    # join-build/exchange sites this test is about never execute
+    q = ("select s_nationkey k, count(*) c, min(n_regionkey) r "
+         "from supplier join nation "
          "on s_nationkey = n_nationkey group by s_nationkey order by k")
     want = Session({"tpch": conn}).sql(q)
     s = Session({"tpch": conn}, mesh=make_mesh(2),

@@ -190,7 +190,11 @@ def test_sql_join_uses_dense_when_stats_bound_the_key():
     from presto_tpu.exec import joins as J
     from presto_tpu.runtime.session import Session
 
-    q = ("select o_orderpriority, count(*) as n from orders, customer "
+    # min(c_nationkey) keeps a build-side OUTPUT on the join: without
+    # one, the leaf-route framework (ISSUE-9) folds the filter-only
+    # unique join into a membership bitmap and no build ever runs
+    q = ("select o_orderpriority, count(*) as n, min(c_nationkey) as mn "
+         "from orders, customer "
          "where o_custkey = c_custkey and c_mktsegment = 'BUILDING' "
          "group by o_orderpriority order by o_orderpriority")
     s = Session({"tpch": TpchConnector(sf=0.01)})

@@ -283,3 +283,36 @@ def test_exposition_names_are_prometheus_safe():
             continue
         name = line.split("{")[0].split(" ")[0]
         assert re.fullmatch(r"[a-zA-Z_][a-zA-Z0-9_]*", name), name
+
+
+# ---------------------------------------------------------------------------
+# the post_run attribution bucket (ISSUE-9 satellite): counters bumped
+# AFTER run_plan returns land under explicit post_run.* keys
+# ---------------------------------------------------------------------------
+
+
+def test_post_run_counters_attributed(conn):
+    s = Session({"tpch": conn})
+    _df, info = s.execute(
+        "select count(*) c from lineitem where l_quantity < 10")
+    # query.completed fires after run_plan's delta scope closes — it
+    # was the documented attribution gap; now it lands in post_run.*
+    assert info.metrics.get("post_run.query.completed") == 1.0
+    # the result-cache populate also happens post-run
+    assert info.metrics.get("post_run.result_cache.populated") == 1.0
+    # in-run counters keep their plain (un-prefixed) keys
+    assert "query.completed" not in info.metrics
+    assert any(not k.startswith("post_run.") for k in info.metrics)
+
+
+def test_post_run_bucket_on_failed_query(conn):
+    s = Session({"tpch": conn})
+    try:
+        # fails at EXECUTION (scalar subquery yields >1 row) — analysis
+        # errors never reach the tracked-query lifecycle
+        s.execute("select (select l_orderkey from lineitem) x")
+    except Exception:
+        pass
+    info = s.query_history[-1]
+    assert info.state == "FAILED"
+    assert info.metrics.get("post_run.query.failed") == 1.0

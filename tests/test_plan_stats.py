@@ -136,10 +136,15 @@ def test_join_estimate_snapshots_planned_strategy(session):
     assert strategies and all(s for s in strategies)
     assert any(s in ("pallas", "dense", "unique", "expand", "grouped")
                for s in strategies)
-    # non-joins never carry a join strategy
+    # aggregates carry the adaptive aggregation strategy (ISSUE-9);
+    # every other non-join node stays strategy-free
+    agg = [e.strategy for e in rec.estimates.values()
+           if e.node_type == "Aggregate"]
+    assert agg and all(
+        s in ("fused", "bypass", "partial", "single") for s in agg)
     assert all(
         not e.strategy for e in rec.estimates.values()
-        if e.node_type not in ("Join", "SemiJoin")
+        if e.node_type not in ("Join", "SemiJoin", "Aggregate")
     )
 
 
