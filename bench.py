@@ -811,6 +811,38 @@ SUSTAINED_TEMPLATES: "dict[str, list[str]]" = {
 }
 
 
+#: varied-literal serving stream: each template is a format string plus
+#: the seeded literal domain its workers draw from — the prepared-
+#:statement workload shape (ROADMAP item 4: templated dashboards where
+#: only constants change per request). With ``plan_templates`` off,
+#: every fresh literal re-traces; on, one compiled template serves all
+#: bindings — exactly the A/B ``sustained_load_queries_per_sec_prepared``
+#: measures. Templates deliberately avoid leaf-route-shaped fragments
+#: (whose literals stay baked by design) so the stream exercises the
+#: slotted path.
+VARIED_SUSTAINED_TEMPLATES: "dict[str, tuple[str, list]]" = {
+    "filter_rows": (
+        "select l_orderkey, l_linenumber, l_quantity from lineitem"
+        " where l_extendedprice < {}"
+        " order by l_orderkey, l_linenumber limit 50",
+        list(range(2000, 100000, 500)),
+    ),
+    "join": (
+        "select o_orderpriority, count(*) c from lineitem"
+        " join orders on l_orderkey = o_orderkey"
+        " where l_extendedprice < {} group by o_orderpriority"
+        " order by o_orderpriority",
+        list(range(2000, 100000, 500)),
+    ),
+    "proj_arith": (
+        "select l_orderkey, l_extendedprice, l_extendedprice + {} p"
+        " from lineitem"
+        " order by l_extendedprice desc, l_orderkey limit 20",
+        list(range(1, 400)),
+    ),
+}
+
+
 def _pctl(sorted_vals: list, q: float) -> float:
     """Exact percentile over a sorted sample (nearest-rank)."""
     if not sorted_vals:
@@ -821,7 +853,9 @@ def _pctl(sorted_vals: list, q: float) -> float:
 
 def run_sustained_load(n_sessions: int = 3, duration_s: float = 6.0,
                        seed: int = 0, sf: float = 0.002, conn=None,
-                       chaos: bool = False, templates=None) -> dict:
+                       chaos: bool = False, templates=None,
+                       varied_literals: bool = False,
+                       plan_templates=None) -> dict:
     """Sustained concurrent load: ``n_sessions`` sessions sharing ONE
     MemoryPool, each replaying a seeded mixed TPC-H template stream
     for ``duration_s`` — the throughput-under-concurrency measurement
@@ -834,6 +868,17 @@ def run_sustained_load(n_sessions: int = 3, duration_s: float = 6.0,
     load sessions so every measured query actually executes — the
     number regresses when the ENGINE slows down, not when a result
     ring rotates.
+
+    ``varied_literals=True`` replays the ``VARIED_SUSTAINED_TEMPLATES``
+    stream: every query draws a FRESH literal from its template's
+    seeded domain, so the measured window is honest about re-trace
+    cost — the old fixed-literal stream warmed every exact statement
+    up front, silently hiding the compile tax a real templated serving
+    workload pays. The window's ``exec.traces`` delta and exec-cache
+    hit rate are reported alongside qps so the cost is visible, and
+    ``plan_templates`` (None = session default) drives the prepared
+    vs unprepared A/B behind the
+    ``sustained_load_queries_per_sec_prepared`` metric.
 
     ``chaos=True`` is the chaos-schedule variant: a driver thread
     replays seeded ``tests/test_chaos.run_chaos_round`` rounds (the
@@ -860,19 +905,38 @@ def run_sustained_load(n_sessions: int = 3, duration_s: float = 6.0,
 
     if conn is None:
         conn = TpchConnector(sf=sf)
-    if templates is None:
-        templates = SUSTAINED_TEMPLATES
-    stream = [q for qs in templates.values() for q in qs]
+    if varied_literals:
+        vtemplates = templates or VARIED_SUSTAINED_TEMPLATES
+        # the varied stream's shape is {name: (fmt, literal domain)} —
+        # NOT the fixed stream's {name: [queries]}; catch a mixed-up
+        # caller here instead of deep in a worker thread
+        for name, v in vtemplates.items():
+            if (not isinstance(v, tuple) or len(v) != 2
+                    or not isinstance(v[0], str) or not v[1]):
+                raise ValueError(
+                    f"varied_literals templates must map name -> "
+                    f"(format string, literal domain); got {name}={v!r}"
+                )
+        varied = list(vtemplates.values())  # [(fmt, values), ...]
+        stream = [fmt.format(vals[0]) for fmt, vals in varied]
+    else:
+        if templates is None:
+            templates = SUSTAINED_TEMPLATES
+        varied = None
+        stream = [q for qs in templates.values() for q in qs]
     pool = MemoryPool(device_budget_bytes() * DEFAULT_POOL_HEADROOM,
                       name="sustained")
     props = {"result_cache_enabled": False,
              "admission_queue_timeout_s": 120.0}
+    if plan_templates is not None:
+        props["plan_templates"] = bool(plan_templates)
     sessions = [
         Session({"tpch": conn}, memory_pool=pool, properties=props)
         for _ in range(n_sessions)
     ]
-    # warmup OUTSIDE the clock: compile every template once (the
-    # executable cache is process-wide, so all sessions run warm)
+    # warmup OUTSIDE the clock: compile each template ONCE (one binding
+    # per template under varied literals — the measured window then
+    # shows whether fresh literals re-trace or ride the warm template)
     for q in stream:
         sessions[0].sql(q)
 
@@ -889,7 +953,11 @@ def run_sustained_load(n_sessions: int = 3, duration_s: float = 6.0,
         rng = random.Random((seed << 8) + wid)
         s = sessions[wid]
         while _t.monotonic() < deadline:
-            q = rng.choice(stream)
+            if varied is not None:
+                fmt, vals = rng.choice(varied)
+                q = fmt.format(rng.choice(vals))
+            else:
+                q = rng.choice(stream)
             t0 = _t.perf_counter()
             try:
                 s.sql(q)
@@ -973,6 +1041,18 @@ def run_sustained_load(n_sessions: int = 3, duration_s: float = 6.0,
         "latency_max_ms": round(latencies[-1] * 1e3, 2) if latencies else 0.0,
         "admission_queued_s": round(delta("memory.queued_s.total"), 4),
         "cache_hit_rate": round(eh / (eh + em), 4) if eh + em else None,
+        # re-traces INSIDE the measured window: the honest compile tax
+        # of the stream (0 when every fresh literal rides a warm
+        # template; large when plan_templates is off under varied
+        # literals — the prepared-statement A/B's whole story)
+        "traces": int(delta("exec.traces")),
+        "template_hit_rate": (
+            round(delta("prepare.template_hit")
+                  / max(delta("prepare.template_hit")
+                        + delta("prepare.template_miss"), 1), 4)
+            if delta("prepare.template_hit") + delta("prepare.template_miss")
+            else None),
+        "coalesced": int(delta("prepare.coalesced")),
         "sessions": n_sessions,
         "duration_s": round(wall, 2),
         "chaos": chaos,
@@ -997,6 +1077,21 @@ def bench_sustained_load(extra: dict) -> None:
     assert not res["untyped_failures"], res["untyped_failures"]
     assert res["pool_drained"], "sustained load leaked pool reservations"
     extra["sustained_load"] = res
+    # prepared-statement A/B on the VARIED-literal stream: every query
+    # draws a fresh literal, so templates-off pays a re-trace per new
+    # binding while templates-on rides one warm executable per template
+    # — the serving-path win ISSUE-10 targets (>= 2x qps)
+    if _remaining() > 60:
+        off = run_sustained_load(n_sessions=3, duration_s=6.0, seed=2,
+                                 sf=0.002, varied_literals=True,
+                                 plan_templates=False)
+        assert not off["untyped_failures"], off["untyped_failures"]
+        on = run_sustained_load(n_sessions=3, duration_s=6.0, seed=2,
+                                sf=0.002, varied_literals=True,
+                                plan_templates=True)
+        assert not on["untyped_failures"], on["untyped_failures"]
+        assert on["pool_drained"] and off["pool_drained"]
+        extra["sustained_load_prepared_ab"] = {"off": off, "on": on}
     if _remaining() > 30:
         chaos_res = run_sustained_load(n_sessions=2, duration_s=5.0,
                                        seed=1, sf=0.002, chaos=True)
@@ -1465,6 +1560,26 @@ def _run(sf: float, stream_mode: bool) -> None:
             "admission_queued_s": sl["admission_queued_s"],
             "cache_hit_rate": sl["cache_hit_rate"],
             "sessions": sl["sessions"],
+        })
+    if "sustained_load_prepared_ab" in extra:
+        off = extra["sustained_load_prepared_ab"]["off"]
+        on = extra["sustained_load_prepared_ab"]["on"]
+        metrics.append({
+            "metric": "sustained_load_queries_per_sec_prepared",
+            "value": on["queries_per_sec"],
+            "unit": "q/s",
+            # templates-off on the SAME varied-literal stream is the
+            # baseline: the ratio is the serving-path win of plan-
+            # template parameterization (ISSUE-10 target >= 2x)
+            "vs_baseline": (
+                round(on["queries_per_sec"]
+                      / max(off["queries_per_sec"], 1e-9), 3)),
+            "baseline_queries_per_sec": off["queries_per_sec"],
+            "latency_p99_ms": on["latency_p99_ms"],
+            "window_traces_on": on["traces"],
+            "window_traces_off": off["traces"],
+            "cache_hit_rate": on["cache_hit_rate"],
+            "template_hit_rate": on["template_hit_rate"],
         })
     if "sustained_load_chaos" in extra:
         sl = extra["sustained_load_chaos"]

@@ -36,14 +36,15 @@ _entries: "OrderedDict[str, tuple[int, int]]" = OrderedDict()
 
 
 def _has_unbound(obj) -> bool:
-    """Does the subtree contain an Unbound scalar-subquery slot? Those
-    are bound from a SIBLING subplan at execution, so the rows flowing
-    into a probe depend on values the subtree fingerprint cannot see —
-    caching across bindings would reuse stale min/max bounds and
-    silently mis-pack join keys."""
-    from presto_tpu.expr import Unbound
+    """Does the subtree contain an Unbound scalar-subquery slot or a
+    Param literal slot? Both are bound OUTSIDE the expression tree at
+    execution (a sibling subplan / the query's parameter binding), so
+    the rows flowing into a probe depend on values the subtree
+    fingerprint cannot see — caching across bindings would reuse stale
+    min/max bounds and silently mis-pack join keys."""
+    from presto_tpu.expr import Param, Unbound
 
-    if isinstance(obj, Unbound):
+    if isinstance(obj, (Unbound, Param)):
         return True
     if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
         return any(

@@ -50,6 +50,12 @@ SCHEMAS: dict[str, dict[str, DataType]] = {
         "output_rows": BIGINT,
         "fragment_retries": BIGINT,
         "cache_hit": BIGINT,
+        # this query's plan TEMPLATE (literal slots in place of values)
+        # was already warm in the session — the compiled executable was
+        # reused regardless of the literal binding (plan/templates.py)
+        "template_hit": BIGINT,
+        # coalesced onto a concurrent identical in-flight execution
+        "coalesced": BIGINT,
         "approximate": BIGINT,
         "degraded": BIGINT,
         "oom_retries": BIGINT,
@@ -174,6 +180,8 @@ class SystemConnector:
                 [i.output_rows for i in infos],
                 [i.fragment_retries for i in infos],
                 [int(i.cache_hit) for i in infos],
+                [int(i.template_hit) for i in infos],
+                [int(i.coalesced) for i in infos],
                 [int(i.approximate) for i in infos],
                 [int(i.degraded) for i in infos],
                 [i.oom_retries for i in infos],
@@ -269,8 +277,8 @@ class SystemConnector:
             }
         elif table == "query_history":
             (qid, state, sql, tok, queued, planning, execution, elapsed,
-             outrows, retries, hits, approx, degraded, oomr, memq,
-             ecode, rung, jstrat, fsel) = rows
+             outrows, retries, hits, tmpl, coal, approx, degraded, oomr,
+             memq, ecode, rung, jstrat, fsel) = rows
             arrays = {
                 "query_id": _bytes_col(qid, 24),
                 "state": STATE_DICT.encode(state).astype(np.int32),
@@ -283,6 +291,8 @@ class SystemConnector:
                 "output_rows": np.asarray(outrows, np.int64),
                 "fragment_retries": np.asarray(retries, np.int64),
                 "cache_hit": np.asarray(hits, np.int64),
+                "template_hit": np.asarray(tmpl, np.int64),
+                "coalesced": np.asarray(coal, np.int64),
                 "approximate": np.asarray(approx, np.int64),
                 "degraded": np.asarray(degraded, np.int64),
                 "oom_retries": np.asarray(oomr, np.int64),

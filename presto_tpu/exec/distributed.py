@@ -67,7 +67,7 @@ from presto_tpu.exec.operators import (
 )
 from presto_tpu.exec.ladder import OomLadderMixin
 from presto_tpu.exec.pipeline import BatchSource, Pipeline
-from presto_tpu.expr import BIGINT, evaluate, bind_scalars
+from presto_tpu.expr import BIGINT, evaluate, bind_scalars, param_scope
 from presto_tpu.ops.groupby import gather_padded, group_ids_sort, segment_agg
 from presto_tpu.ops.hashing import partition_ids
 from presto_tpu.ops.sort import sort_indices
@@ -187,6 +187,10 @@ class DistributedExecutor(OomLadderMixin):
         from presto_tpu.exec.local_planner import DIRECT_LIMIT
 
         self.catalog = catalog
+        #: literal-slot values of the current query's plan template
+        #: (see LocalExecutor.params): traced step argument + ambient
+        #: scope for the whole run
+        self.params: tuple = ()
         # The fused Pallas join probe (ops/pallas_join) never runs on
         # this tier: the distributed probe steps are GSPMD-sharded
         # jits where a pallas_call would not partition — the fused
@@ -266,8 +270,12 @@ class DistributedExecutor(OomLadderMixin):
         # query-scoped join-key min/max memo (see exec/joinkeys.py)
         self._minmax_memo.clear()
         scalars: dict[str, Any] = {}
-        with trace_span("node:Output", "node",
-                        {"plan_node_id": self._nid(plan)}):
+        # concrete literal-slot values scope the whole run (eager
+        # evaluation sites); traced step bodies shadow them with their
+        # traced params argument (expr.param_scope)
+        with param_scope(self.params), \
+                trace_span("node:Output", "node",
+                           {"plan_node_id": self._nid(plan)}):
             d = self._exec(plan.child, scalars)
             b = self._replicate(d).batch
             b = b.select(list(plan.sources)).rename(
@@ -472,7 +480,8 @@ class DistributedExecutor(OomLadderMixin):
         rename = {s: nn for nn, s in node.columns}
         b = b.rename(rename)
         if node.predicate is not None:
-            op = FilterProjectOperator(bind_scalars(node.predicate, scalars), None)
+            op = FilterProjectOperator(bind_scalars(node.predicate, scalars), None,
+                                       params=self.params)
             b = op.process(b)[0]
         return DistBatch(b, sharded=True)
 
@@ -482,13 +491,14 @@ class DistributedExecutor(OomLadderMixin):
     # ---- elementwise (sharding-transparent) ------------------------------
     def _exec_filter(self, node: N.Filter, scalars) -> DistBatch:
         d = self._exec(node.child, scalars)
-        op = FilterProjectOperator(bind_scalars(node.predicate, scalars), None)
+        op = FilterProjectOperator(bind_scalars(node.predicate, scalars), None,
+                                   params=self.params)
         return DistBatch(op.process(d.batch)[0], d.sharded)
 
     def _exec_project(self, node: N.Project, scalars) -> DistBatch:
         d = self._exec(node.child, scalars)
         projs = {n: bind_scalars(e, scalars) for n, e in node.exprs}
-        op = FilterProjectOperator(None, projs)
+        op = FilterProjectOperator(None, projs, params=self.params)
         return DistBatch(op.process(d.batch)[0], d.sharded)
 
     # ---- aggregation -----------------------------------------------------
@@ -535,7 +545,7 @@ class DistributedExecutor(OomLadderMixin):
             # global agg: jnp reductions over the sharded rows — XLA
             # inserts the cross-device reduce (psum) itself
             REGISTRY.counter("agg.strategy.single").add()
-            op = GlobalAggregationOperator(aggs)
+            op = GlobalAggregationOperator(aggs, params=self.params)
             out = Pipeline(BatchSource([d.batch]), [op]).run()
             return DistBatch(out[0], sharded=False)
 
@@ -556,12 +566,14 @@ class DistributedExecutor(OomLadderMixin):
             # small dense group domain: per-shard segment_sum + XLA
             # auto-reduction (the psum path of the Q1 fragment)
             try:
-                op = HashAggregationOperator(keys, aggs, strategy)
+                op = HashAggregationOperator(keys, aggs, strategy,
+                                             params=self.params)
                 out = Pipeline(BatchSource([d.batch]), [op]).run()
                 return DistBatch(out[0], sharded=False)
             except ValueBitsOverflow:
                 aggs = [dataclasses.replace(a, value_bits=63) for a in aggs]
-                op = HashAggregationOperator(keys, aggs, strategy)
+                op = HashAggregationOperator(keys, aggs, strategy,
+                                             params=self.params)
                 out = Pipeline(BatchSource([d.batch]), [op]).run()
                 return DistBatch(out[0], sharded=False)
             except NullGroupKeys:
@@ -571,7 +583,8 @@ class DistributedExecutor(OomLadderMixin):
                     keys, pax, dict_len, live_count(first), direct_limit=0)
         if not d.sharded:
             for _ in range(MAX_RETRIES):
-                op = HashAggregationOperator(keys, aggs, strategy, passengers=pax)
+                op = HashAggregationOperator(keys, aggs, strategy, passengers=pax,
+                                             params=self.params)
                 try:
                     out = Pipeline(BatchSource([d.batch]), [op]).run()
                     return DistBatch(out[0], sharded=False)
@@ -635,7 +648,7 @@ class DistributedExecutor(OomLadderMixin):
             t0 = _time.perf_counter()
             with trace_span("step:dist_agg", "step",
                             {"quota": quota, "recv_cap": mgf}):
-                out, overflow, rounds = step(b)
+                out, overflow, rounds = step(b, self.params)
                 done = not bool(overflow)
             # exchanged rows are partial-agg group rows: the final
             # output's columns plus one int64 merge-count per agg
@@ -776,19 +789,20 @@ class DistributedExecutor(OomLadderMixin):
 
         @partial(
             shard_map, mesh=mesh,
-            in_specs=(P(axes),), out_specs=(P(axes), P(), P()),
+            in_specs=(P(axes), P()), out_specs=(P(axes), P(), P()),
             check_vma=False,
         )
-        def step(b: Batch):
+        def step(b: Batch, params=()):
             trace_probe()
-            part, ovf1 = (bypass_phase(b) if bypass else partial_phase(b))
-            key_sort = [c for n, _ in keys for c in _sortables(part[n])]
-            pids = partition_ids(key_sort, Pn)
-            exch, ovf2, rounds = exchange_multiround(
-                part, pids, Pn, quota, mgf, axes=axes, with_rounds=True
-            )
-            out, ovf3 = final_phase(exch)
-            return out, any_flag(ovf1 | ovf2 | ovf3, axes), rounds
+            with param_scope(params):
+                part, ovf1 = (bypass_phase(b) if bypass else partial_phase(b))
+                key_sort = [c for n, _ in keys for c in _sortables(part[n])]
+                pids = partition_ids(key_sort, Pn)
+                exch, ovf2, rounds = exchange_multiround(
+                    part, pids, Pn, quota, mgf, axes=axes, with_rounds=True
+                )
+                out, ovf3 = final_phase(exch)
+                return out, any_flag(ovf1 | ovf2 | ovf3, axes), rounds
 
         return jax.jit(step)
 
@@ -937,7 +951,7 @@ class DistributedExecutor(OomLadderMixin):
         # multiply HBM by the mesh size
         rb = self._replicate(right, guard="BroadcastJoinBuild",
                              rows_hint=rows_hint).batch
-        build = JoinBuildOperator(rkey)
+        build = JoinBuildOperator(rkey, params=self.params)
         build.process(rb)
         build.finish()
         outs = [BuildOutput(n, n) for n in node.output_right]
@@ -946,7 +960,7 @@ class DistributedExecutor(OomLadderMixin):
                                              verify)
         if node.unique:
             op = LookupJoinOperator(build, lkey, outs, node.kind, unique=True,
-                                    verify=verify)
+                                    verify=verify, params=self.params)
             return DistBatch(op.process(left.batch)[0], left.sharded)
         out_cap = batch_capacity(
             max(left.batch.capacity, live_count(rb), 1024)
@@ -955,7 +969,7 @@ class DistributedExecutor(OomLadderMixin):
             try:
                 op = LookupJoinOperator(
                     build, lkey, outs, node.kind, unique=False,
-                    out_capacity=out_cap, verify=verify,
+                    out_capacity=out_cap, verify=verify, params=self.params,
                 )
                 return DistBatch(op.process(left.batch)[0], left.sharded)
             except CapacityOverflow:
@@ -976,7 +990,7 @@ class DistributedExecutor(OomLadderMixin):
         flags = full_init_flags(build)
         if node.unique:
             op = LookupJoinOperator(build, lkey, outs, "full", unique=True,
-                                    verify=verify)
+                                    verify=verify, params=self.params)
             out, flags = op.process_full(left.batch, flags)
         else:
             out_cap = batch_capacity(
@@ -986,7 +1000,7 @@ class DistributedExecutor(OomLadderMixin):
                 try:
                     op = LookupJoinOperator(
                         build, lkey, outs, "full", unique=False,
-                        out_capacity=out_cap,
+                        out_capacity=out_cap, params=self.params,
                     )
                     out, flags = op.process_full(left.batch, flags)
                     break
@@ -1066,7 +1080,8 @@ class DistributedExecutor(OomLadderMixin):
             with trace_span("step:repartition_join", "step",
                             {"kind": node.kind, "lrecv": lrecv,
                              "rrecv": rrecv}):
-                out, overflow, flags, rounds = step(left.batch, right.batch)
+                out, overflow, flags, rounds = step(left.batch, right.batch,
+                                                    self.params)
                 long_runs, sentinel = (bool(x) for x in np.asarray(flags))
                 ok = not bool(overflow)
             lr, rr = (int(x) for x in np.asarray(rounds))
@@ -1123,12 +1138,16 @@ class DistributedExecutor(OomLadderMixin):
 
         @partial(
             shard_map, mesh=self.mesh,
-            in_specs=(P(axes), P(axes)),
+            in_specs=(P(axes), P(axes), P()),
             out_specs=(P(axes), P(), P(), P()),
             check_vma=False,
         )
-        def step(lb: Batch, rb: Batch):
+        def step(lb: Batch, rb: Batch, params=()):
             trace_probe()
+            with param_scope(params):
+                return step_body(lb, rb)
+
+        def step_body(lb: Batch, rb: Batch):
             from presto_tpu.exec.operators import concat_batches
 
             lv = evaluate(lkey, lb)
@@ -1263,10 +1282,12 @@ class DistributedExecutor(OomLadderMixin):
 
         def make_bids_step():
             @jax.jit
-            def bids_step(bb: Batch):
-                v = evaluate(key, bb)
-                data = jnp.where(bb.live & v.valid, v.data.astype(jnp.int64), 0)
-                return bucket_ids([data], nbuckets)
+            def bids_step(bb: Batch, params=()):
+                with param_scope(params):
+                    v = evaluate(key, bb)
+                    data = jnp.where(bb.live & v.valid,
+                                     v.data.astype(jnp.int64), 0)
+                    return bucket_ids([data], nbuckets)
 
             return bids_step
 
@@ -1274,7 +1295,7 @@ class DistributedExecutor(OomLadderMixin):
             EXEC_CACHE.key_of("dist_spill_bids", key, nbuckets),
             make_bids_step,
         )
-        bids = np.asarray(bids_step(b))
+        bids = np.asarray(bids_step(b, self.params))
         live = np.asarray(b.live)
         cols = {
             n: (np.asarray(c.data), np.asarray(c.valid), c.dtype, c.dictionary)
@@ -1433,14 +1454,16 @@ class DistributedExecutor(OomLadderMixin):
         def make_bids_step():
             @partial(
                 shard_map, mesh=mesh,
-                in_specs=(P(axes),), out_specs=(P(axes), P(axes)),
+                in_specs=(P(axes), P()), out_specs=(P(axes), P(axes)),
                 check_vma=False,
             )
-            def bids_step(local: Batch):
-                bids = bucket_ids(key_sortables(local), nbuckets)
-                onehot = (bids[:, None] == jnp.arange(nbuckets)) & local.live[:, None]
-                counts = jnp.sum(onehot, axis=0, dtype=jnp.int32)[None, :]
-                return bids, counts
+            def bids_step(local: Batch, params=()):
+                with param_scope(params):
+                    bids = bucket_ids(key_sortables(local), nbuckets)
+                    onehot = ((bids[:, None] == jnp.arange(nbuckets))
+                              & local.live[:, None])
+                    counts = jnp.sum(onehot, axis=0, dtype=jnp.int32)[None, :]
+                    return bids, counts
 
             return jax.jit(bids_step)
 
@@ -1448,7 +1471,7 @@ class DistributedExecutor(OomLadderMixin):
             EXEC_CACHE.key_of("dist_bucket_ids", keys, nbuckets,
                               self._mesh_fp),
             make_bids_step,
-        )(b)
+        )(b, self.params)
         counts = np.asarray(counts)  # [P, B]
         cap_pass = batch_capacity(max(int(counts.max()), 16), minimum=64)
 
@@ -1501,11 +1524,12 @@ class DistributedExecutor(OomLadderMixin):
             or not left.sharded
         ):
             rb = self._replicate(right, guard="SemiJoinBuild").batch
-            build = JoinBuildOperator(rkey)
+            build = JoinBuildOperator(rkey, params=self.params)
             build.process(rb)
             build.finish()
             op = LookupJoinOperator(
-                build, lkey, (), "anti" if node.negated else "semi"
+                build, lkey, (), "anti" if node.negated else "semi",
+                params=self.params,
             )
             return DistBatch(op.process(left.batch)[0], left.sharded)
         shim = _SemiShim(node)
@@ -1541,7 +1565,7 @@ class DistributedExecutor(OomLadderMixin):
         from presto_tpu.exec.operators import window_operator_from_node
 
         d = self._exec(node.child, scalars)
-        op = window_operator_from_node(node, scalars)
+        op = window_operator_from_node(node, scalars, params=self.params)
         if d.sharded and self.nworkers > 1 and node.partition_by:
             part = [bind_scalars(e, scalars) for e in node.partition_by]
             return self._partitioned_window(d, part, op)
@@ -1573,7 +1597,7 @@ class DistributedExecutor(OomLadderMixin):
             t0 = _time.perf_counter()
             with trace_span("step:dist_window", "step",
                             {"quota": quota, "recv_cap": rc}):
-                out, overflow, rounds = step(b)
+                out, overflow, rounds = step(b, self.params)
                 ok = not bool(overflow)
             r = int(np.asarray(rounds))
             record_exchange(
@@ -1614,17 +1638,18 @@ class DistributedExecutor(OomLadderMixin):
 
         @partial(
             shard_map, mesh=self.mesh,
-            in_specs=(P(axes),), out_specs=(P(axes), P(), P()),
+            in_specs=(P(axes), P()), out_specs=(P(axes), P(), P()),
             check_vma=False,
         )
-        def step(local: Batch):
+        def step(local: Batch, params=()):
             trace_probe()
-            pids = partition_ids(hash_cols(local), Pn)
-            exch, ovf, rounds = exchange_multiround(
-                local, pids, Pn, quota, recv_cap, axes=axes,
-                with_rounds=True)
-            out = window_body(exch)
-            return out, any_flag(ovf, axes), rounds
+            with param_scope(params):
+                pids = partition_ids(hash_cols(local), Pn)
+                exch, ovf, rounds = exchange_multiround(
+                    local, pids, Pn, quota, recv_cap, axes=axes,
+                    with_rounds=True)
+                out = window_body(exch, params)
+                return out, any_flag(ovf, axes), rounds
 
         return jax.jit(step)
 
@@ -1698,10 +1723,14 @@ class DistributedExecutor(OomLadderMixin):
         def make_step():
             @partial(
                 shard_map, mesh=mesh,
-                in_specs=(P(axes),), out_specs=P(axes),
+                in_specs=(P(axes), P()), out_specs=P(axes),
                 check_vma=False,
             )
-            def step(local: Batch):
+            def step(local: Batch, params=()):
+                with param_scope(params):
+                    return step_body(local)
+
+            def step_body(local: Batch):
                 vals = [evaluate(k.expr, local) for k in keys]
                 order = sort_indices(
                     [v.data for v in vals],
@@ -1730,7 +1759,7 @@ class DistributedExecutor(OomLadderMixin):
                               self._mesh_fp),
             make_step,
         )
-        return DistBatch(step(b), sharded=True)
+        return DistBatch(step(b, self.params), sharded=True)
 
     def _local_limit(self, d: DistBatch, n: int) -> DistBatch:
         from presto_tpu.ops.compact import compact_indices
@@ -1811,10 +1840,14 @@ class DistributedExecutor(OomLadderMixin):
         def make_sample_step():
             @partial(
                 shard_map, mesh=mesh,
-                in_specs=(P(axes),), out_specs=(P(), P()),
+                in_specs=(P(axes), P()), out_specs=(P(), P()),
                 check_vma=False,
             )
-            def sample_step(local: Batch):
+            def sample_step(local: Batch, params=()):
+                with param_scope(params):
+                    return sample_body(local)
+
+            def sample_body(local: Batch):
                 cmp = sort_cmp(k0, local)
                 order = sort_indices([cmp], [False], local.live)
                 cnt = jnp.sum(local.live.astype(jnp.int64))
@@ -1832,7 +1865,7 @@ class DistributedExecutor(OomLadderMixin):
                               self._mesh_fp),
             make_sample_step,
         )
-        samp, ok = sample(b)
+        samp, ok = sample(b, self.params)
         samp = np.asarray(samp).reshape(-1)
         ok = np.asarray(ok).reshape(-1)
         pool = np.sort(samp[ok])
@@ -1859,7 +1892,7 @@ class DistributedExecutor(OomLadderMixin):
             t0 = _time.perf_counter()
             with trace_span("step:dist_sort", "step",
                             {"quota": quota, "recv_cap": rc}):
-                out, overflow, rounds = step(b, splitters)
+                out, overflow, rounds = step(b, splitters, self.params)
                 ok = not bool(overflow)
             r = int(np.asarray(rounds))
             record_exchange(
@@ -1882,11 +1915,15 @@ class DistributedExecutor(OomLadderMixin):
 
         @partial(
             shard_map, mesh=self.mesh,
-            in_specs=(P(axes), P()), out_specs=(P(axes), P(), P()),
+            in_specs=(P(axes), P(), P()), out_specs=(P(axes), P(), P()),
             check_vma=False,
         )
-        def step(local: Batch, splitters):
+        def step(local: Batch, splitters, params=()):
             trace_probe()
+            with param_scope(params):
+                return step_body(local, splitters)
+
+        def step_body(local: Batch, splitters):
             cmp = sort_cmp(k0, local)
             pids = jnp.searchsorted(splitters, cmp, side="right").astype(jnp.int32)
             exch, ovf, rounds = exchange_multiround(

@@ -33,7 +33,7 @@ from presto_tpu.exec.operators import (
     Operator,
     concat_batches,
 )
-from presto_tpu.expr import Expr, InputRef, evaluate
+from presto_tpu.expr import Expr, InputRef, evaluate, param_scope
 from presto_tpu.runtime.trace import span as trace_span
 from presto_tpu.ops.groupby import gather_padded
 from presto_tpu.ops.join import (
@@ -130,6 +130,7 @@ class JoinBuildOperator(CollectingOperator):
         key_max: int | None = None,
         pallas: "pallas_join.PallasJoinSpec | None" = None,
         filter_bits: int = 0,
+        params: Sequence = (),
     ):
         """``dense_domain``: optional (key_min, domain) from planner
         stats — builds a dense direct-address table alongside the sorted
@@ -157,6 +158,8 @@ class JoinBuildOperator(CollectingOperator):
         pushdown."""
         super().__init__()
         self.key = key
+        #: literal-slot values of the owning query (traced step arg)
+        self._params = tuple(params)
         self.capacity = capacity
         self.dense_domain = dense_domain
         self.key_max = key_max
@@ -218,8 +221,12 @@ class JoinBuildOperator(CollectingOperator):
 
         def make_build():
             @jax.jit
-            def build(b: Batch):
+            def build(b: Batch, params=()):
                 trace_probe()
+                with param_scope(params):
+                    return body(b)
+
+            def body(b: Batch):
                 v = evaluate(key_expr, b)
                 live = b.live & v.valid
                 side = build_lookup(v.data, live, cap, pack_bits=pack_bits)
@@ -268,7 +275,8 @@ class JoinBuildOperator(CollectingOperator):
             make_build,
         )
         with trace_span("step:join_build", "step", {"capacity": cap}):
-            side, dense, long_runs, ptables, poob, pnull, filt = build(batch)
+            side, dense, long_runs, ptables, poob, pnull, filt = build(
+                batch, self._params)
         if spec is not None:
             if (poob is not None and bool(poob)) or (
                     pnull is not None and bool(pnull)):
@@ -338,6 +346,7 @@ class LookupJoinOperator(Operator):
         unique: bool = True,
         out_capacity: int | None = None,
         verify: Sequence[tuple[Expr, Expr]] = (),
+        params: Sequence = (),
     ):
         """``verify``: (probe_expr, build_expr) pairs re-checked on the
         original values after a hash-key probe — wide string keys probe
@@ -347,6 +356,7 @@ class LookupJoinOperator(Operator):
         only."""
         self.build = build
         self.probe_key = probe_key
+        self._params = tuple(params)
         self.build_outputs = list(build_outputs)
         self.join_type = join_type
         self.unique = unique
@@ -416,8 +426,12 @@ class LookupJoinOperator(Operator):
 
         def make():
             @jax.jit
-            def step(tables, payload: Batch, batch: Batch) -> Batch:
+            def step(tables, payload: Batch, batch: Batch, params=()) -> Batch:
                 trace_probe()
+                with param_scope(params):
+                    return body(tables, payload, batch)
+
+            def body(tables, payload: Batch, batch: Batch) -> Batch:
                 v = evaluate(key, batch)
                 plive = batch.live & v.valid
                 if spec.mode == "payload":
@@ -506,13 +520,16 @@ class LookupJoinOperator(Operator):
 
             def make_semi():
                 @jax.jit
-                def step(side, payload: Batch, batch: Batch) -> Batch:
+                def step(side, payload: Batch, batch: Batch, params=()) -> Batch:
                     trace_probe()
-                    v = evaluate(key, batch)
-                    probe = probe_exists_dense if use_dense else probe_exists
-                    exists = probe(side, v.data, batch.live & v.valid)
-                    keep = exists if jt == "semi" else batch.live & ~exists
-                    return batch.with_live(batch.live & keep)
+                    with param_scope(params):
+                        v = evaluate(key, batch)
+                        probe = (probe_exists_dense if use_dense
+                                 else probe_exists)
+                        exists = probe(side, v.data, batch.live & v.valid)
+                        keep = (exists if jt == "semi"
+                                else batch.live & ~exists)
+                        return batch.with_live(batch.live & keep)
 
                 return step
 
@@ -532,19 +549,22 @@ class LookupJoinOperator(Operator):
 
             def make_unique():
                 @jax.jit
-                def step(side, payload: Batch, batch: Batch) -> Batch:
+                def step(side, payload: Batch, batch: Batch, params=()) -> Batch:
                     trace_probe()
-                    res = unique_probe(side, payload, batch)
-                    matched = res.matched
-                    cols = dict(batch.columns)
-                    for bo in outs:
-                        src = payload[bo.source]
-                        data = gather_rows(src.data, res.build_row, 0)
-                        valid = gather_padded(src.valid, res.build_row, False)
-                        cols[bo.name] = Column(data, valid & matched,
-                                               src.dtype, src.dictionary)
-                    live = batch.live & matched if jt == "inner" else batch.live
-                    return Batch(cols, live)
+                    with param_scope(params):
+                        res = unique_probe(side, payload, batch)
+                        matched = res.matched
+                        cols = dict(batch.columns)
+                        for bo in outs:
+                            src = payload[bo.source]
+                            data = gather_rows(src.data, res.build_row, 0)
+                            valid = gather_padded(src.valid, res.build_row,
+                                                  False)
+                            cols[bo.name] = Column(data, valid & matched,
+                                                   src.dtype, src.dictionary)
+                        live = (batch.live & matched if jt == "inner"
+                                else batch.live)
+                        return Batch(cols, live)
 
                 return step
 
@@ -567,8 +587,13 @@ class LookupJoinOperator(Operator):
         left = jt == "left"
 
         def make_expand():
-            def step(side: BuildSide, payload: Batch, batch: Batch):
+            def step(side: BuildSide, payload: Batch, batch: Batch,
+                     params=()):
                 trace_probe()
+                with param_scope(params):
+                    return body(side, payload, batch)
+
+            def body(side: BuildSide, payload: Batch, batch: Batch):
                 v = evaluate(key, batch)
                 res = probe_expand(side, v.data, batch.live & v.valid, out_cap,
                                    left=left, emit_live=batch.live)
@@ -626,7 +651,8 @@ class LookupJoinOperator(Operator):
             with trace_span(f"step:probe_{self.join_type}", "step",
                             {"strategy": "pallas"}):
                 return [self._pallas_step(self.build.pallas_side,
-                                          self.build.payload, batch)]
+                                          self.build.payload, batch,
+                                          self._params)]
         if self.build.pallas_side is not None:
             # the build published fused tables but THIS batch cannot
             # ride them (key storage / capacity block): degrade loudly
@@ -641,11 +667,13 @@ class LookupJoinOperator(Operator):
             self._record_strategy(
                 "dense" if self.build.dense_side is not None else "unique")
             with trace_span(f"step:probe_{self.join_type}", "step"):
-                return [self._step(side, self.build.payload, batch)]
+                return [self._step(side, self.build.payload, batch,
+                                   self._params)]
         self._record_strategy("expand")
         with trace_span(f"step:probe_{self.join_type}", "step"):
             out, overflow = self._step(self.build.build_side,
-                                       self.build.payload, batch)
+                                       self.build.payload, batch,
+                                       self._params)
         if bool(overflow):
             raise CapacityOverflow("LookupJoin", self.out_capacity)
         return [out]
@@ -681,8 +709,13 @@ class LookupJoinOperator(Operator):
 
             def make_full_unique():
                 @jax.jit
-                def step(side, payload: Batch, flags, batch: Batch):
+                def step(side, payload: Batch, flags, batch: Batch,
+                         params=()):
                     trace_probe()
+                    with param_scope(params):
+                        return body(side, payload, flags, batch)
+
+                def body(side, payload: Batch, flags, batch: Batch):
                     res = unique_probe(side, payload, batch)
                     matched = res.matched
                     cols = dict(batch.columns)
@@ -719,8 +752,13 @@ class LookupJoinOperator(Operator):
 
         def make_full_expand():
             @jax.jit
-            def step(side: BuildSide, payload: Batch, flags, batch: Batch):
+            def step(side: BuildSide, payload: Batch, flags, batch: Batch,
+                     params=()):
                 trace_probe()
+                with param_scope(params):
+                    return body(side, payload, flags, batch)
+
+            def body(side: BuildSide, payload: Batch, flags, batch: Batch):
                 v = evaluate(key, batch)
                 res = probe_expand(side, v.data, batch.live & v.valid, out_cap,
                                    left=True, emit_live=batch.live)
@@ -765,10 +803,12 @@ class LookupJoinOperator(Operator):
                 else self.build.build_side
             )
             with trace_span("step:probe_full", "step"):
-                return self._full_step(side, self.build.payload, flags, batch)
+                return self._full_step(side, self.build.payload, flags, batch,
+                                       self._params)
         with trace_span("step:probe_full", "step"):
             out, new_flags, overflow = self._full_step(
-                self.build.build_side, self.build.payload, flags, batch
+                self.build.build_side, self.build.payload, flags, batch,
+                self._params,
             )
         if bool(overflow):
             raise CapacityOverflow("LookupJoin", self.out_capacity)

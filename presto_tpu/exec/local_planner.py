@@ -170,6 +170,14 @@ class LocalExecutor(OomLadderMixin):
                  pallas_join_enabled: bool = True,
                  approx_join: bool = False):
         self.catalog = catalog
+        #: literal-slot values of the current query's plan template
+        #: (plan/templates.py device scalars, set by the Session before
+        #: run_plan): threaded into every jitted step as a traced
+        #: argument so one compiled template serves every binding, and
+        #: installed as the ambient expr.param_scope for the whole run
+        #: so eager evaluation sites (sort keys, runtime min/max
+        #: probes, spill bucketing) read the concrete values
+        self.params: tuple = ()
         #: sideways information passing: push join-build key bounds +
         #: Bloom bitmasks into probe-side scans (semantics-preserving)
         self.runtime_join_filters = runtime_join_filters
@@ -234,6 +242,7 @@ class LocalExecutor(OomLadderMixin):
         return pd.concat(dfs, ignore_index=True)[list(names)]
 
     def run_batches(self, plan: N.Output):
+        from presto_tpu.expr import param_scope
         from presto_tpu.runtime.lifecycle import run_fragment
         from presto_tpu.runtime.trace import span as trace_span
 
@@ -245,24 +254,29 @@ class LocalExecutor(OomLadderMixin):
         self.used_approx = False
         scalars: dict[str, Any] = {}
         child = plan.child
-        batches = self._exec(child, scalars)
+        # the CONCRETE literal-slot values scope the whole run: eager
+        # evaluation sites read them directly; traced step bodies
+        # shadow them with their traced params argument (expr.py)
+        with param_scope(self.params):
+            batches = self._exec(child, scalars)
 
-        # the sink drain is a fragment boundary too: in a streaming-only
-        # plan (no pipeline breaker) the lazy scan work happens HERE, so
-        # a retryable fault raised mid-drain must be retried here — the
-        # stream is replayable, a retry re-drains from the top
-        def drain():
-            out = []
-            for b in batches:
-                ren = b.select(list(plan.sources)).rename(
-                    dict(zip(plan.sources, plan.names))
-                )
-                out.append(ren)
-            return out
+            # the sink drain is a fragment boundary too: in a
+            # streaming-only plan (no pipeline breaker) the lazy scan
+            # work happens HERE, so a retryable fault raised mid-drain
+            # must be retried here — the stream is replayable, a retry
+            # re-drains from the top
+            def drain():
+                out = []
+                for b in batches:
+                    ren = b.select(list(plan.sources)).rename(
+                        dict(zip(plan.sources, plan.names))
+                    )
+                    out.append(ren)
+                return out
 
-        with trace_span("node:Output", "node",
-                        {"plan_node_id": self._nid(plan)}):
-            out = run_fragment("fragment:Output", drain)
+            with trace_span("node:Output", "node",
+                            {"plan_node_id": self._nid(plan)}):
+                out = run_fragment("fragment:Output", drain)
         # every lazy scan has drained by here: one readback flushes
         # the runtime-join-filter pruning stats for the whole query
         self._flush_filter_stats()
@@ -348,7 +362,8 @@ class LocalExecutor(OomLadderMixin):
         ops = []
         if node.predicate is not None:
             ops.append(
-                FilterProjectOperator(bind_scalars(node.predicate, scalars), None)
+                FilterProjectOperator(bind_scalars(node.predicate, scalars), None,
+                                      params=self.params)
             )
         splits = list(conn.splits(node.table))
         cap = batch_capacity(max(s.row_hint for s in splits))
@@ -373,13 +388,14 @@ class LocalExecutor(OomLadderMixin):
     # ---- streaming transforms -------------------------------------------
     def _exec_filter(self, node: N.Filter, scalars) -> BatchStream:
         child = self._exec(node.child, scalars)
-        op = FilterProjectOperator(bind_scalars(node.predicate, scalars), None)
+        op = FilterProjectOperator(bind_scalars(node.predicate, scalars), None,
+                                   params=self.params)
         return child.map(lambda b: op.process(b)[0])
 
     def _exec_project(self, node: N.Project, scalars) -> BatchStream:
         child = self._exec(node.child, scalars)
         projs = {n: bind_scalars(e, scalars) for n, e in node.exprs}
-        op = FilterProjectOperator(None, projs)
+        op = FilterProjectOperator(None, projs, params=self.params)
         return child.map(lambda b: op.process(b)[0])
 
     # ---- aggregation ----------------------------------------------------
@@ -430,7 +446,7 @@ class LocalExecutor(OomLadderMixin):
             from presto_tpu.exec.operators import GlobalAggregationOperator
 
             REGISTRY.counter("agg.strategy.single").add()
-            op = GlobalAggregationOperator(aggs)
+            op = GlobalAggregationOperator(aggs, params=self.params)
             return BatchStream.of(Pipeline(child, [op]).run())
         strategy = self._pick_group_strategy(keys, pax, node, child)
         if isinstance(strategy, SortStrategy) and self._use_agg_bypass(node):
@@ -453,7 +469,8 @@ class LocalExecutor(OomLadderMixin):
             REGISTRY.counter("agg.strategy.partial").add()
         fault_point("step.agg")
         for attempt in range(MAX_RETRIES):
-            op = HashAggregationOperator(keys, aggs, strategy, passengers=pax)
+            op = HashAggregationOperator(keys, aggs, strategy, passengers=pax,
+                                         params=self.params)
             try:
                 # draining the (replayable) child stream folds one morsel
                 # at a time into device-resident state — bounded memory
@@ -810,7 +827,8 @@ class LocalExecutor(OomLadderMixin):
             rkey, dense_domain=self._dense_domain(iv, right),
             key_max=self._key_upper_bound(iv) if node.unique else None,
             pallas=spec,
-            filter_bits=self._filter_bits(node.right) if fslot else 0)
+            filter_bits=self._filter_bits(node.right) if fslot else 0,
+            params=self.params)
         Pipeline(BatchSource(right), [build]).run()
         self._fill_join_filter(fslot, build, node.right, rkey)
         outs = [BuildOutput(n, n) for n in node.output_right]
@@ -819,7 +837,7 @@ class LocalExecutor(OomLadderMixin):
                                         verify)
         if node.unique:
             op = LookupJoinOperator(build, lkey, outs, node.kind, unique=True,
-                                    verify=verify)
+                                    verify=verify, params=self.params)
             return left.map(lambda b: op.process(b)[0])
         probe = self._retrying_expand_probe(
             build, lkey, outs, node.kind, right,
@@ -851,7 +869,7 @@ class LocalExecutor(OomLadderMixin):
                 if op is None:
                     op = LookupJoinOperator(
                         build, lkey, outs, kind, unique=False,
-                        out_capacity=c, verify=verify,
+                        out_capacity=c, verify=verify, params=self.params,
                     )
                     state["ops"][c] = op
                 try:
@@ -873,7 +891,7 @@ class LocalExecutor(OomLadderMixin):
         safe)."""
         if node.unique:
             uop = LookupJoinOperator(build, lkey, outs, "full", unique=True,
-                                     verify=verify)
+                                     verify=verify, params=self.params)
             probe_once = lambda b, flags: uop.process_full(b, flags)  # noqa: E731
         else:
             if verify:
@@ -946,7 +964,7 @@ class LocalExecutor(OomLadderMixin):
             minimum=16,
         )
         probe_cap = _probe_capacity(lspill, nbuckets, probe_chunk)
-        build = JoinBuildOperator(rkey, capacity=build_cap)
+        build = JoinBuildOperator(rkey, capacity=build_cap, params=self.params)
         probe_ops: dict[tuple, LookupJoinOperator] = {}
 
         def probe_op(cap: int | None) -> LookupJoinOperator:
@@ -954,7 +972,7 @@ class LocalExecutor(OomLadderMixin):
             if key not in probe_ops:
                 probe_ops[key] = LookupJoinOperator(
                     build, lkey, outs, node.kind,
-                    unique=cap is None, out_capacity=cap,
+                    unique=cap is None, out_capacity=cap, params=self.params,
                 )
             return probe_ops[key]
 
@@ -1043,7 +1061,8 @@ class LocalExecutor(OomLadderMixin):
         spec = self._pallas_spec(iv, (), {}, True, jt)
         build = JoinBuildOperator(
             rkey, dense_domain=self._dense_domain(iv, right), pallas=spec,
-            filter_bits=self._filter_bits(node.right) if fslot else 0)
+            filter_bits=self._filter_bits(node.right) if fslot else 0,
+            params=self.params)
         Pipeline(BatchSource(right), [build]).run()
         self._fill_join_filter(fslot, build, node.right, rkey)
         if (spec is not None and spec.mode == "sketch"
@@ -1054,7 +1073,7 @@ class LocalExecutor(OomLadderMixin):
             # (conservative: a per-batch capacity fallback could still
             # make the run exact in practice; flagged is flagged)
             self.used_approx = True
-        op = LookupJoinOperator(build, lkey, (), jt)
+        op = LookupJoinOperator(build, lkey, (), jt, params=self.params)
         return left.map(lambda b: op.process(b)[0])
 
     def _exec_grouped_semijoin(self, left, right_stream, lkey, rkey,
@@ -1070,8 +1089,8 @@ class LocalExecutor(OomLadderMixin):
             minimum=16,
         )
         probe_cap = _probe_capacity(lspill, nbuckets, probe_chunk)
-        build = JoinBuildOperator(rkey, capacity=build_cap)
-        op = LookupJoinOperator(build, lkey, (), jt)
+        build = JoinBuildOperator(rkey, capacity=build_cap, params=self.params)
+        op = LookupJoinOperator(build, lkey, (), jt, params=self.params)
 
         def make():
             from presto_tpu.runtime.faults import fault_point
@@ -1097,7 +1116,7 @@ class LocalExecutor(OomLadderMixin):
         child = self._exec(node.child, scalars)
         from presto_tpu.exec.operators import window_operator_from_node
 
-        op = window_operator_from_node(node, scalars)
+        op = window_operator_from_node(node, scalars, params=self.params)
         return BatchStream.of(Pipeline(child, [op]).run())
 
     def _exec_values(self, node: N.Values, scalars) -> BatchStream:

@@ -34,7 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from presto_tpu.batch import Batch, Column, Dictionary
-from presto_tpu.expr import Expr, Val, evaluate, evaluate_predicate
+from presto_tpu.expr import Expr, Val, evaluate, evaluate_predicate, param_scope
 from presto_tpu.ops.groupby import (
     ValueBitsOverflow,
     fused_small_sums,
@@ -104,15 +104,21 @@ class FilterProjectOperator(Operator):
     movement (selection-vector semantics).
     """
 
-    def __init__(self, predicate: Expr | None, projections: dict[str, Expr] | None):
+    def __init__(self, predicate: Expr | None, projections: dict[str, Expr] | None,
+                 params: Sequence[Any] = ()):
         from presto_tpu.cache.exec_cache import EXEC_CACHE
 
         self.predicate = predicate
         self.projections = projections
+        #: literal-slot values for this query's plan template (traced
+        #: step argument, NOT baked into the closure — one compiled
+        #: step serves every binding; see expr.param_scope)
+        self._params = tuple(params)
         # jitted steps are shared across queries through the compiled-
         # executable cache, keyed by expression CONTENT: the closure
-        # bakes in nothing but the exprs, so equal configs trace equal
-        # programs (cache/exec_cache.py)
+        # bakes in nothing but the exprs (Param slots hash by slot id,
+        # never by value), so equal configs trace equal programs
+        # (cache/exec_cache.py)
         self._step = EXEC_CACHE.get_or_build(
             EXEC_CACHE.key_of("filter_project", predicate, projections),
             lambda: jax.jit(self._make_step()),
@@ -123,8 +129,12 @@ class FilterProjectOperator(Operator):
 
         pred, projs = self.predicate, self.projections
 
-        def step(batch: Batch) -> Batch:
+        def step(batch: Batch, params=()) -> Batch:
             trace_probe()
+            with param_scope(params):
+                return body(batch)
+
+        def body(batch: Batch) -> Batch:
             live = batch.live
             if pred is not None:
                 live = live & evaluate_predicate(pred, batch)
@@ -161,7 +171,7 @@ class FilterProjectOperator(Operator):
         # FilterProject usually runs via stream.map closures (never
         # inside a Pipeline), so the jitted-step span lives here
         with trace_span("step:filter_project", "step"):
-            return [self._step(batch)]
+            return [self._step(batch, self._params)]
 
 
 # ---------------------------------------------------------------------------
@@ -224,9 +234,11 @@ class HashAggregationOperator(Operator):
         strategy: DirectStrategy | SortStrategy,
         phase: str = "single",  # single | partial | final
         passengers: Sequence[tuple[str, Expr]] = (),
+        params: Sequence[Any] = (),
     ):
         from presto_tpu.cache.exec_cache import EXEC_CACHE
 
+        self._params = tuple(params)
         self.group_keys = list(group_keys)
         self.aggs = list(aggs)
         self.strategy = strategy
@@ -347,7 +359,13 @@ class HashAggregationOperator(Operator):
 
     # -- direct-addressed path -------------------------------------------
 
-    def _direct_update(self, state, batch: Batch):
+    def _direct_update(self, state, batch: Batch, params=()):
+        # traced entry: the params argument shadows the executor's
+        # concrete param scope with this trace's tracers (expr.Param)
+        with param_scope(params):
+            return self._direct_update_impl(state, batch)
+
+    def _direct_update_impl(self, state, batch: Batch):
         """One-pass direct-addressed update.
 
         All integer sums, every per-aggregate count, and group presence
@@ -447,7 +465,11 @@ class HashAggregationOperator(Operator):
 
     # -- sort-merge path ---------------------------------------------------
 
-    def _sort_update(self, state, batch: Batch):
+    def _sort_update(self, state, batch: Batch, params=()):
+        with param_scope(params):
+            return self._sort_update_impl(state, batch)
+
+    def _sort_update_impl(self, state, batch: Batch):
         """Fold a batch into the state by concatenating the state rows
         (as a pseudo-batch) with the batch's rows, then re-grouping —
         bounded memory, one multi-key sort per batch."""
@@ -571,7 +593,7 @@ class HashAggregationOperator(Operator):
         # the carrier hands back the dictionaries THIS trace signature
         # saw (correct even when jit's signature cache skipped the
         # body — the output treedef is stored per signature)
-        self.state, carrier = self._update(self.state, batch)
+        self.state, carrier = self._update(self.state, batch, self._params)
         self._dicts = {n: c.dictionary for n, c in carrier.items()}
         return []
 
@@ -658,9 +680,11 @@ def _phys_dtype(a: AggSpec):
 class GlobalAggregationOperator(Operator):
     """Aggregation without GROUP BY (reference: AggregationOperator)."""
 
-    def __init__(self, aggs: Sequence[AggSpec], phase: str = "single"):
+    def __init__(self, aggs: Sequence[AggSpec], phase: str = "single",
+                 params: Sequence[Any] = ()):
         from presto_tpu.cache.exec_cache import EXEC_CACHE
 
+        self._params = tuple(params)
         self.aggs = list(aggs)
         self.phase = phase
         self.state = None
@@ -679,7 +703,11 @@ class GlobalAggregationOperator(Operator):
         tmpl.state = None
         return jax.jit(tmpl._step)
 
-    def _step(self, state, batch: Batch):
+    def _step(self, state, batch: Batch, params=()):
+        with param_scope(params):
+            return self._step_impl(state, batch)
+
+    def _step_impl(self, state, batch: Batch):
         from presto_tpu.cache.exec_cache import trace_probe
 
         trace_probe()
@@ -734,7 +762,7 @@ class GlobalAggregationOperator(Operator):
     def process(self, batch: Batch) -> list[Batch]:
         if self.state is None:
             self.state = self._init()
-        self.state = self._update(self.state, batch)
+        self.state = self._update(self.state, batch, self._params)
         return []
 
     def finish(self) -> list[Batch]:
@@ -941,8 +969,10 @@ class WindowOperator(CollectingOperator):
         order_keys: Sequence[SortKey],
         funcs: Sequence[AggSpec],
         frame: str = "range",
+        params: Sequence[Any] = (),
     ):
         super().__init__()
+        self._params = tuple(params)
         self.partition_by = list(partition_by)
         self.order_keys = list(order_keys)
         self.funcs = list(funcs)
@@ -1004,8 +1034,12 @@ class WindowOperator(CollectingOperator):
                 return bytes_sort_chunks(v.data)
             return [sortable(v)]
 
-        def step(batch: Batch) -> Batch:
+        def step(batch: Batch, params=()) -> Batch:
             trace_probe()
+            with param_scope(params):
+                return body(batch)
+
+        def body(batch: Batch) -> Batch:
             cap = batch.capacity
             # ---- sort keys: partition keys (nulls as a group), then
             # order keys with SQL null placement
@@ -1141,10 +1175,10 @@ class WindowOperator(CollectingOperator):
     def finish(self) -> list[Batch]:
         if not self.batches:
             return []
-        return [self._step(concat_batches(self.batches))]
+        return [self._step(concat_batches(self.batches), self._params)]
 
 
-def window_operator_from_node(node, scalars) -> WindowOperator:
+def window_operator_from_node(node, scalars, params=()) -> WindowOperator:
     """Lower an ``N.Window`` plan node to a WindowOperator (shared by
     the local and distributed executors)."""
     from presto_tpu.expr import bind_scalars
@@ -1160,7 +1194,7 @@ def window_operator_from_node(node, scalars) -> WindowOperator:
                 f.name, f.dtype, offset=f.offset)
         for f in node.funcs
     ]
-    return WindowOperator(part, keys, aggs, node.frame)
+    return WindowOperator(part, keys, aggs, node.frame, params=params)
 
 
 class LimitOperator(Operator):

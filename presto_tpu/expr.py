@@ -22,8 +22,10 @@ chain *is* the per-query compiler. Two idioms matter:
 
 from __future__ import annotations
 
+from contextlib import contextmanager
+from contextvars import ContextVar
 from dataclasses import dataclass, field
-from typing import Any, Callable, Sequence
+from typing import Any, Callable, Optional, Sequence
 
 import jax.numpy as jnp
 import numpy as np
@@ -87,6 +89,46 @@ class Call(Expr):
 
     def __str__(self) -> str:
         return f"{self.fn}({', '.join(map(str, self.args))})"
+
+
+@dataclass(frozen=True)
+class Param(Expr):
+    """A typed literal slot (plan-template parameterization): the VALUE
+    lives outside the expression tree and arrives at evaluation time
+    through the ambient parameter scope (:func:`param_scope`). Two
+    queries differing only in literals share one Param-bearing plan
+    *template*, so every content-keyed cache (compiled executables,
+    jit signatures) hits across the differing constants. Hashes by
+    (slot, dtype) — never by value — which is exactly what makes the
+    template the cache identity."""
+
+    slot: int = 0
+
+    def __str__(self) -> str:
+        return f"?{self.slot}"
+
+
+#: the ambient parameter-slot values. Two nesting levels cooperate:
+#: executors install the CONCRETE device scalars for the whole plan run
+#: (eager evaluation sites — sort keys, runtime min/max probes, spill
+#: bucketing — read them directly), and every traced step body shadows
+#: them with its own TRACED params argument for the duration of the
+#: trace, so compiled programs close over tracers, never over one
+#: binding's constants (which a jit signature-cache hit would silently
+#: replay for the next binding).
+_PARAM_VALUES: ContextVar[Optional[tuple]] = ContextVar(
+    "presto_tpu_param_values", default=None
+)
+
+
+@contextmanager
+def param_scope(values):
+    """Install parameter-slot values for evaluate() (see _PARAM_VALUES)."""
+    token = _PARAM_VALUES.set(tuple(values) if values is not None else None)
+    try:
+        yield
+    finally:
+        _PARAM_VALUES.reset(token)
 
 
 @dataclass(frozen=True)
@@ -1515,6 +1557,18 @@ def evaluate(expr: Expr, batch: Batch) -> Val:
     if isinstance(expr, InputRef):
         c = batch[expr.name]
         return Val(c.data, c.valid, c.dtype, c.dictionary)
+    if isinstance(expr, Param):
+        vals = _PARAM_VALUES.get()
+        if vals is None or expr.slot >= len(vals):
+            raise KeyError(
+                f"unbound literal slot ?{expr.slot}: evaluation outside a "
+                "param_scope (executor run scope or traced step body)"
+            )
+        cap = batch.capacity
+        data = jnp.broadcast_to(
+            jnp.asarray(vals[expr.slot], expr.dtype.jnp_dtype), (cap,)
+        )
+        return Val(data, jnp.ones(cap, dtype=jnp.bool_), expr.dtype)
     if isinstance(expr, Literal):
         cap = batch.capacity
         if expr.value is None:

@@ -44,7 +44,9 @@ listeners get their own context).
 
 from __future__ import annotations
 
+import threading
 import time
+from contextlib import contextmanager
 from contextvars import ContextVar
 from dataclasses import dataclass
 from typing import Callable, Optional
@@ -211,6 +213,106 @@ def peak_estimate_bytes(plan, catalog) -> tuple[int, str]:
     return worst, worst_node
 
 
+class _InflightEntry:
+    """One in-flight execution other submissions can coalesce onto."""
+
+    __slots__ = ("event", "df", "ok", "waiters")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.df = None
+        self.ok = False
+        self.waiters = 0
+
+
+class InflightCoalescer:
+    """Cross-query batching, first rung: concurrent IDENTICAL queries
+    (same binding fingerprint) coalesce onto one execution — followers
+    wait for the leader's result instead of racing N duplicate device
+    dispatches — and concurrent same-TEMPLATE different-literal queries
+    serialize behind the single warm executable (one trace+compile,
+    then back-to-back signature-cache hits) instead of racing N
+    identical traces through jit's internal locks.
+
+    The Session gates entry exactly like result-cache admission
+    (deterministic plans, no fault injector, no stats recorder), so a
+    follower's answer is always what its own execution would have
+    produced. Leaders publish in a ``finally``: a failed leader wakes
+    followers with no result and each falls through to executing
+    itself — coalescing can batch work, never failures."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._inflight: dict[str, _InflightEntry] = {}
+        #: template fingerprint -> [lock, refcount]
+        self._tlocks: dict[str, list] = {}
+
+    def lead_or_wait(self, key: str, timeout_s: float | None = None):
+        """Returns ``(True, entry)`` for the leader (MUST ``publish``
+        the entry in a finally), or ``(False, df_or_None)`` for a
+        follower — the leader's result, or None when the leader failed
+        / the wait timed out (the caller then executes itself)."""
+        with self._lock:
+            entry = self._inflight.get(key)
+            if entry is None:
+                entry = _InflightEntry()
+                self._inflight[key] = entry
+                return True, entry
+            entry.waiters += 1
+        try:
+            served = entry.event.wait(timeout_s)
+        finally:
+            with self._lock:
+                entry.waiters -= 1
+        if served and entry.ok:
+            # per-follower defensive copy: N coalesced submissions must
+            # not alias one frame (mutating one result would corrupt
+            # the others — the result-cache convention applies here too)
+            return False, entry.df.copy(deep=True)
+        return False, None
+
+    def publish(self, key: str, entry: _InflightEntry, df) -> None:
+        """Finish an in-flight execution: store a defensive copy of the
+        result (None on failure) and wake every waiter. The key is
+        retired first, so late arrivals lead a fresh execution instead
+        of reading a result whose table versions may have moved."""
+        with self._lock:
+            self._inflight.pop(key, None)
+        if df is not None:
+            entry.df = df.copy(deep=True)
+            entry.ok = True
+        entry.event.set()
+
+    def waiters(self, key: str) -> int:
+        """Current follower count for an in-flight key (tests/metrics)."""
+        with self._lock:
+            entry = self._inflight.get(key)
+            return 0 if entry is None else entry.waiters
+
+    @contextmanager
+    def template_slot(self, template_key: str):
+        """Serialize executions of one plan template: the first binding
+        traces+compiles, queued bindings then run warm. Slots are
+        refcounted so the map stays bounded by in-flight templates."""
+        with self._lock:
+            slot = self._tlocks.get(template_key)
+            if slot is None:
+                slot = self._tlocks[template_key] = [threading.Lock(), 0]
+            slot[1] += 1
+        queued = not slot[0].acquire(blocking=False)
+        if queued:
+            REGISTRY.counter("prepare.template_queued").add()
+            slot[0].acquire()
+        try:
+            yield
+        finally:
+            slot[0].release()
+            with self._lock:
+                slot[1] -= 1
+                if slot[1] == 0:
+                    self._tlocks.pop(template_key, None)
+
+
 class QueryManager:
     """Owns one session's query lifecycle mechanics (the Session keeps
     the client surface and the QUEUED/RUNNING/FINISHED state machine;
@@ -218,6 +320,9 @@ class QueryManager:
 
     def __init__(self, session):
         self.session = session
+        #: in-flight query coalescing (plan-template parameterization's
+        #: cross-query batching rung; see InflightCoalescer)
+        self.coalescer = InflightCoalescer()
 
     # -- admission ------------------------------------------------------
     def admission_limit(self) -> int:
@@ -408,10 +513,11 @@ class QueryManager:
                     and getattr(executor, "mesh", None) is not None
                     and self.session.prop("degrade_to_local")
                 ):
-                    return self._degrade(plan, info, recorder, ctx)
+                    return self._degrade(plan, info, recorder, ctx,
+                                         getattr(executor, "params", ()))
                 raise
 
-    def _degrade(self, plan, info, recorder, ctx):
+    def _degrade(self, plan, info, recorder, ctx, params=()):
         """Re-plan a failed distributed query onto the single-device
         local pipeline (graceful degradation; the deadline keeps
         running — the retry context stays installed, and if the local
@@ -439,6 +545,9 @@ class QueryManager:
             # fresh recorder per attempt
             recorder.nodes.clear()
         local.recorder = recorder
+        # the literal-slot binding travels with the plan: the degraded
+        # run evaluates the same Param slots the distributed one did
+        local.params = tuple(params)
         with trace_span("degrade_to_local", "lifecycle"):
             return self._run_with_oom_ladder(local, plan, info, recorder,
                                              ctx)

@@ -121,6 +121,19 @@ SESSION_PROPERTIES: dict[str, PropertyDef] = {
             "agg_strategy=partial.",
         ),
         PropertyDef(
+            "plan_templates", bool, True,
+            "Plan-template parameterization: eligible literals are "
+            "lifted out of traced programs into runtime scalar slots, "
+            "so queries differing only in constants share ONE compiled "
+            "executable (zero warm re-traces across bindings), and "
+            "concurrent identical queries coalesce onto one in-flight "
+            "execution. Bit-identical results on or off — NOT a "
+            "codegen property; the result cache keys on the full "
+            "literal binding either way. Literals that prove kernel "
+            "admission (leaf-route spec bounds, LIMIT shapes) stay "
+            "baked, counted under prepare.slot_ineligible.*.",
+        ),
+        PropertyDef(
             "collect_node_stats", bool, False,
             "Record per-plan-node wall time and output rows on every "
             "query (the EXPLAIN ANALYZE recorder, always on).",

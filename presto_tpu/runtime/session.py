@@ -17,6 +17,7 @@ its own query history and the process metrics registry.
 from __future__ import annotations
 
 import itertools
+import threading
 import time
 import uuid
 from typing import Mapping, Optional
@@ -40,6 +41,24 @@ from presto_tpu.sql.analyzer import Analyzer
 from presto_tpu.sql.parser import parse
 
 _query_seq = itertools.count(1)
+
+
+def _ast_literal_value(node):
+    """EXECUTE ... USING argument -> logical Python value (literals
+    only — parameters are values, not expressions)."""
+    from presto_tpu.sql import ast as A
+
+    if isinstance(node, A.NumberLit):
+        return float(node.text) if "." in node.text else int(node.text)
+    if isinstance(node, A.StringLit):
+        return node.value
+    if isinstance(node, (A.DateLit, A.TimestampLit)):
+        return node.value  # ISO strings; DataType.to_physical parses
+    if isinstance(node, A.UnaryOp) and node.op == "-":
+        return -_ast_literal_value(node.operand)
+    raise UserError(
+        "EXECUTE ... USING arguments must be literals"
+    )
 
 
 class Session:
@@ -112,6 +131,19 @@ class Session:
         self.catalog.add_invalidation_listener(
             self.plan_stats.invalidate_table
         )
+        #: prepared statements (PREPARE name FROM ... / Session.prepare)
+        self._prepared: dict[str, object] = {}
+        #: plan templates this session has executed at least once —
+        #: the query_history ``template_hit`` column's ground truth.
+        #: LRU-bounded: a long-lived serving session over unbounded
+        #: distinct statements must not grow it forever (evicting a
+        #: template only re-marks its NEXT run a miss — observability,
+        #: never correctness)
+        from collections import OrderedDict
+
+        self._seen_templates: "OrderedDict[str, None]" = OrderedDict()
+        self._seen_templates_limit = 4096
+        self._tmpl_lock = threading.Lock()
         # every memory-connector write (CTAS store / INSERT commit /
         # DROP) bumps the catalog version even when issued through the
         # Python API rather than SQL DDL — stale metadata or cached
@@ -256,14 +288,40 @@ class Session:
                 "DDL statements execute via Session.sql(), not plan()/explain()"
             )
         logical = self.analyzer.analyze(ast)
+        if self.analyzer.param_types:
+            # catch the unbindable plan at PLAN time: executing it would
+            # surface as a KeyError deep inside a traced step (and then
+            # be pointlessly retried)
+            raise UserError(
+                "query contains ? parameters; PREPARE it and EXECUTE "
+                "... USING (or Session.prepare/execute)"
+            )
         return prune(logical)
 
     def explain(self, sql: str) -> str:
-        plan = self.plan(sql)
-        return plan_tree_str(plan, catalog=self.catalog,
-                             approx_join=bool(self.prop("approx_join")),
-                             plan_hints=self._plan_hints(plan),
-                             agg_bypass=bool(self.prop("partial_agg_bypass")))
+        """EXPLAIN rendering. With ``plan_templates`` on, the plan is
+        rendered as its TEMPLATE — exprs show ``?N`` slots — followed by
+        a ``params=[...]`` line binding each slot to this statement's
+        literal (the prepared-statement view of the query)."""
+        from presto_tpu.sql import ast as A
+
+        stmt = parse(sql)
+        if isinstance(stmt, (A.CreateTableAs, A.InsertInto, A.DropTable,
+                             A.Prepare, A.ExecuteStmt, A.Deallocate)):
+            raise UserError(
+                "DDL statements execute via Session.sql(), not plan()/explain()"
+            )
+        plan, bound = self._plan_binding(stmt)
+        out = plan_tree_str(plan, catalog=self.catalog,
+                            approx_join=bool(self.prop("approx_join")),
+                            plan_hints=self._plan_hints(plan),
+                            agg_bypass=bool(self.prop("partial_agg_bypass")))
+        if bound:
+            rendered = ", ".join(
+                f"?{i}={dt}:{v!r}" for i, (dt, v) in enumerate(bound)
+            )
+            out += f"params=[{rendered}]\n"
+        return out
 
     def explain_distributed(self, sql: str) -> str:
         """Fragment/exchange rendering (reference: EXPLAIN (TYPE
@@ -305,21 +363,103 @@ class Session:
     def sql(self, sql: str):
         """Execute and return a pandas DataFrame. DDL/DML statements
         (CREATE TABLE AS / INSERT INTO / DROP TABLE) return a one-row
-        summary frame."""
+        summary frame; PREPARE / EXECUTE ... USING / DEALLOCATE PREPARE
+        drive the prepared-statement surface."""
+        import pandas as pd
+
         from presto_tpu.sql import ast as A
 
         t0 = time.perf_counter()
         stmt = parse(sql)
+        if isinstance(stmt, A.Prepare):
+            self._prepared[stmt.name] = self._prepare_ast(
+                stmt.name, sql, stmt.statement)
+            return pd.DataFrame({"prepared": [stmt.name]})
+        if isinstance(stmt, A.ExecuteStmt):
+            df, _info = self.execute_prepared(
+                stmt.name, [_ast_literal_value(a) for a in stmt.args],
+                planning_s=time.perf_counter() - t0,
+            )
+            return df
+        if isinstance(stmt, A.Deallocate):
+            if self._prepared.pop(stmt.name, None) is None:
+                raise UserError(f"prepared statement not found: {stmt.name}")
+            return pd.DataFrame({"deallocated": [stmt.name]})
         if isinstance(stmt, (A.CreateTableAs, A.InsertInto, A.DropTable)):
             return self._run_ddl(sql, stmt)
         want = bool(self.prop("collect_node_stats"))
-        plan = prune(self.analyzer.analyze(stmt))
+        plan, bound = self._plan_binding(stmt, parameterize=not want)
         planning_s = time.perf_counter() - t0
         df, _info = self._run_with_retries(
             sql, plan, (lambda: StatsRecorder()) if want else (lambda: None),
-            planning_s=planning_s,
+            planning_s=planning_s, bound=bound,
         )
         return df
+
+    # ---- prepared statements / plan templates ------------------------
+    def _plan_binding(self, stmt, parameterize: bool = True):
+        """Analyze + prune + (when ``plan_templates`` is on)
+        auto-parameterize one statement: returns ``(plan, bound)``
+        where ``bound`` is the slot-ordered (dtype, logical value)
+        binding the statement's own literals supply. A raw statement
+        containing explicit ``?`` placeholders has no values to bind —
+        PREPARE it instead."""
+        plan = prune(self.analyzer.analyze(stmt))
+        if self.analyzer.param_types:
+            raise UserError(
+                "query contains ? parameters; PREPARE it and EXECUTE "
+                "... USING (or Session.prepare/execute)"
+            )
+        if not (parameterize and self.prop("plan_templates")):
+            return plan, ()
+        from presto_tpu.plan.templates import parameterize_plan
+
+        plan, slots = parameterize_plan(plan, self.catalog)
+        return plan, tuple((s.dtype, s.value) for s in slots)
+
+    def _prepare_ast(self, name: str, sql: str, stmt):
+        from presto_tpu.plan.templates import (
+            PreparedStatement,
+            parameterize_plan,
+        )
+        from presto_tpu.sql import ast as A
+
+        if not isinstance(stmt, (A.Query, A.SetQuery)):
+            raise UserError("only queries can be prepared")
+        plan = prune(self.analyzer.analyze(stmt))
+        user = tuple(sorted(self.analyzer.param_types.items()))
+        auto = ()
+        if self.prop("plan_templates"):
+            plan, auto = parameterize_plan(plan, self.catalog,
+                                           start_slot=len(user))
+        return PreparedStatement(name, sql, plan, user, auto)
+
+    def prepare(self, sql: str, name: Optional[str] = None):
+        """Prepare a query into a plan-template handle: eligible
+        literals (and explicit ``?`` placeholders) become typed slots,
+        and every ``execute(handle, params)`` binding reuses ONE
+        compiled executable — zero re-traces across bindings."""
+        stmt = parse(sql)
+        handle = self._prepare_ast(name or f"stmt_{len(self._prepared)}",
+                                   sql, stmt)
+        self._prepared[handle.name] = handle
+        return handle
+
+    def execute_prepared(self, handle, params=(), planning_s: float = 0.0):
+        """Execute a prepared handle (or its registered name) with
+        positional ``?`` bindings; returns (DataFrame, QueryInfo)."""
+        from presto_tpu.plan.templates import PreparedStatement
+
+        if not isinstance(handle, PreparedStatement):
+            h = self._prepared.get(handle)
+            if h is None:
+                raise UserError(f"prepared statement not found: {handle}")
+            handle = h
+        bound = handle.bind(list(params))
+        return self._run_with_retries(
+            handle.sql, handle.plan, lambda: None,
+            planning_s=planning_s, bound=bound,
+        )
 
     def _owning_catalog(self, table: str):
         for cname, conn in self.catalog.connectors.items():
@@ -370,10 +510,10 @@ class Session:
                     "is read-only"
                 )
         t0 = time.perf_counter()
-        plan = prune(self.analyzer.analyze(stmt.query))
+        plan, bound = self._plan_binding(stmt.query)
         planning_s = time.perf_counter() - t0
         df, _info = self._run_with_retries(sql, plan, lambda: None,
-                                           planning_s=planning_s)
+                                           planning_s=planning_s, bound=bound)
         if isinstance(stmt, A.CreateTableAs):
             rows = mem.create_table(stmt.name, df)
         else:
@@ -382,8 +522,14 @@ class Session:
             self.catalog.invalidate(stmt.name)  # see the drop path
         return pd.DataFrame({"rows": [rows]})
 
-    def execute(self, sql: str):
-        """Execute returning (DataFrame, QueryInfo)."""
+    def execute(self, sql, params=None):
+        """Execute returning (DataFrame, QueryInfo). With a
+        ``PreparedStatement`` handle (or a registered name) plus
+        ``params``, runs the prepared template with those bindings."""
+        from presto_tpu.plan.templates import PreparedStatement
+
+        if isinstance(sql, PreparedStatement) or params is not None:
+            return self.execute_prepared(sql, params or ())
         t0 = time.perf_counter()
         plan = self.plan(sql)
         planning_s = time.perf_counter() - t0
@@ -391,7 +537,7 @@ class Session:
                                       planning_s=planning_s)
 
     def _run_with_retries(self, sql: str, plan, make_recorder,
-                          planning_s: float = 0.0):
+                          planning_s: float = 0.0, bound=()):
         """The engine's whole failure-recovery posture, like the
         reference's: no mid-query recovery — a failed attempt fails the
         query, and recovery is re-running it from the top
@@ -402,7 +548,7 @@ class Session:
         for attempt in range(retries + 1):
             try:
                 return self._run_tracked(sql, plan, make_recorder(),
-                                         planning_s=planning_s)
+                                         planning_s=planning_s, bound=bound)
             except Exception:
                 if attempt == retries:
                     raise
@@ -410,9 +556,11 @@ class Session:
 
     # ------------------------------------------------------------------
     def _run_tracked(self, sql: str, plan: PlanNode, recorder,
-                     planning_s: float = 0.0):
+                     planning_s: float = 0.0, bound=()):
         """Track one execution attempt: QueryInfo lifecycle, span trace
-        (when ``trace_enabled``), result-cache lookup, events."""
+        (when ``trace_enabled``), result-cache lookup, events.
+        ``bound`` is the plan template's slot-ordered (dtype, value)
+        literal binding (empty for unparameterized plans)."""
         info = QueryInfo(
             query_id=f"q_{next(_query_seq)}_{uuid.uuid4().hex[:8]}",
             sql=sql,
@@ -433,13 +581,15 @@ class Session:
             token = trace.install(tracer)
         try:
             with trace.span("query", "query", {"query_id": info.query_id}):
-                return self._run_tracked_inner(sql, plan, recorder, info)
+                return self._run_tracked_inner(sql, plan, recorder, info,
+                                               bound=bound)
         finally:
             if tracer is not None:
                 trace.uninstall(token)
                 self.traces.add(tracer)
 
-    def _run_tracked_inner(self, sql: str, plan: PlanNode, recorder, info):
+    def _run_tracked_inner(self, sql: str, plan: PlanNode, recorder, info,
+                           bound=()):
         self.query_history.append(info)
         REGISTRY.counter("query.started").add()
         self.events.query_created(info)
@@ -450,26 +600,53 @@ class Session:
             # deterministic pre-order plan-node ids (trace spans and
             # NodeStats correlate on them)
             recorder.attach_plan(plan)
-        # ---- versioned result cache (cache/result_cache.py) ----------
-        # the fingerprint folds in plan content, referenced-table
-        # catalog versions, mesh shape, and codegen session properties;
-        # admission excludes volatile plans and fault-injected runs.
-        # Failed queries never populate: the put sits on the FINISHED
-        # path only.
         from presto_tpu.cache.fingerprint import (
             plan_fingerprint,
             table_versions,
+            try_fingerprint,
         )
         from presto_tpu.cache.result_cache import ResultCache
+        from presto_tpu.plan.templates import device_params, logical_values
 
+        # ---- binding identity (plan/templates.py) --------------------
+        # Two fingerprints with distinct jobs: the plan TEMPLATE's
+        # fingerprint (Param slots hash by id + type, never value) is
+        # the trace/compile identity — template-hit tracking and the
+        # in-flight coalescer's serialization key; the full BINDING
+        # fingerprint (template + this query's literal values) keys the
+        # result cache and plan stats. Compile work is shared across
+        # bindings; results never are.
+        values = logical_values(bound) if bound else ()
+        admissible = ResultCache.admissible(plan, self.catalog)
+        cache_ok = bool(self.prop("result_cache_enabled")) and admissible
+        templates_on = bool(self.prop("plan_templates")) and recorder is None
+        base_fp = None
+        if cache_ok or templates_on:
+            base_fp = plan_fingerprint(plan, self.catalog, self.properties,
+                                       self.mesh)
         fp = None
-        if self.prop("result_cache_enabled") and ResultCache.admissible(
-            plan, self.catalog
-        ):
+        if base_fp is not None:
+            fp = (try_fingerprint(("binding", base_fp, values))
+                  if bound else base_fp)
+        if templates_on and base_fp is not None:
+            with self._tmpl_lock:
+                info.template_hit = base_fp in self._seen_templates
+                self._seen_templates[base_fp] = None
+                self._seen_templates.move_to_end(base_fp)
+                while len(self._seen_templates) > self._seen_templates_limit:
+                    self._seen_templates.popitem(last=False)
+            REGISTRY.counter(
+                "prepare.template_hit" if info.template_hit
+                else "prepare.template_miss").add()
+        # ---- versioned result cache (cache/result_cache.py) ----------
+        # the binding fingerprint folds in plan-template content,
+        # referenced-table catalog versions, mesh shape, codegen
+        # session properties, AND the full literal values; admission
+        # excludes volatile plans and fault-injected runs. Failed
+        # queries never populate: the put sits on the FINISHED path.
+        if cache_ok and fp is not None:
             with trace.span("result_cache:lookup", "cache") as sp, \
                     REGISTRY.histogram("cache.result_lookup_s").time():
-                fp = plan_fingerprint(plan, self.catalog, self.properties,
-                                      self.mesh)
                 hit = self.result_cache.get_entry(fp, self.catalog)
                 cached = None if hit is None else hit[0]
                 if sp is not None:
@@ -510,37 +687,86 @@ class Session:
                     plan_hints=hints,
                     agg_bypass=bool(self.prop("partial_agg_bypass")),
                 )
-        executor = self._make_executor()
-        executor.recorder = recorder
-        executor.plan_hints = hints
-        executor.agg_bypass = bool(self.prop("partial_agg_bypass"))
-        # counters bumped AFTER run_plan returns (query.completed,
-        # result-cache populate, plan-stats record, completion events)
-        # land in an explicit ``post_run.`` metric bucket — closing the
-        # attribution gap run_plan's delta scope cannot see
-        from presto_tpu.runtime.metrics import (
-            QueryMetricsDelta,
-            install_delta,
-            uninstall_delta,
-        )
-
-        post = QueryMetricsDelta()
+        # ---- in-flight coalescing (lifecycle.InflightCoalescer) ------
+        # identical concurrent queries (same binding fp) dedupe onto
+        # one execution; same-template different-literal queries queue
+        # behind the single warm executable via the template slot.
+        # Gated by the result-cache admission rules (deterministic
+        # plans, no fault injector): a follower's answer is always what
+        # its own execution would have produced.
+        entry = None
+        if templates_on and admissible and fp is not None:
+            wait_s = (self.prop("query_max_run_time")
+                      or self.prop("admission_queue_timeout_s"))
+            lead, payload = self.query_manager.coalescer.lead_or_wait(
+                fp, wait_s)
+            if lead:
+                entry = payload
+            elif payload is not None:
+                info.state = "FINISHED"
+                info.coalesced = True
+                info.output_rows = len(payload)
+                info.finished_at = time.time()
+                info.finished_mono = time.monotonic()
+                REGISTRY.counter("prepare.coalesced").add()
+                REGISTRY.counter("query.completed").add()
+                self.events.query_completed(info)
+                return payload, info
+            # else: the leader failed or the wait timed out — fall
+            # through and execute this query ourselves (uncoalesced)
         try:
+            executor = self._make_executor()
+            executor.recorder = recorder
+            executor.plan_hints = hints
+            executor.agg_bypass = bool(self.prop("partial_agg_bypass"))
+            #: the literal binding as device scalars, threaded through
+            #: every jitted step (plan/templates.py; expr.param_scope)
+            executor.params = device_params(bound) if bound else ()
+            # counters bumped AFTER run_plan returns (query.completed,
+            # result-cache populate, plan-stats record, completion
+            # events) land in an explicit ``post_run.`` metric bucket —
+            # closing the attribution gap run_plan's delta scope cannot
+            # see
+            import contextlib
+
+            from presto_tpu.runtime.metrics import (
+                QueryMetricsDelta,
+                install_delta,
+                uninstall_delta,
+            )
+
+            post = QueryMetricsDelta()
+        except BaseException:
+            # a failure BEFORE the publishing try/finally below (e.g.
+            # executor construction) must still retire the in-flight
+            # entry, or every later identical query blocks the full
+            # coalesce wait on a key nobody will ever publish
+            if entry is not None:
+                self.query_manager.coalescer.publish(fp, entry, None)
+            raise
+        published = None  # the leader's successful result, for waiters
+        try:
+            # same-template serialization: first binding compiles, the
+            # rest run warm back to back (leaders only; identical-fp
+            # followers wait on the entry event, not this lock)
+            slot_cm = (
+                self.query_manager.coalescer.template_slot(base_fp)
+                if entry is not None and bound and base_fp is not None
+                else contextlib.nullcontext()
+            )
             # the query.execution_s histogram is timed inside run_plan
             # AFTER admission, so pool queue wait lands in queued_s /
             # memory.queued_s, never in execution percentiles
-            with self._profiled():
+            with self._profiled(), slot_cm:
                 df = self.query_manager.run_plan(executor, plan, info,
                                                  recorder)
+            published = df
             token = install_delta(post)
             try:
                 info.state = "FINISHED"
                 info.output_rows = len(df)
                 REGISTRY.counter("query.completed").add()
-                # fp is only non-None when admission passed at lookup,
-                # and nothing in this synchronous path can change
-                # admissibility
-                if fp is not None:
+                if cache_ok and fp is not None:
                     with trace.span("result_cache:populate", "cache"):
                         self.result_cache.put(
                             fp, df, table_versions(plan, self.catalog),
@@ -562,6 +788,11 @@ class Session:
                 uninstall_delta(token)
             raise
         finally:
+            if entry is not None:
+                # wake identical-query followers with the result (or,
+                # on failure, with nothing — each then runs itself:
+                # coalescing batches work, never failures)
+                self.query_manager.coalescer.publish(fp, entry, published)
             info.finished_at = time.time()
             info.finished_mono = time.monotonic()
             token = install_delta(post)

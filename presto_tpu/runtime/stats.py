@@ -382,6 +382,13 @@ class QueryInfo:
     #: True when the result was served from the versioned result cache
     #: (no execution happened; node_stats stay empty)
     cache_hit: bool = False
+    #: True when this query's plan TEMPLATE (literal slots in place of
+    #: values) had already executed in this session — the compiled
+    #: executable was warm regardless of the literal binding
+    template_hit: bool = False
+    #: True when this query coalesced onto a concurrent identical
+    #: in-flight execution (one device dispatch served N submissions)
+    coalesced: bool = False
     #: True when the run probed an APPROXIMATE join sketch (the
     #: ``approx_join`` session property routed a semi join through the
     #: Bloom sketch): the result may contain false-positive rows.
@@ -476,6 +483,8 @@ class QueryInfo:
                 "memoryQueuedS": round(self.memory_queued_s, 6),
                 "memoryReservedBytes": self.memory_reserved_bytes,
                 "cacheHit": self.cache_hit,
+                "templateHit": self.template_hit,
+                "coalesced": self.coalesced,
                 "approximate": self.approximate,
                 "outputRows": self.output_rows,
                 "nodeStats": self.node_stats,

@@ -284,6 +284,10 @@ class Analyzer:
     def __init__(self, catalog: Catalog):
         self.catalog = catalog
         self._uniq = 0
+        #: ``?`` placeholder types inferred during the last analyze()
+        #: (ordinal -> DataType); Session.prepare reads them to build
+        #: the prepared statement's user-slot layout
+        self.param_types: dict[int, "DataType"] = {}
 
     # ------------------------------------------------------------------
     def fresh(self, base: str) -> str:
@@ -294,10 +298,35 @@ class Analyzer:
         # the gensym counter restarts per statement: names need only be
         # unique WITHIN one plan, and a session-lifetime counter would
         # make identical SQL produce alpha-equivalent-but-unequal plans
-        # — defeating every content-keyed cache (cache/fingerprint.py)
+        # — defeating every content-keyed cache (cache/fingerprint.py).
+        # The placeholder-type map restarts with it (slot ids are
+        # per-statement lexical ordinals, like gensyms).
         self._uniq = 0
+        self.param_types = {}
         plan, _scope = self._analyze_any(query, outer=None, ctes={})
         return plan
+
+    def _param(self, ph: "A.Placeholder", dtype) -> Expr:
+        """Type one ``?`` placeholder from its context and lower it to
+        an ``expr.Param`` slot (slot id == lexical ordinal). A
+        placeholder reached through two conflicting typed contexts is
+        rejected — a silently coerced parameter would bind wrongly."""
+        from presto_tpu.expr import Param
+
+        if dtype.kind in (TypeKind.VARCHAR, TypeKind.BYTES):
+            raise AnalysisError(
+                "string parameters are not supported (dictionary "
+                "encoding is a trace-time decision); inline the literal"
+            )
+        dtype = dtype.canonical()
+        seen = self.param_types.get(ph.ordinal)
+        if seen is not None and seen != dtype:
+            raise AnalysisError(
+                f"parameter ?{ph.ordinal + 1} used with conflicting "
+                f"types {seen} and {dtype}"
+            )
+        self.param_types[ph.ordinal] = dtype
+        return Param(dtype, ph.ordinal)
 
     def _analyze_any(
         self, q: A.Node, outer: Scope | None, ctes: dict
@@ -2059,7 +2088,32 @@ class Analyzer:
             from presto_tpu.types import TIMESTAMP
 
             return Literal(TIMESTAMP, TIMESTAMP.to_physical(n.value))
+        if isinstance(n, A.Placeholder):
+            raise AnalysisError(
+                f"cannot infer the type of parameter ?{n.ordinal + 1}: use "
+                "it in a comparison or arithmetic with a typed operand"
+            )
         if isinstance(n, A.BinaryOp):
+            # placeholder typing: one side a ``?``, the other typed —
+            # the parameter takes the typed side's type (the reference's
+            # parameter-type-inference rule, narrowed to the contexts
+            # this dialect supports)
+            l_ph = isinstance(n.left, A.Placeholder)
+            r_ph = isinstance(n.right, A.Placeholder)
+            if (l_ph or r_ph) and n.op in (_CMP_OPS | _ARITH_OPS):
+                if l_ph and r_ph:
+                    raise AnalysisError(
+                        "cannot infer parameter types: both comparison "
+                        "sides are ?")
+                typed = self._expr(n.right if l_ph else n.left, scope, outer,
+                                   ctes, scalar_binds, agg_map, key_map)
+                ph = self._param(n.left if l_ph else n.right, typed.dtype)
+                l, r = (ph, typed) if l_ph else (typed, ph)
+                if n.op in _CMP_OPS:
+                    return Call(BOOLEAN, _CMP_OPS[n.op], (l, r))
+                fn = _ARITH_OPS[n.op]
+                t = result_type(fn, [l.dtype, r.dtype])
+                return Call(t, fn, (l, r))
             if n.op in ("and", "or"):
                 l = self._expr(n.left, scope, outer, ctes, scalar_binds, agg_map, key_map)
                 r = self._expr(n.right, scope, outer, ctes, scalar_binds, agg_map, key_map)
@@ -2110,14 +2164,21 @@ class Analyzer:
             return Call(v.dtype, "neg", (v,))
         if isinstance(n, A.Between):
             v = self._expr(n.value, scope, outer, ctes, scalar_binds, agg_map, key_map)
-            lo = self._expr(n.low, scope, outer, ctes, scalar_binds, agg_map, key_map)
-            hi = self._expr(n.high, scope, outer, ctes, scalar_binds, agg_map, key_map)
-            e = Call(BOOLEAN, "between", (v, lo, hi))
+
+            def bound(b):
+                if isinstance(b, A.Placeholder):
+                    return self._param(b, v.dtype)
+                return self._expr(b, scope, outer, ctes, scalar_binds,
+                                  agg_map, key_map)
+
+            e = Call(BOOLEAN, "between", (v, bound(n.low), bound(n.high)))
             return Call(BOOLEAN, "not", (e,)) if n.negated else e
         if isinstance(n, A.InList):
             v = self._expr(n.value, scope, outer, ctes, scalar_binds, agg_map, key_map)
             items = tuple(
-                self._expr(i, scope, outer, ctes, scalar_binds, agg_map, key_map)
+                self._param(i, v.dtype) if isinstance(i, A.Placeholder)
+                else self._expr(i, scope, outer, ctes, scalar_binds, agg_map,
+                                key_map)
                 for i in n.items
             )
             e = Call(BOOLEAN, "in", (v,) + items)

@@ -179,6 +179,15 @@ class Substring(Node):
     length: Optional[Node]
 
 
+@dataclass(frozen=True)
+class Placeholder(Node):
+    """A ``?`` parameter in a PREPAREd statement; ``ordinal`` is the
+    0-based lexical position. The analyzer types it from its comparison
+    /arithmetic context and lowers it to an ``expr.Param`` slot."""
+
+    ordinal: int
+
+
 # ---------------------------------------------------------------------------
 # relations & query structure
 # ---------------------------------------------------------------------------
@@ -258,6 +267,31 @@ class InsertInto(Node):
 class DropTable(Node):
     name: str
     if_exists: bool = False
+
+
+@dataclass(frozen=True)
+class Prepare(Node):
+    """PREPARE <name> FROM <statement> — store a plan template under a
+    session-scoped handle (reference: PREPARE; SURVEY §2.1 protocol)."""
+
+    name: str
+    statement: Node  # Query | SetQuery
+
+
+@dataclass(frozen=True)
+class ExecuteStmt(Node):
+    """EXECUTE <name> [USING v1, v2, ...] — run a prepared template
+    with positional parameter bindings (literals only)."""
+
+    name: str
+    args: tuple[Node, ...] = ()
+
+
+@dataclass(frozen=True)
+class Deallocate(Node):
+    """DEALLOCATE PREPARE <name> — drop a prepared handle."""
+
+    name: str
 
 
 @dataclass(frozen=True)

@@ -33,7 +33,7 @@ KEYWORDS = {
 }
 
 _TWO_CHAR_OPS = {"<=", ">=", "<>", "!=", "||"}
-_ONE_CHAR_OPS = set("+-*/%(),.;=<>")
+_ONE_CHAR_OPS = set("+-*/%(),.;=<>?")
 
 
 class LexError(ValueError):

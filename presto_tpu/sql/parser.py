@@ -32,6 +32,8 @@ class Parser:
     def __init__(self, sql: str):
         self.toks = tokenize(sql)
         self.i = 0
+        #: ``?`` placeholders seen so far (ordinals in lex order)
+        self.n_params = 0
 
     # -- token helpers ----------------------------------------------------
     @property
@@ -104,7 +106,28 @@ class Parser:
         return q
 
     def parse_statement(self) -> A.Node:
-        """Query, CREATE TABLE AS, INSERT INTO, or DROP TABLE."""
+        """Query, CREATE TABLE AS, INSERT INTO, DROP TABLE, or the
+        prepared-statement surface (PREPARE / EXECUTE ... USING /
+        DEALLOCATE PREPARE)."""
+        if self.word("prepare"):
+            self.eat()
+            name = self.parse_name()
+            self.expect_kw("from")
+            return A.Prepare(name, self.parse_statement())
+        if self.word("execute"):
+            self.eat()
+            name = self.parse_name()
+            args: list[A.Node] = []
+            if self._accept_word("using"):
+                args.append(self.parse_expr())
+                while self.accept_op(","):
+                    args.append(self.parse_expr())
+            return A.ExecuteStmt(name, tuple(args))
+        if self.word("deallocate"):
+            self.eat()
+            if not self._accept_word("prepare"):
+                raise ParseError("expected PREPARE", self.cur)
+            return A.Deallocate(self.parse_name())
         if self.word("create"):
             self.eat()
             if not self._accept_word("table"):
@@ -513,6 +536,11 @@ class Parser:
 
     def parse_primary(self) -> A.Node:
         t = self.cur
+        if self.op("?"):
+            self.eat()
+            ph = A.Placeholder(self.n_params)
+            self.n_params += 1
+            return ph
         if t.kind == "NUMBER":
             self.eat()
             return A.NumberLit(t.text)

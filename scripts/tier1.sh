@@ -39,7 +39,13 @@
 #      warm re-traces, and the adaptive partial-aggregation bypass
 #      must trigger on a high-cardinality synthetic GROUP BY and be
 #      recorded in system.plan_stats (ISSUE-9 acceptance).
-#   9. The tier-1 pytest suite on the CPU backend (virtual-device
+#   9. Plan-template smoke: a TPC-H template executed at 3 different
+#      literal bindings must re-trace ZERO jitted steps after the
+#      first, return rows identical to the unparameterized
+#      (plan_templates=0) run, PREPARE/EXECUTE ... USING must bind
+#      correctly, and the global memory pool must drain to zero
+#      (ISSUE-10 acceptance).
+#  10. The tier-1 pytest suite on the CPU backend (virtual-device
 #      distributed tests included; `slow` marks excluded), with the
 #      same flags and timeout the driver uses.
 #
@@ -348,6 +354,53 @@ fb = {k: v for k, v in REGISTRY.snapshot().items()
 print("leaf-route smoke: %d fragments routed (q6 + ssb q1_1), on/off "
       "identical, 0 warm re-traces, bypass recorded in plan_stats, "
       "fallbacks=%s" % (routed, fb or "{}"))
+PY
+
+timeout -k 10 300 env JAX_PLATFORMS=cpu JAX_ENABLE_X64=1 python - <<'PY' || exit $?
+# Plan-template smoke (ISSUE-10 acceptance): one compiled executable
+# serves every literal binding of a TPC-H template — the exec cache
+# AND jax's signature cache hit across differing constants.
+import sys
+
+sys.path.insert(0, ".")
+from presto_tpu.connectors.tpch import TpchConnector
+from presto_tpu.runtime.memory import global_pool
+from presto_tpu.runtime.metrics import REGISTRY
+from presto_tpu.runtime.session import Session
+
+conn = TpchConnector(sf=0.005)
+tpl = ("select o_orderpriority, count(*) c from lineitem"
+       " join orders on l_orderkey = o_orderkey"
+       " where l_quantity < {} group by o_orderpriority"
+       " order by o_orderpriority")
+s = Session({"tpch": conn}, properties={"result_cache_enabled": False})
+s.sql(tpl.format(10))  # cold: trace + compile the template once
+t0 = REGISTRY.snapshot().get("exec.traces", 0)
+res = {v: s.sql(tpl.format(v)) for v in (17, 24, 31)}
+t1 = REGISTRY.snapshot().get("exec.traces", 0)
+assert t1 == t0, f"warm bindings re-traced ({t1 - t0} new traces)"
+s_off = Session({"tpch": conn}, properties={
+    "result_cache_enabled": False, "plan_templates": False})
+for v, df in res.items():
+    assert df.equals(s_off.sql(tpl.format(v))), \
+        f"plan_templates changed results at binding {v}"
+# PREPARE / EXECUTE ... USING binds by position, same executable
+s.sql("prepare t10 from select count(*) c from orders"
+      " where o_orderkey < ?")
+a = s.sql("execute t10 using 512")
+t2 = REGISTRY.snapshot().get("exec.traces", 0)
+b = s.sql("execute t10 using 4096")
+assert REGISTRY.snapshot().get("exec.traces", 0) == t2, \
+    "EXECUTE with a new binding re-traced"
+assert a.equals(s_off.sql("select count(*) c from orders"
+                          " where o_orderkey < 512"))
+assert b.equals(s_off.sql("select count(*) c from orders"
+                          " where o_orderkey < 4096"))
+hits = REGISTRY.snapshot().get("prepare.template_hit", 0)
+assert hits >= 4, f"template hits not counted ({hits})"
+assert global_pool().reserved_bytes == 0, "global pool reservation leak"
+print("template smoke: 3 bindings + 2 EXECUTEs re-traced 0 steps, "
+      "on/off identical, pool balance 0")
 PY
 
 rm -f /tmp/_t1.log

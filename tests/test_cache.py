@@ -84,6 +84,44 @@ def test_identical_sql_has_identical_plan_fingerprint():
     assert fp4 != fp1
 
 
+def test_slotted_plan_fingerprints_identically_across_reanalysis():
+    """The gensym discipline extended to plan templates: parameterized
+    re-analysis of identical SQL must produce an identical template
+    fingerprint (slot ids are deterministic pre-order ordinals, like
+    gensyms), and two statements differing ONLY in eligible literals
+    must share ONE template fingerprint — that identity is the whole
+    compiled-executable reuse story."""
+    from presto_tpu.plan.templates import parameterize_plan
+
+    s = make_session()
+    fmt = ("select l_orderkey, l_linenumber, l_quantity + {} q"
+           " from lineitem where l_extendedprice < {}"
+           " order by l_orderkey, l_linenumber limit 10")
+
+    def template_fp(sql):
+        plan, slots = parameterize_plan(
+            s.plan(sql), s.catalog)
+        assert slots  # the sweep literals really did slot
+        return plan_fingerprint(plan, s.catalog, s.properties), slots
+
+    fp1, slots1 = template_fp(fmt.format(3, 2000))
+    fp2, slots2 = template_fp(fmt.format(3, 2000))  # re-analysis
+    assert fp1 is not None and fp1 == fp2
+    assert [(x.slot, x.dtype) for x in slots1] == \
+        [(x.slot, x.dtype) for x in slots2]
+    fp3, slots3 = template_fp(fmt.format(7, 90000))  # new literals only
+    assert fp3 == fp1
+    assert [x.value for x in slots3] != [x.value for x in slots1]
+    # explicit ?-placeholder plans fingerprint identically too (the
+    # PREPARE path: user slots precede auto slots deterministically)
+    psql = ("select count(*) c from orders"
+            " where o_orderkey between ? and ?")
+    h1 = s.prepare(psql)
+    h2 = s.prepare(psql)
+    assert plan_fingerprint(h1.plan, s.catalog, s.properties) == \
+        plan_fingerprint(h2.plan, s.catalog, s.properties)
+
+
 def test_table_version_bump_changes_plan_fingerprint():
     s = make_session()
     fp1 = plan_fingerprint(s.plan("select count(*) c from region"),

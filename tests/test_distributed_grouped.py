@@ -27,9 +27,12 @@ SF = 0.002
 TINY_BUDGET = 512
 
 GROUPED_QUERIES = {
+    # count(c_acctbal) is a BUILD-side output: a filter-only join (all
+    # outputs probe-side) folds into the leaf route as a membership
+    # bitmap (PR 8) and the grouped join tier under test never executes
     "inner_unique": (
-        "select count(*) c, sum(o_totalprice) s from orders "
-        "join customer on o_custkey = c_custkey"
+        "select count(*) c, sum(o_totalprice) s, count(c_acctbal) a "
+        "from orders join customer on o_custkey = c_custkey"
     ),
     "left_expand": (
         "select count(*) c, count(l_orderkey) lk from orders "
