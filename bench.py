@@ -893,6 +893,7 @@ def run_sustained_load(n_sessions: int = 3, duration_s: float = 6.0,
     import threading as _th
     import time as _t
 
+    from presto_tpu.cache.exec_cache import EXEC_CACHE
     from presto_tpu.connectors.tpch import TpchConnector
     from presto_tpu.runtime.errors import PrestoError
     from presto_tpu.runtime.memory import (
@@ -1005,6 +1006,8 @@ def run_sustained_load(n_sessions: int = 3, duration_s: float = 6.0,
         chaos_thread = _th.Thread(target=chaos_driver, daemon=True)
 
     before = REGISTRY.snapshot()
+    ledger_before = sum(
+        r["compile_s_saved"] for r in EXEC_CACHE.stats_rows())
     t_start = _t.perf_counter()
     deadline = _t.monotonic() + duration_s
     threads = [
@@ -1053,6 +1056,19 @@ def run_sustained_load(n_sessions: int = 3, duration_s: float = 6.0,
             if delta("prepare.template_hit") + delta("prepare.template_miss")
             else None),
         "coalesced": int(delta("prepare.coalesced")),
+        # compile-cost ledger rollup (cache/exec_cache.py,
+        # system.exec_cache): measured trace+compile seconds the
+        # executable cache's reuse amortized away INSIDE the measured
+        # window — a delta like every sibling field, so earlier bench
+        # phases' accrual doesn't inflate this window's win (clamped:
+        # eviction of a warmed entry can shrink the absolute sum)
+        "compile_s_saved": round(max(
+            sum(r["compile_s_saved"] for r in EXEC_CACHE.stats_rows())
+            - ledger_before, 0.0), 3),
+        "exec_cache_entries": len(EXEC_CACHE),
+        # flight-recorder evidence: post-mortems the window captured
+        # (chaos failures and load-query faults auto-capture)
+        "flight_records": int(delta("flight.captured")),
         "sessions": n_sessions,
         "duration_s": round(wall, 2),
         "chaos": chaos,

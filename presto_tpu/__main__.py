@@ -167,7 +167,12 @@ def main(argv=None):
                     help="optional subcommand: 'metrics' prints the "
                          "process metrics registry as OpenMetrics/"
                          "Prometheus text after any -e/-f statements "
-                         "run, then exits")
+                         "run, then exits; 'flightrec' prints the "
+                         "flight-recorder post-mortem ring as JSON the "
+                         "same way (the dump-on-failure workflow: "
+                         "`python -m presto_tpu flightrec -e '<sql>'` "
+                         "captures and dumps any failure the statement "
+                         "hits)")
     ap.add_argument("--catalog", default="tpch",
                     help="tpch | tpcds | ssb (default tpch)")
     ap.add_argument("--sf", type=float, default=0.01,
@@ -197,8 +202,9 @@ def main(argv=None):
     conn = make_connector(args.catalog, args.sf)
     session = Session({args.catalog: conn}, properties=props, mesh=mesh)
 
-    if args.command not in (None, "metrics"):
-        raise SystemExit(f"unknown command {args.command!r} ('metrics')")
+    if args.command not in (None, "metrics", "flightrec"):
+        raise SystemExit(
+            f"unknown command {args.command!r} ('metrics', 'flightrec')")
     ran = False
     if args.execute is not None:
         run_statement(session, args.execute, args.max_rows)
@@ -214,6 +220,12 @@ def main(argv=None):
         # statements above run first, so `python -m presto_tpu metrics
         # -e "<sql>"` scrapes the metrics that query moved
         print(session.export_metrics(), end="")
+        return
+    if args.command == "flightrec":
+        # the dump-on-failure workflow: -e/-f statements run first
+        # (the REPL loop keeps the session alive through failures),
+        # then every captured post-mortem dumps as JSON
+        print(session.export_flight_record())
         return
     if ran:
         return

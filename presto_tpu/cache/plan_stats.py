@@ -12,8 +12,12 @@ kept here.
 Each entry maps one ``plan_fingerprint`` to the latest
 estimate-vs-actual rows of a completed run (per node: estimated rows,
 actual rows, measured selectivity, chosen join strategy, misestimate
-ratio — ``StatsRecorder.estimate_vs_actual``), plus a ``runs`` counter
-so recurring plans are distinguishable from one-offs.
+ratio, observed exchange-partition skew —
+``StatsRecorder.estimate_vs_actual``), plus a ``runs`` counter so
+recurring plans are distinguishable from one-offs. The skew column is
+what makes hot partitions PLAN-visible: ``EXPLAIN (TYPE DISTRIBUTED)``
+reads it back through ``Session._plan_hints`` for recurring
+fingerprints and renders it on the owning fragment's header.
 
 Correctness model (the result cache's, reused deliberately):
 

@@ -81,7 +81,46 @@ SCHEMAS: dict[str, dict[str, DataType]] = {
         "selectivity": DOUBLE,
         "strategy": fixed_bytes(16),
         "misest": DOUBLE,
+        # observed exchange-partition skew (max/mean delivered rows
+        # across destinations) of the node's exchanges; 0 = none seen
+        "skew": DOUBLE,
         "runs": BIGINT,
+    },
+    # flight-recorder post-mortems (runtime/flight.py): one row per
+    # retained record; the full evidence (plan render, spans, metric
+    # delta) exports as JSON via Session.export_flight_record
+    "flight_recorder": {
+        "query_id": fixed_bytes(24),
+        "state": varchar(),
+        "query": fixed_bytes(256),
+        "triggers": fixed_bytes(48),
+        "error_code": fixed_bytes(32),
+        "oom_rung": BIGINT,
+        "rungs": BIGINT,
+        "fragment_retries": BIGINT,
+        "degraded": BIGINT,
+        "spans": BIGINT,
+        "metric_deltas": BIGINT,
+        "hot_partitions": fixed_bytes(48),
+        "execution_s": DOUBLE,
+        "captured_at": DOUBLE,
+        "pool_reserved_bytes": BIGINT,
+    },
+    # compile-cost ledger of the process-wide executable cache
+    # (cache/exec_cache.py): per-entry provenance, reuse, and the
+    # measured trace+compile amortization (compile_s_saved)
+    "exec_cache": {
+        "kind": fixed_bytes(24),
+        # longest kind tag (18) + ':' + 64-hex sha256 = 83; sized so
+        # the fingerprint tail never truncates away entry identity
+        "key": fixed_bytes(96),
+        "hits": BIGINT,
+        "calls": BIGINT,
+        "cold_call_s": DOUBLE,
+        "warm_call_s": DOUBLE,
+        "compile_s_saved": DOUBLE,
+        "age_s": DOUBLE,
+        "idle_s": DOUBLE,
     },
     # live state of the memory pool this session admits through
     # (runtime/memory.MemoryPool): one row, materialized at scan time
@@ -139,7 +178,8 @@ class SystemConnector:
         return SCHEMAS[table]
 
     def dictionaries(self, table: str) -> Mapping[str, Dictionary]:
-        if table in ("runtime_queries", "query_history"):
+        if table in ("runtime_queries", "query_history",
+                     "flight_recorder"):
             return {"state": STATE_DICT}
         return {}
 
@@ -194,9 +234,8 @@ class SystemConnector:
         if table == "plan_stats":
             entries = self._session.plan_stats.entries(
                 self._session.catalog)
-            fps, qids, nids, ntypes, ests, acts, sels, strats, mis, runs = (
-                [], [], [], [], [], [], [], [], [], []
-            )
+            (fps, qids, nids, ntypes, ests, acts, sels, strats, mis,
+             skews, runs) = ([], [], [], [], [], [], [], [], [], [], [])
             for e in entries:
                 for r in e.records:
                     fps.append(e.fingerprint)
@@ -208,9 +247,45 @@ class SystemConnector:
                     sels.append(r["selectivity"])
                     strats.append(r["strategy"])
                     mis.append(r["misest"])
+                    skews.append(r.get("skew", 0.0))
                     runs.append(e.runs)
             return (fps, qids, nids, ntypes, ests, acts, sels, strats,
-                    mis, runs)
+                    mis, skews, runs)
+        if table == "flight_recorder":
+            recs = self._session.flight.records()
+            return (
+                [r.query_id for r in recs],
+                [r.state for r in recs],
+                [" ".join(r.sql.split()) for r in recs],
+                [",".join(r.triggers) for r in recs],
+                [r.error_code or "" for r in recs],
+                [r.oom_rung for r in recs],
+                [len(r.rung_history) for r in recs],
+                [r.fragment_retries for r in recs],
+                [int(r.degraded_to_local) for r in recs],
+                [len(r.spans) for r in recs],
+                [len(r.metrics) for r in recs],
+                [",".join(str(p) for p in r.hot_partitions)
+                 for r in recs],
+                [r.execution_s for r in recs],
+                [r.captured_at for r in recs],
+                [int(r.pool.get("reserved_bytes", 0)) for r in recs],
+            )
+        if table == "exec_cache":
+            from presto_tpu.cache.exec_cache import EXEC_CACHE
+
+            rows = EXEC_CACHE.stats_rows()
+            return (
+                [r["kind"] for r in rows],
+                [r["key"] for r in rows],
+                [r["hits"] for r in rows],
+                [r["calls"] for r in rows],
+                [r["cold_call_s"] for r in rows],
+                [r["warm_call_s"] for r in rows],
+                [r["compile_s_saved"] for r in rows],
+                [r["age_s"] for r in rows],
+                [r["idle_s"] for r in rows],
+            )
         if table == "memory_pool":
             pool = self._session.pool()
             snap = pool.snapshot()  # one lock: internally consistent
@@ -227,16 +302,17 @@ class SystemConnector:
                 [], [], [], [], [], [], [], [], []
             )
             for rec in self._session.traces.recorders():
-                t0 = rec.t0
-                for sp in rec.spans:
+                # the ONE span-flattening projection, shared with the
+                # flight recorder (TraceRecorder.to_span_dicts)
+                for d in rec.to_span_dicts():
                     qids.append(rec.query_id)
-                    sids.append(sp.span_id)
-                    pids_.append(sp.parent_id)
-                    names_.append(sp.name)
-                    cats.append(sp.cat)
-                    starts.append(max(sp.t0 - t0, 0.0))
-                    durs.append(max(sp.t1 - sp.t0, 0.0))
-                    nids.append(int(sp.args.get("plan_node_id", -1)))
+                    sids.append(d["span_id"])
+                    pids_.append(d["parent_id"])
+                    names_.append(d["name"])
+                    cats.append(d["cat"])
+                    starts.append(d["start_s"])
+                    durs.append(d["duration_s"])
+                    nids.append(int(d["args"].get("plan_node_id", -1)))
                     toks.append(rec.trace_token or "")
             return (qids, sids, pids_, names_, cats, starts, durs, nids,
                     toks)
@@ -304,7 +380,7 @@ class SystemConnector:
             }
         elif table == "plan_stats":
             (fps, qids, nids, ntypes, ests, acts, sels, strats, mis,
-             runs) = rows
+             skews, runs) = rows
             arrays = {
                 "fingerprint": _bytes_col(fps, 64),
                 "query_id": _bytes_col(qids, 24),
@@ -315,7 +391,42 @@ class SystemConnector:
                 "selectivity": np.asarray(sels, np.float64),
                 "strategy": _bytes_col(strats, 16),
                 "misest": np.asarray(mis, np.float64),
+                "skew": np.asarray(skews, np.float64),
                 "runs": np.asarray(runs, np.int64),
+            }
+        elif table == "flight_recorder":
+            (qid, state, sql, trig, ecode, rung, rungs, retries, degr,
+             spans, mdeltas, hot, execs, cap, poolb) = rows
+            arrays = {
+                "query_id": _bytes_col(qid, 24),
+                "state": STATE_DICT.encode(state).astype(np.int32),
+                "query": _bytes_col(sql, 256),
+                "triggers": _bytes_col(trig, 48),
+                "error_code": _bytes_col(ecode, 32),
+                "oom_rung": np.asarray(rung, np.int64),
+                "rungs": np.asarray(rungs, np.int64),
+                "fragment_retries": np.asarray(retries, np.int64),
+                "degraded": np.asarray(degr, np.int64),
+                "spans": np.asarray(spans, np.int64),
+                "metric_deltas": np.asarray(mdeltas, np.int64),
+                "hot_partitions": _bytes_col(hot, 48),
+                "execution_s": np.asarray(execs, np.float64),
+                "captured_at": np.asarray(cap, np.float64),
+                "pool_reserved_bytes": np.asarray(poolb, np.int64),
+            }
+        elif table == "exec_cache":
+            (kind, key, hits, calls, cold, warm, saved, age,
+             idle) = rows
+            arrays = {
+                "kind": _bytes_col(kind, 24),
+                "key": _bytes_col(key, 96),
+                "hits": np.asarray(hits, np.int64),
+                "calls": np.asarray(calls, np.int64),
+                "cold_call_s": np.asarray(cold, np.float64),
+                "warm_call_s": np.asarray(warm, np.float64),
+                "compile_s_saved": np.asarray(saved, np.float64),
+                "age_s": np.asarray(age, np.float64),
+                "idle_s": np.asarray(idle, np.float64),
             }
         elif table == "memory_pool":
             name, cap, reserved, free, active, queued = rows

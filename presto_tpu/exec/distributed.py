@@ -251,6 +251,19 @@ class DistributedExecutor(OomLadderMixin):
         #: plan-time proven-broadcast shortcut; each further rung
         #: doubles grouped bucket counts
         self.oom_rung = 0
+        #: exchange-skew telemetry (PR 6 _flush_filter_stats
+        #: discipline): per-destination row histograms accumulate as
+        #: DEVICE arrays per dispatched exchange — (site, node,
+        #: dest_rows, row_bytes) — and ONE readback at the end of the
+        #: run turns them into metrics, NodeStats.skew, and the
+        #: flight-recorder summary below
+        self._skew_accum: list = []
+        #: flushed per-exchange summaries of the LAST run (the flight
+        #: recorder copies these into failure post-mortems)
+        self.exchange_skew: list = []
+        #: destination ids that tripped a receive-capacity overflow
+        #: (the hot partitions the doubled-buffer retries paid for)
+        self.hot_partitions: list = []
 
     # ------------------------------------------------------------------
     def run(self, plan: N.PlanNode):
@@ -269,20 +282,29 @@ class DistributedExecutor(OomLadderMixin):
             self.recorder.attach_plan(plan)
         # query-scoped join-key min/max memo (see exec/joinkeys.py)
         self._minmax_memo.clear()
+        # per-run exchange-skew accumulators (an OOM-ladder rung
+        # re-enters run(); each rung flushes its own observations)
+        self._skew_accum.clear()
+        self.hot_partitions = []
         scalars: dict[str, Any] = {}
-        # concrete literal-slot values scope the whole run (eager
-        # evaluation sites); traced step bodies shadow them with their
-        # traced params argument (expr.param_scope)
-        with param_scope(self.params), \
-                trace_span("node:Output", "node",
-                           {"plan_node_id": self._nid(plan)}):
-            d = self._exec(plan.child, scalars)
-            b = self._replicate(d).batch
-            b = b.select(list(plan.sources)).rename(
-                dict(zip(plan.sources, plan.names)))
-            if live_count(b) == 0:
-                return pd.DataFrame(columns=list(plan.names))
-            return b.to_pandas()[list(plan.names)]
+        try:
+            # concrete literal-slot values scope the whole run (eager
+            # evaluation sites); traced step bodies shadow them with
+            # their traced params argument (expr.param_scope)
+            with param_scope(self.params), \
+                    trace_span("node:Output", "node",
+                               {"plan_node_id": self._nid(plan)}):
+                d = self._exec(plan.child, scalars)
+                b = self._replicate(d).batch
+                b = b.select(list(plan.sources)).rename(
+                    dict(zip(plan.sources, plan.names)))
+                if live_count(b) == 0:
+                    return pd.DataFrame(columns=list(plan.names))
+                return b.to_pandas()[list(plan.names)]
+        finally:
+            # in the finally so FAILED runs flush too: a post-mortem's
+            # most useful line is which partition was hot when it died
+            self._flush_exchange_skew()
 
     # ------------------------------------------------------------------
     def _exec(self, node: N.PlanNode, scalars: dict) -> DistBatch:
@@ -377,6 +399,55 @@ class DistributedExecutor(OomLadderMixin):
 
     def _shard(self, b: Batch) -> Batch:
         return jax.device_put(b, row_sharding(self.mesh))
+
+    # ---- exchange-skew telemetry -----------------------------------------
+    def _note_exchange_skew(self, site: str, node, dest, row_bytes: int):
+        """Bank one exchange's per-destination device histogram for the
+        end-of-run flush (NEVER a readback here — this sits on the
+        dispatch hot path)."""
+        self._skew_accum.append((site, node, dest, int(row_bytes)))
+
+    def _hot_partition(self, dest) -> int:
+        """Hottest destination id of an overflowed exchange (the ONE
+        readback the overflow path already pays before recompiling at
+        doubled capacity); recorded for post-mortems + metrics."""
+        counts = np.asarray(dest)
+        hot = int(np.argmax(counts)) if counts.size else -1
+        self.hot_partitions.append(hot)
+        return hot
+
+    def _flush_exchange_skew(self):
+        """The once-per-run host readback (PR 6 ``_flush_filter_stats``
+        discipline): per-destination histograms -> ``exchange.skew``
+        histogram + per-site row counters, NodeStats.skew on the
+        recorder (-> EXPLAIN ANALYZE + system.plan_stats history), and
+        the ``exchange_skew`` summary the flight recorder captures."""
+        from presto_tpu.parallel.exchange import skew_ratio
+        from presto_tpu.runtime.metrics import REGISTRY
+
+        summaries = []
+        for site, node, dest, row_bytes in self._skew_accum:
+            try:
+                counts = np.asarray(dest)
+            except Exception:  # noqa: BLE001 — a failed run's buffers
+                continue  # may be poisoned; telemetry never raises
+            rows = int(counts.sum())
+            if rows <= 0:
+                continue
+            ratio = skew_ratio(counts)
+            REGISTRY.counter(f"exchange.rows.{site}").add(rows)
+            REGISTRY.histogram("exchange.skew").add(ratio)
+            summaries.append({
+                "site": site,
+                "rows": rows,
+                "bytes": rows * row_bytes,
+                "skew": round(ratio, 3),
+                "hot_partition": int(np.argmax(counts)),
+            })
+            if node is not None and self.recorder is not None:
+                self.recorder.record_skew(node, ratio, rows)
+        self._skew_accum.clear()
+        self.exchange_skew = summaries
 
     # ---- leaves ----------------------------------------------------------
     def _exec_tablescan(self, node: N.TableScan, scalars) -> DistBatch:
@@ -596,7 +667,8 @@ class DistributedExecutor(OomLadderMixin):
         est = estimate_node_bytes(node, self.catalog)
         if est > self.join_build_budget or self.oom_rung > 0:
             REGISTRY.counter("agg.strategy.partial").add()
-            return self._grouped_dist_agg(d.batch, keys, aggs, pax, est)
+            return self._grouped_dist_agg(d.batch, keys, aggs, pax, est,
+                                          node=node)
         # adaptive bypass (leaf_route.bypass_partial_agg): when group
         # cardinality ~ input cardinality, the per-device partial
         # group-sort reduces nothing before the shuffle — stream the
@@ -611,10 +683,10 @@ class DistributedExecutor(OomLadderMixin):
             "agg.strategy.bypass" if bypass else "agg.strategy.partial"
         ).add()
         return self._dist_grouped_agg(d.batch, keys, aggs, pax,
-                                      bypass=bypass)
+                                      bypass=bypass, node=node)
 
     def _dist_grouped_agg(self, b: Batch, keys, aggs, pax,
-                          bypass: bool = False) -> DistBatch:
+                          bypass: bool = False, node=None) -> DistBatch:
         """PARTIAL -> all_to_all(hash(keys)) -> FINAL, one compiled step.
 
         The exchange is the skew-aware multi-round shuffle: the wire
@@ -648,17 +720,24 @@ class DistributedExecutor(OomLadderMixin):
             t0 = _time.perf_counter()
             with trace_span("step:dist_agg", "step",
                             {"quota": quota, "recv_cap": mgf}):
-                out, overflow, rounds = step(b, self.params)
+                out, overflow, rounds, dest, exch_ovf = step(b, self.params)
                 done = not bool(overflow)
             # exchanged rows are partial-agg group rows: the final
             # output's columns plus one int64 merge-count per agg
             row_b = batch_row_bytes(out) + 9 * len(aggs)
             r = int(np.asarray(rounds))
+            # hot-partition capture keys on the EXCHANGE receive
+            # overflow specifically — a partial/final group-capacity
+            # overflow retries through the same loop but is NOT skew,
+            # and must not plant a phantom hot partition in post-mortems
             record_exchange(
                 "aggregate", a2a_wire_bytes(row_b, Pn, quota, r),
                 Pn, _time.perf_counter() - t0, rounds=r,
+                hot_partition=(self._hot_partition(dest)
+                               if not done and bool(exch_ovf) else None),
             )
             if done:
+                self._note_exchange_skew("aggregate", node, dest, row_b)
                 return DistBatch(out, sharded=True)
             mg_final *= 2
         raise CapacityOverflow("DistributedAggregate", mg_final)
@@ -789,7 +868,8 @@ class DistributedExecutor(OomLadderMixin):
 
         @partial(
             shard_map, mesh=mesh,
-            in_specs=(P(axes), P()), out_specs=(P(axes), P(), P()),
+            in_specs=(P(axes), P()),
+            out_specs=(P(axes), P(), P(), P(), P()),
             check_vma=False,
         )
         def step(b: Batch, params=()):
@@ -798,11 +878,16 @@ class DistributedExecutor(OomLadderMixin):
                 part, ovf1 = (bypass_phase(b) if bypass else partial_phase(b))
                 key_sort = [c for n, _ in keys for c in _sortables(part[n])]
                 pids = partition_ids(key_sort, Pn)
-                exch, ovf2, rounds = exchange_multiround(
-                    part, pids, Pn, quota, mgf, axes=axes, with_rounds=True
+                exch, ovf2, rounds, dest = exchange_multiround(
+                    part, pids, Pn, quota, mgf, axes=axes, with_rounds=True,
+                    with_stats=True,
                 )
                 out, ovf3 = final_phase(exch)
-                return out, any_flag(ovf1 | ovf2 | ovf3, axes), rounds
+                # the exchange receive overflow rides out separately:
+                # only IT means "a destination was hot" (the group-
+                # capacity flags retry the same loop but are not skew)
+                return (out, any_flag(ovf1 | ovf2 | ovf3, axes), rounds,
+                        dest, any_flag(ovf2, axes))
 
         return jax.jit(step)
 
@@ -1080,18 +1165,34 @@ class DistributedExecutor(OomLadderMixin):
             with trace_span("step:repartition_join", "step",
                             {"kind": node.kind, "lrecv": lrecv,
                              "rrecv": rrecv}):
-                out, overflow, flags, rounds = step(left.batch, right.batch,
-                                                    self.params)
-                long_runs, sentinel = (bool(x) for x in np.asarray(flags))
+                out, overflow, flags, rounds, dest = step(
+                    left.batch, right.batch, self.params)
+                long_runs, sentinel, exch_ovf = (
+                    bool(x) for x in np.asarray(flags))
                 ok = not bool(overflow)
             lr, rr = (int(x) for x in np.asarray(rounds))
+            # hot-partition capture keys on the exchange RECEIVE
+            # overflow only — probe-expand output overflow retries
+            # through the same loop but is not partition skew
             record_exchange(
                 "join",
                 a2a_wire_bytes(batch_row_bytes(left.batch), Pn, lquota, lr)
                 + a2a_wire_bytes(batch_row_bytes(right.batch), Pn, rquota,
                                  rr),
                 Pn, _time.perf_counter() - t0, rounds=lr + rr,
+                hot_partition=(self._hot_partition(dest[0] + dest[1])
+                               if not ok and exch_ovf else None),
             )
+            if ok:
+                # dest[0] = probe-side rows by destination, dest[1] =
+                # build-side: both exchanges shuffle on the SAME key
+                # hash, so a hot key shows up in each independently
+                self._note_exchange_skew(
+                    "join.probe", node, dest[0],
+                    batch_row_bytes(left.batch))
+                self._note_exchange_skew(
+                    "join.build", node, dest[1],
+                    batch_row_bytes(right.batch))
             if long_runs:
                 raise NotImplementedError(
                     "hash-key collision run exceeds the verified probe's "
@@ -1139,7 +1240,7 @@ class DistributedExecutor(OomLadderMixin):
         @partial(
             shard_map, mesh=self.mesh,
             in_specs=(P(axes), P(axes), P()),
-            out_specs=(P(axes), P(), P(), P()),
+            out_specs=(P(axes), P(), P(), P(), P()),
             check_vma=False,
         )
         def step(lb: Batch, rb: Batch, params=()):
@@ -1154,11 +1255,16 @@ class DistributedExecutor(OomLadderMixin):
             rv = evaluate(rkey, rb)
             lpids = partition_ids([lv.data.astype(jnp.int64)], Pn)
             rpids = partition_ids([rv.data.astype(jnp.int64)], Pn)
-            le, ovf1, lrnd = exchange_multiround(
-                lb, lpids, Pn, lquota, lrecv, axes=axes, with_rounds=True)
-            re, ovf2, rrnd = exchange_multiround(
-                rb, rpids, Pn, rquota, rrecv, axes=axes, with_rounds=True)
+            le, ovf1, lrnd, ldest = exchange_multiround(
+                lb, lpids, Pn, lquota, lrecv, axes=axes, with_rounds=True,
+                with_stats=True)
+            re, ovf2, rrnd, rdest = exchange_multiround(
+                rb, rpids, Pn, rquota, rrecv, axes=axes, with_rounds=True,
+                with_stats=True)
             rounds = jnp.stack([lrnd, rrnd])
+            # [2, P] per-destination delivered rows (probe, build) —
+            # the skew telemetry's raw device histograms
+            dest = jnp.stack([ldest, rdest])
             bv = evaluate(rkey, re)
             build_cap = re.capacity
             side = build_lookup(bv.data, re.live & bv.valid, build_cap)
@@ -1176,14 +1282,17 @@ class DistributedExecutor(OomLadderMixin):
                 longrun = jnp.zeros((), jnp.bool_)
             # refusal flags: [0] hash-collision run exceeds the verified
             # probe window, [1] a live build key equals the reserved
-            # int64 dead-slot sentinel (host raises per flag)
+            # int64 dead-slot sentinel (host raises per flag), [2] an
+            # exchange RECEIVE capacity overflowed (the one overflow
+            # that means a destination was hot — skew telemetry)
             longrun = jnp.stack([any_flag(longrun, axes),
-                                 any_flag(side.sentinel_hit, axes)])
+                                 any_flag(side.sentinel_hit, axes),
+                                 any_flag(ovf1 | ovf2, axes)])
             if kind in ("semi", "anti"):
                 exists = probe_exists(side, pv.data, pvalid)
                 keep = exists if kind == "semi" else le.live & ~exists
                 return (le.with_live(le.live & keep), any_flag(ovf, axes),
-                        longrun, rounds)
+                        longrun, rounds, dest)
             if unique:
                 if verify:
                     res = verified_unique_probe(side, lkey, verify, re, le)
@@ -1200,7 +1309,7 @@ class DistributedExecutor(OomLadderMixin):
                 live = le.live & res.matched if kind == "inner" else le.live
                 pout = Batch(cols, live)
                 if kind != "full":
-                    return pout, any_flag(ovf, axes), longrun, rounds
+                    return pout, any_flag(ovf, axes), longrun, rounds, dest
                 flags = (
                     jnp.zeros(re.capacity, jnp.bool_)
                     .at[jnp.where(res.matched, res.build_row, re.capacity)]
@@ -1212,6 +1321,7 @@ class DistributedExecutor(OomLadderMixin):
                     any_flag(ovf, axes),
                     longrun,
                     rounds,
+                    dest,
                 )
             res = probe_expand(
                 side, pv.data, pvalid, out_cap,
@@ -1237,8 +1347,8 @@ class DistributedExecutor(OomLadderMixin):
                 )
             pout = Batch(cols, live)
             if kind != "full":
-                return pout, any_flag(ovf | res.overflow, axes), longrun, \
-                    rounds
+                return (pout, any_flag(ovf | res.overflow, axes), longrun,
+                        rounds, dest)
             flags = (
                 jnp.zeros(re.capacity, jnp.bool_)
                 .at[res.build_row]
@@ -1250,6 +1360,7 @@ class DistributedExecutor(OomLadderMixin):
                 any_flag(ovf | res.overflow, axes),
                 longrun,
                 rounds,
+                dest,
             )
 
         return jax.jit(step)
@@ -1422,7 +1533,7 @@ class DistributedExecutor(OomLadderMixin):
         return self._concat_sharded_many(outs)
 
     def _grouped_dist_agg(self, b: Batch, keys, aggs, pax,
-                          est_bytes: int) -> DistBatch:
+                          est_bytes: int, node=None) -> DistBatch:
         """Grouped aggregation: ``nbuckets`` sequential passes, each
         filtering the input to one key-hash bucket (device-side, no
         spill — the input is already resident; what the budget bounds is
@@ -1494,7 +1605,11 @@ class DistributedExecutor(OomLadderMixin):
         outs = []
         for bk in range(nbuckets):
             fb = fstep(b, bids, jnp.asarray(bk, jnp.int32))
-            outs.append(self._dist_grouped_agg(fb, keys, aggs, pax).batch)
+            # node threads through so bucket-pass exchange skew still
+            # attributes to the Aggregate (the budget-bounded queries
+            # are exactly the ones most likely to be skewed)
+            outs.append(self._dist_grouped_agg(fb, keys, aggs, pax,
+                                               node=node).batch)
         return self._concat_sharded_many(outs)
 
     def _exec_semijoin(self, node: N.SemiJoin, scalars) -> DistBatch:

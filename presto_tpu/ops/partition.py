@@ -47,6 +47,20 @@ def partition_layout(pids, live, num_partitions: int, quota: int):
     return slot, counts, overflow
 
 
+def destination_counts(pids, mask, num_partitions: int):
+    """Per-destination row histogram of the masked rows (int64 [P]).
+
+    The exchange-skew telemetry's device-side primitive: accumulated
+    across shuffle rounds inside the compiled step (never a per-round
+    host readback), psum'd over the worker axis at the end, and read
+    back once per query — the ``_flush_filter_stats`` discipline. The
+    extra slot absorbs masked-off rows (their pid may be garbage)."""
+    dest = jnp.where(mask, pids, num_partitions)
+    return jnp.zeros(num_partitions + 1, jnp.int64).at[dest].add(1)[
+        :num_partitions
+    ]
+
+
 def scatter_to_buffer(values, slot, num_partitions: int, quota: int, fill=0):
     """Scatter a column into the dense [P, quota] send tensor."""
     flat = jnp.full((num_partitions * quota + 1,) + values.shape[1:], fill, values.dtype)

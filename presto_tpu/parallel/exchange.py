@@ -36,7 +36,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from presto_tpu.batch import Batch, Column
-from presto_tpu.ops.partition import partition_layout, scatter_to_buffer
+from presto_tpu.ops.partition import (
+    destination_counts,
+    partition_layout,
+    scatter_to_buffer,
+)
 from presto_tpu.parallel.mesh import WORKERS, worker_axes
 
 
@@ -99,6 +103,7 @@ def exchange_multiround(
     max_rounds: int | None = None,
     axes=WORKERS,
     with_rounds: bool = False,
+    with_stats: bool = False,
 ):
     """Skew-aware per-device shuffle body: multi-round, fixed wire quota.
 
@@ -125,6 +130,10 @@ def exchange_multiround(
     (int32; identical on every device — the while cond is driven by
     the global pending flag) so the host can account exact wire bytes
     (``a2a_wire_bytes`` x rounds) for the exchange metrics.
+    ``with_stats=True`` appends the GLOBAL per-destination delivered
+    row counts (int64 [P], psum'd over the axis — identical on every
+    device): the exchange-skew telemetry's raw material, accumulated
+    in the while-loop carry so no round ever pays a host readback.
     """
     P = num_partitions
     cap = batch.live.shape[0]
@@ -151,15 +160,16 @@ def exchange_multiround(
         jnp.zeros((), jnp.int64),  # receive write offset
         jnp.zeros((), jnp.bool_),  # receive-side overflow
         jnp.zeros((), jnp.int32),  # round counter
+        jnp.zeros(P, jnp.int64),  # per-destination delivered rows
         {n: empty_buf(batch.columns[n]) for n in names},
     )
 
     def cond(state):
-        _remaining, pending, _off, _ovf, rnd, _bufs = state
+        _remaining, pending, _off, _ovf, rnd, _dest, _bufs = state
         return pending & (rnd < max_rounds)
 
     def body(state):
-        remaining, _pending, off, ovf, rnd, bufs = state
+        remaining, _pending, off, ovf, rnd, dest, bufs = state
         slot, _counts, _ = partition_layout(pids, remaining, P, quota)
         sent = remaining & (slot < P * quota)
 
@@ -190,10 +200,17 @@ def exchange_multiround(
             new_off,
             ovf | (new_off > recv_cap),
             rnd + 1,
+            # skew telemetry: delivered-rows-by-destination, carried on
+            # device across rounds (the host reads the total once).
+            # Gated: stats-less callers (window/sort shuffles) loop the
+            # zeros through untouched — the [P] carry rides for free,
+            # the per-round scatter-add is only paid when someone reads
+            (dest + destination_counts(pids, sent, P) if with_stats
+             else dest),
             new_bufs,
         )
 
-    remaining, _pending, off, ovf, rnd, bufs = jax.lax.while_loop(
+    remaining, _pending, off, ovf, rnd, dest, bufs = jax.lax.while_loop(
         cond, body, init
     )
     undrained = jnp.any(remaining)
@@ -204,9 +221,14 @@ def exchange_multiround(
     }
     live = jnp.arange(recv_cap) < off
     out = Batch(cols, live)
+    res = (out, ovf | undrained)
     if with_rounds:
-        return out, ovf | undrained, rnd
-    return out, ovf | undrained
+        res = res + (rnd,)
+    if with_stats:
+        # every device sees the same global per-destination totals
+        # (sender-local histograms psum'd over the axis)
+        res = res + (jax.lax.psum(dest, axes),)
+    return res
 
 
 def broadcast_local(batch: Batch, axes=WORKERS) -> Batch:
@@ -252,11 +274,14 @@ def gather_wire_bytes(row_bytes: int, capacity: int, mesh_size: int) -> int:
 
 
 def record_exchange(site: str, nbytes: int, partitions: int,
-                    dispatch_s: float, rounds: int = 1) -> None:
+                    dispatch_s: float, rounds: int = 1,
+                    hot_partition: int | None = None) -> None:
     """Publish one exchange dispatch: process metrics (counters +
     ``exchange.dispatch_s`` histogram) and a completed trace span
     under the active recorder, carrying the byte/partition/round
-    accounting in its args."""
+    accounting in its args. ``hot_partition`` names the partition that
+    tripped a capacity overflow (skew telemetry: the retry's doubled
+    buffers are THIS destination's fault — the span records who)."""
     from presto_tpu.runtime import trace
     from presto_tpu.runtime.metrics import REGISTRY
 
@@ -264,12 +289,25 @@ def record_exchange(site: str, nbytes: int, partitions: int,
     REGISTRY.counter("exchange.bytes").add(float(nbytes))
     REGISTRY.counter("exchange.rounds").add(float(rounds))
     REGISTRY.histogram("exchange.dispatch_s").add(dispatch_s)
+    args = {"bytes": int(nbytes), "partitions": int(partitions),
+            "rounds": int(rounds)}
+    if hot_partition is not None:
+        REGISTRY.counter("exchange.quota_overflow").add()
+        args["hot_partition"] = int(hot_partition)
     trace.add_complete(
         f"exchange:{site}", "exchange",
-        time.perf_counter() - dispatch_s, dispatch_s,
-        {"bytes": int(nbytes), "partitions": int(partitions),
-         "rounds": int(rounds)},
+        time.perf_counter() - dispatch_s, dispatch_s, args,
     )
+
+
+def skew_ratio(counts) -> float:
+    """max/mean partition ratio of a per-destination row histogram
+    (1.0 = perfectly balanced; P = everything on one destination;
+    0.0 when nothing moved)."""
+    total = float(np.sum(counts))
+    if total <= 0 or len(counts) == 0:
+        return 0.0
+    return float(np.max(counts) / (total / len(counts)))
 
 
 # ---------------------------------------------------------------------------

@@ -148,10 +148,14 @@ class FragmentPlan:
     #: catalog used for planning (renders scan columns' physical types)
     catalog: object = None
 
-    def render(self) -> str:
+    def render(self, skew_history: "dict | None" = None) -> str:
         # roots of other fragments are rendering stop points: each
         # subtree prints in exactly one fragment, with an exchange stub
-        # where it was cut out
+        # where it was cut out.
+        # ``skew_history``: {id(plan node): observed exchange-partition
+        # skew ratio} from plan-stats history (recurring fingerprints) —
+        # rendered on the owning fragment's header so a hot partition
+        # seen in PAST runs is visible at plan time.
         stops = {id(f.root): f.fid for f in self.fragments}
         ex_by_child = {}
         for f in self.fragments:
@@ -204,6 +208,17 @@ class FragmentPlan:
                 lines.extend(tree(c, own_fid, indent + 1))
             return lines
 
+        def fragment_skew(n: N.PlanNode, own_fid: int) -> float:
+            """Worst history-observed skew over the nodes THIS fragment
+            owns (stopping at other fragments' roots, like tree())."""
+            fid = stops.get(id(n))
+            if fid is not None and fid != own_fid:
+                return 0.0
+            worst = (skew_history or {}).get(id(n), 0.0)
+            for c in n.children:
+                worst = max(worst, fragment_skew(c, own_fid))
+            return worst
+
         out = []
         for f in self.fragments:
             # the SOUND plan-time row bound per fragment root (the same
@@ -215,6 +230,9 @@ class FragmentPlan:
                 ub = upper_bound_rows(f.root, self.catalog)
                 if ub is not None:
                     bound = f" est<={ub:,} rows"
+            skew = fragment_skew(f.root, f.fid)
+            if skew > 0:
+                bound += f" skew~{skew:.1f}x (observed)"
             out.append(f"Fragment {f.fid} [{f.partitioning}]{bound}")
             out.extend(tree(f.root, f.fid, 0))
         out.append(

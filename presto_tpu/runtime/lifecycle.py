@@ -397,6 +397,10 @@ class QueryManager:
             # increments it before calling here); info only mirrors it,
             # so listeners see the up-to-date count on the QueryInfo
             info.fragment_retries = ctx.fragment_retries
+            # flight-recorder evidence: WHICH dispatch failed, with
+            # what — the retry count alone can't answer a post-mortem
+            info.retry_events.append(
+                {"site": site, "error": type(exc).__name__})
             events.fragment_retried(info)
 
         ctx.on_retry = on_retry
@@ -427,11 +431,48 @@ class QueryManager:
         pool = self.session.pool()
         delta = QueryMetricsDelta()
         delta_token = install_delta(delta)
+        err = None
         try:
             return self._run_admitted(executor, plan, info, recorder, pool)
+        except BaseException as e:
+            err = e
+            raise
         finally:
             uninstall_delta(delta_token)
             info.attribute_metrics(delta.snapshot())
+            # flight recorder (runtime/flight.py): this is the ONE
+            # choke point every executed query passes with its full
+            # evidence in hand — attributed metrics, rung/retry
+            # history, the live trace recorder — and with the pool
+            # reservation already released (_run_admitted's finally),
+            # so a post-mortem can never hold memory capacity
+            self._maybe_flight_record(executor, plan, info, err)
+
+    def _maybe_flight_record(self, executor, plan, info, err) -> None:
+        """Capture a post-mortem when the run FAILED, DEGRADED (OOM
+        rung or distributed->local), RETRIED a fragment, or blew its
+        deadline; successes only under ``flight_record_successes``.
+        Best-effort: observability never fails (or retries) a query."""
+        try:
+            triggers = []
+            if err is not None:
+                triggers.append("failed")
+                if isinstance(err, ExceededTimeLimit):
+                    triggers.append("deadline")
+            if info.oom_retries > 0 or info.degraded:
+                triggers.append("degraded")
+            if info.fragment_retries > 0:
+                triggers.append("retried")
+            if not triggers:
+                if not self.session.prop("flight_record_successes"):
+                    return
+                triggers.append("requested")
+            self.session.flight.capture(
+                info, plan, self.session, executor=executor, err=err,
+                triggers=triggers,
+            )
+        except Exception:  # noqa: BLE001 — see docstring
+            REGISTRY.counter("flight.capture_errors").add()
 
     def _run_admitted(self, executor, plan, info, recorder, pool):
         try:
@@ -496,6 +537,11 @@ class QueryManager:
                 # additive: a degraded-to-local run's ladder continues
                 # the count the distributed attempt started
                 info.oom_retries += 1
+                # the ladder's walk, preserved for the post-mortem:
+                # rung ordinals are QUERY-level (they keep counting
+                # across a distributed->local degradation)
+                info.rung_history.append(
+                    {"rung": info.oom_retries, "error": str(e)[:200]})
                 REGISTRY.counter("query.oom_degraded").add()
                 self.session.events.query_degraded(info)
                 if recorder is not None:

@@ -139,6 +139,13 @@ DEFAULT_BOUNDS = tuple(10.0 ** (-5 + i * 0.25) for i in range(29))
 #: everything below 1.0 into two cells and destroy the percentiles
 SELECTIVITY_BOUNDS = (0.01, 0.05, 0.1, 0.25, 0.5, 0.75, 0.9, 0.99, 1.0)
 
+#: ratio-shaped bounds for the exchange-skew histogram: max/mean
+#: delivered rows per destination lives on [1, mesh size] (1 =
+#: balanced, P = one hot partition owns everything) — latency buckets
+#: would crush the whole range into two cells
+SKEW_BOUNDS = (1.0, 1.1, 1.25, 1.5, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0,
+               16.0, 32.0)
+
 #: per-metric bucket shapes — THE place a histogram's boundary choice
 #: lives. ``MetricsRegistry.histogram(name)`` resolves bounds here, so
 #: every call site of a named metric agrees by construction (bounds are
@@ -147,6 +154,7 @@ SELECTIVITY_BOUNDS = (0.01, 0.05, 0.1, 0.25, 0.5, 0.75, 0.9, 0.99, 1.0)
 #: DEFAULT_BOUNDS is the fallback for everything unlisted.
 HISTOGRAM_BOUNDS: dict[str, tuple] = {
     "join.filter_selectivity": SELECTIVITY_BOUNDS,
+    "exchange.skew": SKEW_BOUNDS,
 }
 
 
@@ -304,7 +312,41 @@ def _fmt(v: float) -> str:
     return str(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
 
 
-def to_openmetrics(registry: MetricsRegistry = None) -> str:
+#: ``# HELP`` text per exposition family (post-prefix engine names) —
+#: emitted when known; families without an entry stay HELP-less
+#: (OpenMetrics allows it). Kept to the families whose meaning is not
+#: recoverable from the name alone.
+METRIC_HELP: dict[str, str] = {
+    "exchange.skew": (
+        "max/mean delivered-rows-per-destination ratio of each "
+        "partitioned exchange (1 = balanced)"),
+    "exchange.quota_overflow": (
+        "exchanges whose receive capacity overflowed (the hot "
+        "partition id rides the trace span and flight record)"),
+    "exec.traces": "actual jit traces executed (the no-retrace probe)",
+    "flight.captured": "flight-recorder post-mortems captured",
+    "memory_pool_reserved_bytes": (
+        "bytes currently reserved from the session's memory pool"),
+    "memory_pool_capacity_bytes": "capacity of the session's memory pool",
+    "memory_pool_occupancy": (
+        "reserved/capacity fraction of the session's memory pool"),
+    "exec_cache_entries": (
+        "entries in the process-wide compiled-executable cache "
+        "(ledger: system.exec_cache)"),
+    "flight_recorder_depth": (
+        "post-mortem records currently retained in the session's "
+        "flight-recorder ring"),
+}
+
+
+def _help_line(lines: list, engine_name: str, family: str) -> None:
+    text = METRIC_HELP.get(engine_name)
+    if text:
+        lines.append(f"# HELP {family} {text}")
+
+
+def to_openmetrics(registry: MetricsRegistry = None,
+                   gauges: Optional[dict] = None) -> str:
     """The registry as OpenMetrics/Prometheus text exposition.
 
     - counters -> ``# TYPE f counter`` with one ``f_total`` sample;
@@ -312,8 +354,12 @@ def to_openmetrics(registry: MetricsRegistry = None) -> str:
       ``f_seconds_min``/``_max`` gauges (TimeStat keeps no quantiles);
     - histograms -> ``# TYPE f summary`` with ``quantile`` labels
       (p50/p95/p99 — bucket upper bounds, conservative) plus
-      ``_count``/``_sum`` and an ``f_max`` gauge.
+      ``_count``/``_sum`` and an ``f_max`` gauge;
+    - ``gauges`` (name -> live value, e.g. memory-pool occupancy or
+      cache entry counts — state a monotone counter cannot express)
+      -> ``# TYPE f gauge`` with one sample each.
 
+    Known families also carry a ``# HELP`` line (:data:`METRIC_HELP`).
     Families are emitted in sorted name order and the text ends with
     ``# EOF`` (the OpenMetrics terminator), so the output is both
     scrape-able and deterministic for golden tests.
@@ -322,10 +368,12 @@ def to_openmetrics(registry: MetricsRegistry = None) -> str:
     lines: list[str] = []
     for c in sorted(reg.counters.values(), key=lambda s: s.name):
         f = _metric_name(c.name)
+        _help_line(lines, c.name, f)
         lines.append(f"# TYPE {f} counter")
         lines.append(f"{f}_total {_fmt(c.total)}")
     for t in sorted(reg.timers.values(), key=lambda s: s.name):
         f = _metric_name(t.name) + "_seconds"
+        _help_line(lines, t.name, f)
         lines.append(f"# TYPE {f} summary")
         lines.append(f"{f}_count {_fmt(t.count)}")
         lines.append(f"{f}_sum {_fmt(t.total_s)}")
@@ -336,6 +384,7 @@ def to_openmetrics(registry: MetricsRegistry = None) -> str:
             lines.append(f"{f}_max {_fmt(t.max_s)}")
     for h in sorted(reg.histograms.values(), key=lambda s: s.name):
         f = _metric_name(h.name)
+        _help_line(lines, h.name, f)
         lines.append(f"# TYPE {f} summary")
         for q in (0.5, 0.95, 0.99):
             lines.append(f'{f}{{quantile="{q}"}} {_fmt(h.quantile(q))}')
@@ -344,5 +393,10 @@ def to_openmetrics(registry: MetricsRegistry = None) -> str:
         if h.count:
             lines.append(f"# TYPE {f}_max gauge")
             lines.append(f"{f}_max {_fmt(h.max)}")
+    for name in sorted(gauges or ()):
+        f = _metric_name(name)
+        _help_line(lines, name, f)
+        lines.append(f"# TYPE {f} gauge")
+        lines.append(f"{f} {_fmt(gauges[name])}")
     lines.append("# EOF")
     return "\n".join(lines) + "\n"

@@ -270,6 +270,24 @@ SESSION_PROPERTIES: dict[str, PropertyDef] = {
             _positive,
         ),
         PropertyDef(
+            "flight_recorder_limit", int, 64,
+            "Post-mortem records retained in the session's flight-"
+            "recorder ring (runtime/flight.py; the "
+            "system.flight_recorder table). A record is captured "
+            "automatically whenever a query fails, degrades down the "
+            "OOM ladder, retries a fragment, or exceeds its deadline; "
+            "export via Session.export_flight_record or `python -m "
+            "presto_tpu flightrec`.",
+            _positive,
+        ),
+        PropertyDef(
+            "flight_record_successes", bool, False,
+            "Also capture a flight record for every SUCCESSFUL query "
+            "(plan render + spans + metric delta + pool state) — the "
+            "on-demand post-mortem mode for profiling a healthy run; "
+            "off by default to keep the ring for failures.",
+        ),
+        PropertyDef(
             "plan_stats_limit", int, 512,
             "Plan fingerprints retained in the session's "
             "estimate-vs-actual history store (the system.plan_stats "

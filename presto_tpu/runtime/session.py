@@ -97,6 +97,14 @@ class Session:
         #: ring of recent span traces (Session.export_trace /
         #: system.trace_spans); populated when trace_enabled
         self.traces = TraceStore()
+        #: flight recorder: bounded ring of failure post-mortems
+        #: (runtime/flight.py), auto-captured at run_plan's choke point
+        #: whenever a query fails/degrades/retries/overruns; queryable
+        #: as system.flight_recorder, exportable via
+        #: export_flight_record / `python -m presto_tpu flightrec`
+        from presto_tpu.runtime.flight import FlightRecorder
+
+        self.flight = FlightRecorder(self.prop("flight_recorder_limit"))
         #: lifecycle mechanics: admission control, deadlines, fragment
         #: retry, distributed->local degradation (runtime/lifecycle.py)
         self.query_manager = QueryManager(self)
@@ -175,6 +183,9 @@ class Session:
             # immediately, not silently keep the old size until the
             # next recorded query
             self.plan_stats.resize(self.prop(name))
+        if name == "flight_recorder_limit":
+            # same take-effect rule as the rings above
+            self.flight.resize(self.prop(name))
         if name == "memory_pool_bytes":
             # rebuild the private pool here — not lazily in pool() —
             # so concurrent queries always see exactly one pool
@@ -325,21 +336,31 @@ class Session:
 
     def explain_distributed(self, sql: str) -> str:
         """Fragment/exchange rendering (reference: EXPLAIN (TYPE
-        DISTRIBUTED) via PlanFragmenter + PlanPrinter)."""
+        DISTRIBUTED) via PlanFragmenter + PlanPrinter). Fragment
+        headers carry observed exchange-partition skew from plan-stats
+        history when this plan's fingerprint has recurred — a hot
+        partition seen in past runs is plan-visible, not buried in a
+        finished query's trace."""
         from presto_tpu.plan.fragmenter import fragment_plan
 
         ex = self.executor
+        plan = self.plan(sql)
         # local sessions render with the same session-property defaults
         # a distributed executor would be built with — no duplicated
         # literals that could drift from execution
         fp = fragment_plan(
-            self.plan(sql), self.catalog,
+            plan, self.catalog,
             getattr(ex, "broadcast_limit",
                     self.prop("broadcast_join_row_limit")),
             getattr(ex, "join_build_budget",
                     self.prop("join_build_budget_bytes")),
         )
-        return fp.render()
+        skew = {
+            nid: rec.get("skew", 0.0)
+            for nid, rec in self._plan_hints(plan).items()
+            if rec.get("skew", 0.0) > 1.0
+        }
+        return fp.render(skew_history=skew or None)
 
     def explain_analyze(self, sql: str) -> str:
         """Execute and render the plan annotated with actuals
@@ -889,12 +910,39 @@ class Session:
     def export_metrics(self, path: Optional[str] = None) -> str:
         """The process metrics registry as OpenMetrics/Prometheus text
         exposition (counters, timers, histogram quantiles — see
-        ``runtime.metrics.to_openmetrics``). Returns the text; with
-        ``path``, also writes it there (the scrape-file shape;
-        ``python -m presto_tpu metrics`` is the CLI surface)."""
+        ``runtime.metrics.to_openmetrics``), plus live state gauges the
+        counter registry cannot carry: memory-pool occupancy, compiled-
+        executable cache entries, and this session's flight-recorder
+        ring depth. Returns the text; with ``path``, also writes it
+        there (the scrape-file shape; ``python -m presto_tpu metrics``
+        is the CLI surface)."""
+        from presto_tpu.cache.exec_cache import EXEC_CACHE
         from presto_tpu.runtime.metrics import to_openmetrics
 
-        text = to_openmetrics()
+        snap = self.pool().snapshot()
+        gauges = {
+            "memory_pool_capacity_bytes": snap["capacity_bytes"],
+            "memory_pool_reserved_bytes": snap["reserved_bytes"],
+            "memory_pool_occupancy": (
+                snap["reserved_bytes"] / snap["capacity_bytes"]
+                if snap["capacity_bytes"] else 0.0),
+            "exec_cache_entries": len(EXEC_CACHE),
+            "flight_recorder_depth": len(self.flight),
+        }
+        text = to_openmetrics(gauges=gauges)
+        if path is not None:
+            with open(path, "w") as f:
+                f.write(text)
+        return text
+
+    def export_flight_record(self, path: Optional[str] = None,
+                             query_id: Optional[str] = None) -> str:
+        """Flight-recorder post-mortems as JSON (runtime/flight.py):
+        one record with ``query_id``, else the whole ring (newest
+        last). Returns the JSON text; with ``path``, also writes it
+        there (``python -m presto_tpu flightrec`` is the CLI surface —
+        the dump-on-failure workflow)."""
+        text = self.flight.to_json(query_id)
         if path is not None:
             with open(path, "w") as f:
                 f.write(text)

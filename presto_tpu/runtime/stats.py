@@ -135,6 +135,12 @@ class NodeStats:
     est_rows: int = -1
     #: planner-chosen join strategy for Join/SemiJoin nodes ("" else)
     strategy: str = ""
+    #: worst observed exchange-partition skew (max/mean delivered-row
+    #: ratio across destinations) of the exchanges this node drove;
+    #: 0.0 = no partitioned exchange measured, 1.0 = balanced
+    skew: float = 0.0
+    #: live rows those exchanges delivered (the skew's weight)
+    exchange_rows: int = 0
 
     @property
     def misest(self) -> float:
@@ -157,6 +163,8 @@ class NodeStats:
             "est_rows": self.est_rows,
             "strategy": self.strategy,
             "misest": round(self.misest, 3),
+            "skew": round(self.skew, 3),
+            "exchange_rows": self.exchange_rows,
         }
 
 
@@ -277,6 +285,19 @@ class StatsRecorder:
         if device_bytes >= 0:
             st.device_bytes = max(st.device_bytes, device_bytes)
 
+    def record_skew(self, node, ratio: float, rows: int = 0) -> None:
+        """Attach an exchange-skew observation to the node that drove
+        the exchange (distributed executor flush path): the WORST ratio
+        wins — a post-mortem wants the hottest imbalance, and a
+        capacity-retried exchange reports once per dispatch."""
+        key = self.ids.of(node)
+        st = self.nodes.get(key)
+        if st is None:
+            st = NodeStats(type(node).__name__, node_id=key)
+            self.nodes[key] = st
+        st.skew = max(st.skew, float(ratio))
+        st.exchange_rows += int(rows)
+
     def stats_for(self, node) -> Optional[NodeStats]:
         nid = self.ids.get(node)
         return None if nid is None else self.nodes.get(nid)
@@ -337,6 +358,10 @@ class StatsRecorder:
                 "selectivity": sel,
                 "strategy": est.strategy,
                 "misest": misestimate_ratio(est.est_rows, actual),
+                # observed exchange-partition skew rides the history
+                # beside est/actual: recurring skew becomes visible at
+                # PLAN time (EXPLAIN (TYPE DISTRIBUTED) headers)
+                "skew": 0.0 if st is None else round(st.skew, 3),
             })
         return out
 
@@ -375,6 +400,13 @@ class QueryInfo:
     degraded: bool = False
     #: rungs taken down the runtime-OOM degradation ladder (0 = none)
     oom_retries: int = 0
+    #: per-rung history of the ladder walk ({"rung", "error"} dicts in
+    #: descent order) — the flight recorder's post-mortem evidence for
+    #: WHY a run degraded, not just how far
+    rung_history: list = field(default_factory=list)
+    #: fragment retry events ({"site", "error"} dicts in occurrence
+    #: order) — which dispatch failed retryably, with what
+    retry_events: list = field(default_factory=list)
     #: seconds spent queued on the shared memory pool at admission
     memory_queued_s: float = 0.0
     #: bytes reserved from the pool (the peak stats estimate)
@@ -480,6 +512,8 @@ class QueryInfo:
                 "fragmentRetries": self.fragment_retries,
                 "degraded": self.degraded,
                 "oomRetries": self.oom_retries,
+                "rungHistory": self.rung_history,
+                "retryEvents": self.retry_events,
                 "memoryQueuedS": round(self.memory_queued_s, 6),
                 "memoryReservedBytes": self.memory_reserved_bytes,
                 "cacheHit": self.cache_hit,
@@ -538,12 +572,15 @@ def render_analyzed_plan(plan, recorder: StatsRecorder,
         if st is not None:
             rows = "?" if st.output_rows < 0 else f"{st.output_rows:,}"
             in_rows = "?" if st.input_rows < 0 else f"{st.input_rows:,}"
+            # exchange-partition skew of the exchanges this node drove
+            # (distributed runs only): max/mean delivered-row ratio
+            skew = f", skew {st.skew:.1f}x" if st.skew > 0 else ""
             lines.append(
                 f"{pad}{name}  [wall {st.wall_s * 1e3:.1f}ms, "
                 f"rows {in_rows}->{rows}"
                 f"{est_part(node, st)}, "
                 f"bytes {_fmt_bytes(st.output_bytes)}, "
-                f"calls {st.invocations}]" + strat
+                f"calls {st.invocations}{skew}]" + strat
             )
         else:
             lines.append(

@@ -177,6 +177,25 @@ class TraceRecorder:
     def t0(self) -> float:
         return self.spans[0].t0 if self.spans else 0.0
 
+    def to_span_dicts(self) -> list[dict]:
+        """The span tree as plain dicts with query-relative timestamps
+        (args shared by reference — callers that persist them, like
+        the flight recorder, must deep-copy/coerce). The flattening
+        the ``system.trace_spans`` scan and post-mortem capture share."""
+        t0 = self.t0
+        return [
+            {
+                "span_id": s.span_id,
+                "parent_id": s.parent_id,
+                "name": s.name,
+                "cat": s.cat,
+                "start_s": round(max(s.t0 - t0, 0.0), 6),
+                "duration_s": round(max(s.t1 - s.t0, 0.0), 6),
+                "args": s.args,
+            }
+            for s in self.spans
+        ]
+
     def spans_by_cat(self, cat: str) -> list[Span]:
         return [s for s in self.spans if s.cat == cat]
 

@@ -45,7 +45,14 @@
 #      (plan_templates=0) run, PREPARE/EXECUTE ... USING must bind
 #      correctly, and the global memory pool must drain to zero
 #      (ISSUE-10 acceptance).
-#  10. The tier-1 pytest suite on the CPU backend (virtual-device
+#  10. Flight-recorder smoke: a zipfian distributed repartition must
+#      populate exchange.skew and render a >2x partition-skew ratio in
+#      EXPLAIN ANALYZE (balanced stays ~1x); an injected fault must
+#      auto-capture a post-mortem that round-trips through JSON export
+#      with plan render + spans + metric delta; a warm template re-run
+#      must show system.exec_cache hits with compile_s_saved > 0; the
+#      global pool must drain (ISSUE-12 acceptance).
+#  11. The tier-1 pytest suite on the CPU backend (virtual-device
 #      distributed tests included; `slow` marks excluded), with the
 #      same flags and timeout the driver uses.
 #
@@ -269,7 +276,7 @@ sample = re.compile(r'^[a-zA-Z_][a-zA-Z0-9_]*(\{quantile="0\.\d+"\})? '
                     r'-?\d+(\.\d+)?(e-?\d+)?$')
 names = set()
 for line in lines[:-1]:
-    if line.startswith("# TYPE "):
+    if line.startswith("# TYPE ") or line.startswith("# HELP "):
         continue
     assert sample.match(line), f"unparseable exposition line: {line!r}"
     names.add(line.split("{")[0].split(" ")[0])
@@ -401,6 +408,96 @@ assert hits >= 4, f"template hits not counted ({hits})"
 assert global_pool().reserved_bytes == 0, "global pool reservation leak"
 print("template smoke: 3 bindings + 2 EXECUTEs re-traced 0 steps, "
       "on/off identical, pool balance 0")
+PY
+
+timeout -k 10 420 env JAX_ENABLE_X64=1 python - <<'PY' || exit $?
+# Flight-recorder smoke (ISSUE-12 acceptance): exchange-skew telemetry
+# on a zipfian repartition, auto-captured fault post-mortems with JSON
+# round-trip, and the compile-cost ledger's measured amortization.
+import json
+import re
+import sys
+
+import numpy as np
+import pandas as pd
+
+sys.path.insert(0, ".")
+from __graft_entry__ import _provision_virtual_mesh
+
+_provision_virtual_mesh(8)
+
+from presto_tpu.connectors.tpch import TpchConnector
+from presto_tpu.parallel.mesh import make_mesh
+from presto_tpu.runtime import faults
+from presto_tpu.runtime.memory import global_pool
+from presto_tpu.runtime.metrics import REGISTRY
+from presto_tpu.runtime.session import Session
+
+conn = TpchConnector(sf=0.005)
+rng = np.random.default_rng(12)
+
+# 1) zipfian repartition: one hot key owns ~85% of the probe rows ->
+#    the partition it hashes to receives most of the exchange; the
+#    balanced stream spreads 64 keys uniformly
+s = Session({"tpch": conn}, mesh=make_mesh(8), properties={
+    "result_cache_enabled": False, "broadcast_join_row_limit": 0})
+mem = s.catalog.connector("memory")
+hot = np.where(rng.random(4096) < 0.85, 7, rng.integers(0, 64, 4096))
+mem.create_table("zipf", pd.DataFrame({"k": hot.astype(np.int64)}))
+mem.create_table("flat", pd.DataFrame(
+    {"k": (np.arange(4096) % 64).astype(np.int64)}))
+mem.create_table("dim", pd.DataFrame(
+    {"dk": np.arange(64, dtype=np.int64)}))
+q = "select count(*) c from {} join dim on k = dk"
+before = REGISTRY.snapshot().get("exchange.skew.count", 0)
+out_skew = s.explain_analyze(q.format("zipf"))
+out_flat = s.explain_analyze(q.format("flat"))
+assert REGISTRY.snapshot().get("exchange.skew.count", 0) > before, \
+    "exchange.skew histogram not populated"
+
+def join_skew(rendered):
+    m = re.search(r"Join .*skew ([\d.]+)x", rendered)
+    assert m, "no skew rendered on the Join:\n" + rendered
+    return float(m.group(1))
+
+ratio_hot, ratio_flat = join_skew(out_skew), join_skew(out_flat)
+assert ratio_hot > 2.0, f"zipfian skew ratio {ratio_hot} not > 2x"
+assert ratio_flat < 2.0, f"balanced skew ratio {ratio_flat} not ~1x"
+ps = s.sql("select node_type from plan_stats where skew > 2")
+assert len(ps) >= 1, "skew not persisted into system.plan_stats"
+
+# 2) injected fault -> auto-captured post-mortem, JSON round trip
+inj = faults.FaultInjector()
+inj.inject("aggregation", times=None)
+failed = False
+try:
+    with faults.injected(inj):
+        s.sql(q.format("zipf"))
+except Exception:
+    failed = True
+assert failed, "injected fault did not surface"
+rec = s.flight.latest()
+assert rec is not None and rec.state == "FAILED", "no post-mortem captured"
+d = json.loads(s.export_flight_record(query_id=rec.query_id))
+assert d["errorCode"] and d["planRender"] and d["spans"] and d["metrics"], d
+assert d["pool"]["reserved_bytes"] == 0, "post-mortem holds pool capacity"
+
+# 3) compile-cost ledger: warm template re-run -> hits + measured
+#    amortization in system.exec_cache
+s2 = Session({"tpch": conn}, properties={"result_cache_enabled": False})
+tq = ("select count(*) c from orders where o_orderkey < {}")
+s2.sql(tq.format(1000))
+s2.sql(tq.format(5000))  # warm: same template, new binding
+ec = s2.sql("select sum(hits) h, sum(compile_s_saved) saved "
+            "from exec_cache")
+assert float(ec["h"][0]) > 0, "warm re-run produced no exec-cache hits"
+assert float(ec["saved"][0]) > 0, "compile_s_saved not measured"
+
+assert global_pool().reserved_bytes == 0, "global pool reservation leak"
+print("flight smoke: zipf skew %.1fx / balanced %.1fx, post-mortem "
+      "JSON ok (%d spans), ledger saved %.3fs over %d hits, pool 0"
+      % (ratio_hot, ratio_flat, len(d["spans"]),
+         float(ec["saved"][0]), int(ec["h"][0])))
 PY
 
 rm -f /tmp/_t1.log

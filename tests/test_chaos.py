@@ -117,6 +117,37 @@ def _arm_faults(inj: faults.FaultInjector, rng: random.Random) -> None:
             )
 
 
+def _assert_flight_postmortem(session, info) -> None:
+    """The flight-recorder contract the chaos suite enforces on every
+    typed failure (and every degraded success): exactly one COMPLETE
+    post-mortem — plan render, spans (tracing is on in these rounds),
+    attributed metric delta, rung history list — captured at the
+    run_plan choke point, holding zero pool reservation."""
+    recs = [r for r in session.flight.records()
+            if r.query_id == info.query_id]
+    assert len(recs) == 1, (
+        f"{info.query_id}: {len(recs)} flight records (want exactly 1)"
+    )
+    rec = recs[0]
+    assert rec.plan_render and "render failed" not in rec.plan_render
+    assert rec.spans, "post-mortem captured no trace spans"
+    assert rec.metrics, "post-mortem captured no metric delta"
+    assert isinstance(rec.rung_history, list)
+    assert rec.oom_rung == info.oom_retries
+    assert len(rec.rung_history) == info.oom_retries
+    # recording must never hold pool capacity: the reservation was
+    # released BEFORE capture, and the record proves it
+    assert rec.pool.get("reserved_bytes", 0) == 0
+    # the export path is part of the contract: a record that cannot
+    # round-trip through JSON is not a post-mortem anyone can read
+    import json as _json
+
+    dumped = _json.loads(session.export_flight_record(
+        query_id=info.query_id))
+    assert dumped["queryId"] == info.query_id
+    assert dumped["planRender"] == rec.plan_render
+
+
 def run_chaos_round(conn, oracle, seed: int, mesh=None) -> str:
     """One seeded round. Asserts the robustness contract and returns an
     outcome label ("ok:<query>", "typed:<ERROR_CODE>:<query>")."""
@@ -150,12 +181,22 @@ def run_chaos_round(conn, oracle, seed: int, mesh=None) -> str:
             f"seed {seed}: untyped failure {type(e).__name__}: {e}"
         )
         outcome = f"typed:{error_code(e)}:{qname}"
+        # flight-recorder contract: the surfaced failure's attempt left
+        # exactly one complete, JSON-exportable post-mortem
+        failed = [i for i in session.query_history if i.state == "FAILED"]
+        assert failed, f"seed {seed}: typed failure but no FAILED info"
+        _assert_flight_postmortem(session, failed[-1])
     else:
         assert frames_equal(df, oracle[qname]), (
             f"seed {seed}: WRONG ANSWER on {qname} "
             f"(faults: {[s.site for s in inj.specs]})"
         )
         outcome = f"ok:{qname}"
+        info = session.query_history[-1]
+        if info.oom_retries > 0 or info.fragment_retries > 0:
+            # degraded/retried successes auto-capture too (rung > 0 is
+            # evidence worth keeping even when the answer was right)
+            _assert_flight_postmortem(session, info)
     wall = time.monotonic() - t0
     assert wall < HANG_BUDGET_S, f"seed {seed}: round took {wall:.0f}s"
     assert session.pool().reserved_bytes == 0, (

@@ -358,11 +358,13 @@ def test_explain_renders_strategies(conns):
 def test_fragment_is_cached_zero_warm_retraces(conns):
     """Warm repeats of a routed query re-trace nothing (the fused step
     lives in the content-keyed executable cache)."""
+    from presto_tpu.cache.exec_cache import trace_delta
+
     s = make_session(conns)
     s.sql(TPCH["q6"])
-    t0 = snap("exec.traces")
-    s.sql(TPCH["q6"])
-    assert snap("exec.traces") == t0
+    with trace_delta() as td:
+        s.sql(TPCH["q6"])
+    assert td.traces == 0
 
 
 @pytest.mark.slow

@@ -239,7 +239,8 @@ _SAMPLE = re.compile(
 
 def _parse_exposition(text: str) -> dict:
     """Minimal OpenMetrics parser: every line must be a comment
-    (# TYPE / # EOF) or a valid sample; returns {family: value}."""
+    (# TYPE / # HELP / # EOF) or a valid sample; returns
+    {family: value}."""
     samples = {}
     lines = text.splitlines()
     assert lines[-1] == "# EOF"
@@ -248,6 +249,9 @@ def _parse_exposition(text: str) -> dict:
             parts = line.split()
             assert len(parts) == 4 and parts[3] in (
                 "counter", "gauge", "summary", "histogram"), line
+            continue
+        if line.startswith("# HELP "):
+            assert len(line.split()) >= 4, line  # family + some text
             continue
         assert _SAMPLE.match(line), f"unparseable sample line: {line!r}"
         name, _, value = line.rpartition(" ")
@@ -265,6 +269,25 @@ def test_openmetrics_exposition_parses_and_has_known_counters(conn):
     # histogram families expose quantiles + count/sum
     assert 'presto_tpu_query_execution_s{quantile="0.5"}' in samples
     assert samples["presto_tpu_query_execution_s_count"] >= 1
+
+
+def test_openmetrics_live_state_gauges(conn):
+    """Session.export_metrics carries the live-state gauges the counter
+    registry can't: pool occupancy, exec-cache entries, and the
+    flight-recorder ring depth — each with TYPE gauge and a HELP line
+    (to_openmetrics alone, with no gauges passed, emits none)."""
+    s = Session({"tpch": conn})
+    s.sql("select count(*) c from nation")
+    text = s.export_metrics()
+    samples = _parse_exposition(text)
+    assert samples["presto_tpu_memory_pool_capacity_bytes"] > 0
+    assert samples["presto_tpu_memory_pool_reserved_bytes"] >= 0
+    assert samples["presto_tpu_exec_cache_entries"] >= 1
+    assert samples["presto_tpu_flight_recorder_depth"] >= 0
+    assert "# TYPE presto_tpu_exec_cache_entries gauge" in text
+    assert "# HELP presto_tpu_flight_recorder_depth" in text
+    bare = to_openmetrics(REGISTRY)
+    assert "presto_tpu_exec_cache_entries" not in bare
 
 
 def test_export_metrics_writes_path(tmp_path, conn):

@@ -24,6 +24,7 @@ import time
 import pandas as pd
 import pytest
 
+from presto_tpu.cache.exec_cache import trace_delta
 from presto_tpu.connectors.tpch import TpchConnector
 from presto_tpu.runtime.errors import UserError
 from presto_tpu.runtime.lifecycle import InflightCoalescer, QueryManager
@@ -86,14 +87,15 @@ ELIGIBLE_POSITIONS = [
 def test_eligible_position_zero_warm_retraces(name, fmt, lits):
     s = make_session()
     dfs = {lits[0]: s.sql(fmt.format(lits[0]))}  # cold: trace once
-    # warm bindings all inside ONE trace-delta window (exec.traces is
-    # process-global — interleaving the off-session here would count
-    # ITS traces and fake a failure)
-    t0 = counter("exec.traces")
-    for v in lits[1:]:
-        dfs[v] = s.sql(fmt.format(v))
-        assert s.query_history[-1].template_hit
-    assert counter("exec.traces") == t0, \
+    # warm bindings all inside ONE trace_delta window (exec.traces is
+    # process-global — keep the off-session's runs OUTSIDE the window,
+    # or their traces would fake a failure: the PR 9 footgun the
+    # helper exists to retire)
+    with trace_delta() as td:
+        for v in lits[1:]:
+            dfs[v] = s.sql(fmt.format(v))
+            assert s.query_history[-1].template_hit
+    assert td.traces == 0, \
         f"{name}: warm same-template bindings re-traced"
     off = make_session(plan_templates=False)
     for v, df in dfs.items():
@@ -110,9 +112,9 @@ def test_off_mode_retraces_fresh_literals():
     # is process-global and content-keyed, so a reused literal would be
     # legitimately warm even with templates off
     off.sql(fmt.format(3333))
-    t0 = counter("exec.traces")
-    off.sql(fmt.format(7777))
-    assert counter("exec.traces") > t0
+    with trace_delta() as td:
+        off.sql(fmt.format(7777))
+    assert td.traces > 0
     assert not off.query_history[-1].template_hit
 
 
@@ -165,9 +167,9 @@ def test_prepare_execute_python_api():
     s = make_session()
     h = s.prepare("select count(*) c from orders where o_orderkey < ?")
     df1, info1 = s.execute(h, [512])
-    t0 = counter("exec.traces")
-    df2, info2 = s.execute(h, [4096])
-    assert counter("exec.traces") == t0  # new binding, zero re-traces
+    with trace_delta() as td:
+        df2, info2 = s.execute(h, [4096])
+    assert td.traces == 0  # new binding, zero re-traces
     assert info2.template_hit and info2.state == "FINISHED"
     off = make_session(plan_templates=False)
     pd.testing.assert_frame_equal(
@@ -355,13 +357,13 @@ def test_concurrent_distinct_literals_ride_one_warm_template():
     def worker(v):
         results[v] = s.sql(fmt.format(v))
 
-    t0 = counter("exec.traces")
-    threads = [threading.Thread(target=worker, args=(v,)) for v in lits]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join(60)
-    assert counter("exec.traces") == t0, "concurrent bindings re-traced"
+    with trace_delta() as td:
+        threads = [threading.Thread(target=worker, args=(v,)) for v in lits]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+    assert td.traces == 0, "concurrent bindings re-traced"
     off = make_session(plan_templates=False)
     for v in lits:
         pd.testing.assert_frame_equal(results[v], off.sql(fmt.format(v)))
@@ -457,11 +459,11 @@ def test_distributed_template_zero_warm_retraces():
            " where l_extendedprice < {}"
            " group by o_orderpriority order by o_orderpriority")
     dfs = {(0, 20000): s.sql(fmt.format(0, 20000))}
-    t0 = counter("exec.traces")
-    for args in ((7, 50000), (29, 90000)):
-        dfs[args] = s.sql(fmt.format(*args))
-        assert s.query_history[-1].template_hit
-    assert counter("exec.traces") == t0, "distributed warm bindings re-traced"
+    with trace_delta() as td:
+        for args in ((7, 50000), (29, 90000)):
+            dfs[args] = s.sql(fmt.format(*args))
+            assert s.query_history[-1].template_hit
+    assert td.traces == 0, "distributed warm bindings re-traced"
     off = make_session(plan_templates=False)
     for args, df in dfs.items():
         pd.testing.assert_frame_equal(df, off.sql(fmt.format(*args)))
