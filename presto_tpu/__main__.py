@@ -158,6 +158,80 @@ def repl(session, max_rows: int):
             return
 
 
+def load_tenants(path):
+    """Tenant config JSON -> (specs, total_slots). Accepts a bare list
+    of {name, weight?, max_concurrent?, max_bytes?} objects or
+    {"total_slots": N, "tenants": [...]}."""
+    import json
+
+    from presto_tpu.server.scheduler import TenantSpec
+
+    with open(path) as f:
+        cfg = json.load(f)
+    total = None
+    rows = cfg
+    if isinstance(cfg, dict):
+        total = cfg.get("total_slots")
+        rows = cfg.get("tenants", [])
+    specs = [
+        TenantSpec(r["name"], float(r.get("weight", 1.0)),
+                   r.get("max_concurrent"), r.get("max_bytes"))
+        for r in rows
+    ]
+    return specs, total
+
+
+def serve(session, args) -> None:
+    """``python -m presto_tpu serve``: the multi-tenant HTTP front-end
+    over one session, with graceful SIGINT shutdown — stop accepting,
+    drain in-flight queries (pool reservations release on every
+    terminal state), flush the flight recorder when --flight-out is
+    given."""
+    import signal
+
+    from presto_tpu.server.frontend import HttpFrontend, QueryServer
+
+    # the serving layer exists to exploit load shape: batched dispatch
+    # defaults ON unless the operator explicitly set the property
+    if "batched_dispatch" not in session.properties:
+        session.set_property("batched_dispatch", True)
+    tenants, total_slots = (load_tenants(args.tenants)
+                            if args.tenants else ([], None))
+    server = QueryServer(session=session, tenants=tenants,
+                         total_slots=total_slots)
+    import threading
+
+    http = HttpFrontend(server, host=args.host, port=args.port)
+    stop = threading.Event()
+
+    def on_sigint(signum, frame):
+        # first ^C: graceful drain below; a second ^C falls through to
+        # the default handler (hard exit)
+        signal.signal(signal.SIGINT, signal.default_int_handler)
+        stop.set()
+
+    signal.signal(signal.SIGINT, on_sigint)
+    ten = ", ".join(s.name for s in tenants) or "(open admission)"
+    print(f"presto-tpu serving on http://{args.host}:{http.port} "
+          f"— tenants: {ten}; ^C drains and exits", flush=True)
+    # the HTTP loop runs on a worker thread: httpd.shutdown() deadlocks
+    # when called from the thread inside serve_forever (the SIGINT
+    # handler runs on the main thread's stack), so the main thread just
+    # waits for the signal and then drives the drain
+    http.start_background()
+    try:
+        stop.wait()
+    finally:
+        http.shutdown()
+        summary = server.shutdown(drain_timeout_s=30.0,
+                                  flight_path=args.flight_out)
+        print(f"drained={summary['drained']} "
+              f"inflight={summary['inflight']} "
+              f"pool_reserved_bytes={summary['pool_reserved_bytes']} "
+              f"flight_records={summary['flight_records']}"
+              + (f" -> {args.flight_out}" if args.flight_out else ""))
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="python -m presto_tpu", description=__doc__,
@@ -172,7 +246,9 @@ def main(argv=None):
                          "same way (the dump-on-failure workflow: "
                          "`python -m presto_tpu flightrec -e '<sql>'` "
                          "captures and dumps any failure the statement "
-                         "hits)")
+                         "hits); 'serve' starts the multi-tenant HTTP "
+                         "front-end (presto_tpu.server) on --port with "
+                         "graceful SIGINT drain")
     ap.add_argument("--catalog", default="tpch",
                     help="tpch | tpcds | ssb (default tpch)")
     ap.add_argument("--sf", type=float, default=0.01,
@@ -186,6 +262,18 @@ def main(argv=None):
     ap.add_argument("--max-rows", type=int, default=100)
     ap.add_argument("--session", action="append", default=[],
                     metavar="NAME=VALUE", help="initial session property")
+    ap.add_argument("--host", default="127.0.0.1",
+                    help="serve: bind address (default 127.0.0.1)")
+    ap.add_argument("--port", type=int, default=8080,
+                    help="serve: HTTP port (default 8080; 0 = ephemeral)")
+    ap.add_argument("--tenants", default=None, metavar="CFG",
+                    help="serve: JSON tenant config file — either a "
+                         "list of {name, weight, max_concurrent, "
+                         "max_bytes} objects or {'total_slots': N, "
+                         "'tenants': [...]}")
+    ap.add_argument("--flight-out", default=None, metavar="PATH",
+                    help="serve: write the flight-recorder ring as "
+                         "JSON to PATH during graceful shutdown")
     args = ap.parse_args(argv)
 
     from presto_tpu.runtime.session import Session
@@ -202,9 +290,12 @@ def main(argv=None):
     conn = make_connector(args.catalog, args.sf)
     session = Session({args.catalog: conn}, properties=props, mesh=mesh)
 
-    if args.command not in (None, "metrics", "flightrec"):
+    if args.command not in (None, "metrics", "flightrec", "serve"):
         raise SystemExit(
-            f"unknown command {args.command!r} ('metrics', 'flightrec')")
+            f"unknown command {args.command!r} "
+            "('metrics', 'flightrec', 'serve')")
+    if args.command == "serve":
+        return serve(session, args)
     ran = False
     if args.execute is not None:
         run_statement(session, args.execute, args.max_rows)

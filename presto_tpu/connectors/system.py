@@ -56,6 +56,15 @@ SCHEMAS: dict[str, dict[str, DataType]] = {
         "template_hit": BIGINT,
         # coalesced onto a concurrent identical in-flight execution
         "coalesced": BIGINT,
+        # rode a cross-query batched dispatch (server/batcher.py):
+        # stacked with concurrent same-template bindings into one
+        # vmapped device program
+        "batched": BIGINT,
+        # serving-layer tenant attribution ("" outside the front-end).
+        # 48 bytes of UTF-8; names longer than that DO truncate in the
+        # system tables (the scheduler and metric suffixes keep full
+        # names) — keep tenant identifiers short
+        "tenant": fixed_bytes(48),
         "approximate": BIGINT,
         "degraded": BIGINT,
         "oom_retries": BIGINT,
@@ -121,6 +130,24 @@ SCHEMAS: dict[str, dict[str, DataType]] = {
         "compile_s_saved": DOUBLE,
         "age_s": DOUBLE,
         "idle_s": DOUBLE,
+    },
+    # serving-layer tenant registry (server/scheduler.FairScheduler,
+    # attached by a fronting QueryServer): one row per tenant with its
+    # fairness contract and live scheduling state; empty outside the
+    # serving layer
+    "tenants": {
+        "tenant": fixed_bytes(48),
+        "weight": DOUBLE,
+        "max_concurrent": BIGINT,  # -1 = unlimited
+        "max_bytes": BIGINT,       # -1 = unlimited
+        "running": BIGINT,
+        "peak_running": BIGINT,
+        "queued": BIGINT,
+        "admitted": BIGINT,
+        "over_quota_blocked": BIGINT,
+        "queue_timeouts": BIGINT,
+        "reserved_bytes": BIGINT,
+        "vtime": DOUBLE,
     },
     # live state of the memory pool this session admits through
     # (runtime/memory.MemoryPool): one row, materialized at scan time
@@ -222,6 +249,8 @@ class SystemConnector:
                 [int(i.cache_hit) for i in infos],
                 [int(i.template_hit) for i in infos],
                 [int(i.coalesced) for i in infos],
+                [int(i.batched) for i in infos],
+                [i.tenant for i in infos],
                 [int(i.approximate) for i in infos],
                 [int(i.degraded) for i in infos],
                 [i.oom_retries for i in infos],
@@ -286,6 +315,14 @@ class SystemConnector:
                 [r["age_s"] for r in rows],
                 [r["idle_s"] for r in rows],
             )
+        if table == "tenants":
+            sched = getattr(self._session, "tenants", None)
+            rows = sched.snapshot() if sched is not None else []
+            keys = ("tenant", "weight", "max_concurrent", "max_bytes",
+                    "running", "peak_running", "queued", "admitted",
+                    "over_quota_blocked", "queue_timeouts",
+                    "reserved_bytes", "vtime")
+            return tuple([r[k] for r in rows] for k in keys)
         if table == "memory_pool":
             pool = self._session.pool()
             snap = pool.snapshot()  # one lock: internally consistent
@@ -353,8 +390,8 @@ class SystemConnector:
             }
         elif table == "query_history":
             (qid, state, sql, tok, queued, planning, execution, elapsed,
-             outrows, retries, hits, tmpl, coal, approx, degraded, oomr,
-             memq, ecode, rung, jstrat, fsel) = rows
+             outrows, retries, hits, tmpl, coal, batched, tenant, approx,
+             degraded, oomr, memq, ecode, rung, jstrat, fsel) = rows
             arrays = {
                 "query_id": _bytes_col(qid, 24),
                 "state": STATE_DICT.encode(state).astype(np.int32),
@@ -369,6 +406,8 @@ class SystemConnector:
                 "cache_hit": np.asarray(hits, np.int64),
                 "template_hit": np.asarray(tmpl, np.int64),
                 "coalesced": np.asarray(coal, np.int64),
+                "batched": np.asarray(batched, np.int64),
+                "tenant": _bytes_col(tenant, 48),
                 "approximate": np.asarray(approx, np.int64),
                 "degraded": np.asarray(degraded, np.int64),
                 "oom_retries": np.asarray(oomr, np.int64),
@@ -427,6 +466,23 @@ class SystemConnector:
                 "compile_s_saved": np.asarray(saved, np.float64),
                 "age_s": np.asarray(age, np.float64),
                 "idle_s": np.asarray(idle, np.float64),
+            }
+        elif table == "tenants":
+            (tname, weight, maxc, maxb, running, peak, queued, admitted,
+             blocked, timeouts, resv, vtime) = rows
+            arrays = {
+                "tenant": _bytes_col(tname, 48),
+                "weight": np.asarray(weight, np.float64),
+                "max_concurrent": np.asarray(maxc, np.int64),
+                "max_bytes": np.asarray(maxb, np.int64),
+                "running": np.asarray(running, np.int64),
+                "peak_running": np.asarray(peak, np.int64),
+                "queued": np.asarray(queued, np.int64),
+                "admitted": np.asarray(admitted, np.int64),
+                "over_quota_blocked": np.asarray(blocked, np.int64),
+                "queue_timeouts": np.asarray(timeouts, np.int64),
+                "reserved_bytes": np.asarray(resv, np.int64),
+                "vtime": np.asarray(vtime, np.float64),
             }
         elif table == "memory_pool":
             name, cap, reserved, free, active, queued = rows

@@ -175,6 +175,13 @@ class DistributedExecutor(OomLadderMixin):
     explicit shard_map fragment step with the exchange inside.
     """
 
+    #: cross-query batched dispatch (server/batcher.py) stays off on
+    #: this tier: stacking a binding axis onto shard_map/GSPMD fragment
+    #: steps would nest a vmap around mesh collectives — sessions with
+    #: a mesh fall back to PR 9's serialized template slot, counted
+    #: under ``batch.fallback.distributed``
+    supports_batched_dispatch = False
+
     def __init__(
         self,
         catalog: Catalog,

@@ -164,6 +164,12 @@ def pick_group_strategy(keys, pax, dict_len, est_rows: int,
 
 
 class LocalExecutor(OomLadderMixin):
+    #: the cross-query batched dispatcher (server/batcher.py) can stack
+    #: this executor's param bindings into one vmapped dispatch — the
+    #: single-device pipeline is the one whose whitelisted operator
+    #: steps are pure (batch, params) functions
+    supports_batched_dispatch = True
+
     def __init__(self, catalog: Catalog, join_build_budget: int | None = None,
                  direct_group_limit: int = DIRECT_LIMIT,
                  runtime_join_filters: bool = True,

@@ -765,18 +765,25 @@ class GlobalAggregationOperator(Operator):
         self.state = self._update(self.state, batch, self._params)
         return []
 
-    def finish(self) -> list[Batch]:
-        if self.state is None:
-            self.state = self._init()
+    def result_batch(self, state) -> Batch:
+        """Pure finalize: accumulated state -> the one-row result batch.
+        Shared by ``finish()`` (concrete state) and the cross-query
+        batched dispatcher (traced, param-stacked state — see
+        server/batcher.py), so both paths run IDENTICAL math."""
         cols = {}
         for a in self.aggs:
-            n = self.state[a.name + "$n"]
+            n = state[a.name + "$n"]
             valid = (n > 0) | jnp.asarray(a.kind in ("count", "count_star"))
-            data = jnp.where(valid, self.state[a.name], 0)
+            data = jnp.where(valid, state[a.name], 0)
             cols[a.name] = Column(
                 data.astype(a.dtype.jnp_dtype)[None], valid[None], a.dtype
             )
-        return [Batch(cols, jnp.ones(1, jnp.bool_))]
+        return Batch(cols, jnp.ones(1, jnp.bool_))
+
+    def finish(self) -> list[Batch]:
+        if self.state is None:
+            self.state = self._init()
+        return [self.result_batch(self.state)]
 
 
 # ---------------------------------------------------------------------------
@@ -885,10 +892,10 @@ class OrderByOperator(CollectingOperator):
         super().__init__()
         self.keys = list(keys)
 
-    def finish(self) -> list[Batch]:
-        if not self.batches:
-            return []
-        batch = concat_batches(self.batches)
+    def result_batch(self, batch: Batch) -> Batch:
+        """Pure sort of one concatenated batch (shared by ``finish()``
+        and the cross-query batched dispatcher — see finish/result
+        split note on GlobalAggregationOperator.result_batch)."""
         vals = [evaluate(k.expr, batch) for k in self.keys]
         order = sort_indices(
             [v.data for v in vals],
@@ -904,7 +911,12 @@ class OrderByOperator(CollectingOperator):
             )
             for n in batch.names
         }
-        return [Batch(cols, batch.live[order])]
+        return Batch(cols, batch.live[order])
+
+    def finish(self) -> list[Batch]:
+        if not self.batches:
+            return []
+        return [self.result_batch(concat_batches(self.batches))]
 
 
 class TopNOperator(CollectingOperator):
@@ -918,7 +930,11 @@ class TopNOperator(CollectingOperator):
     def finish(self) -> list[Batch]:
         if not self.batches:
             return []
-        batch = concat_batches(self.batches)
+        return [self.result_batch(concat_batches(self.batches))]
+
+    def result_batch(self, batch: Batch) -> Batch:
+        """Pure top-N of one concatenated batch (shared by ``finish()``
+        and the cross-query batched dispatcher)."""
         vals = [evaluate(k.expr, batch) for k in self.keys]
         order = sort_indices(
             [v.data for v in vals],
@@ -945,7 +961,7 @@ class TopNOperator(CollectingOperator):
             )
             for n_ in batch.names
         }
-        return [Batch(cols, live)]
+        return Batch(cols, live)
 
 
 class WindowOperator(CollectingOperator):

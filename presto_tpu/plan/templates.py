@@ -315,6 +315,91 @@ def _count_eligible_literals(obj) -> int:
     return 0
 
 
+#: aggregate kinds the batched dispatcher's global-aggregation replay
+#: covers (GlobalAggregationOperator's exact update/finish math)
+_BATCHABLE_AGG_KINDS = frozenset({"sum", "count", "count_star", "min", "max"})
+
+
+def unbatchable_reason(plan: N.PlanNode, catalog) -> Optional[str]:
+    """Why a plan template cannot take the cross-query batched-dispatch
+    route (``server/batcher.py``) — or ``None`` when it can.
+
+    The batched dispatcher replays a template once with every queued
+    binding's literal slots stacked on a leading axis (one vmapped
+    device dispatch computes N results). That is only sound for plans
+    whose execution is a PURE function of (scan data, params): exactly
+    one table scan feeding a chain of streaming filter/project steps
+    into at most one pipeline breaker whose finalize math is traceable
+    (global aggregation, sort, top-N). Everything else — joins (their
+    capacity-overflow retries and runtime-filter probes branch on
+    per-binding values host-side), grouped aggregation (overflow /
+    NULL-key flags are host-checked), windows, set ops, subqueries,
+    LIMIT (value-dependent host cutoff), volatile system scans, and
+    fragments the leaf-route matcher would lower to a fused kernel —
+    falls back to PR 9's serialized template slot, counted per reason
+    under ``batch.fallback.*``. The reasons are the observability
+    contract: a serving workload that never batches should say WHY."""
+    breakers = 0
+
+    def walk(node: N.PlanNode) -> Optional[str]:
+        nonlocal breakers
+        if isinstance(node, N.Output):
+            return walk(node.child)
+        if isinstance(node, (N.TopN, N.Sort)):
+            breakers += 1
+            if breakers > 1:
+                return "multi_breaker"
+            return walk(node.child)
+        if isinstance(node, N.Aggregate):
+            # the serial executor's global-aggregation condition: no
+            # keys, no passengers (a plain global agg's unique_sets is
+            # the one empty grouping set, which that path ignores)
+            if node.keys or node.passengers:
+                return "grouped_agg"
+            if any(a.kind not in _BATCHABLE_AGG_KINDS for a in node.aggs):
+                return "agg_kind"
+            try:
+                from presto_tpu.exec.leaf_route import match_leaf_fragment
+
+                route, _ = match_leaf_fragment(node, catalog)
+                if route is not None:
+                    # the serial path runs the fused kernel; batching
+                    # must not silently re-route it through the
+                    # generic replay
+                    return "leaf_route"
+            except Exception:  # noqa: BLE001 — conservative: no batch
+                return "leaf_route"
+            breakers += 1
+            if breakers > 1:
+                return "multi_breaker"
+            return walk(node.child)
+        if isinstance(node, (N.Filter, N.Project)):
+            return walk(node.child)
+        if isinstance(node, N.TableScan):
+            conn = catalog.connectors.get(node.connector)
+            if conn is None or getattr(conn, "volatile", False):
+                return "volatile"
+            return None
+        if isinstance(node, (N.Join, N.SemiJoin)):
+            return "join"
+        if isinstance(node, N.Window):
+            return "window"
+        if isinstance(node, N.Union):
+            return "union"
+        if isinstance(node, (N.BindScalars, N.ScalarValue)):
+            return "subquery"
+        if isinstance(node, N.Limit):
+            return "limit"
+        if isinstance(node, N.Values):
+            return "values"
+        return "unsupported"
+
+    try:
+        return walk(plan)
+    except Exception:  # noqa: BLE001 — advisory gate; never fail a query
+        return "unsupported"
+
+
 def parameterize_plan(plan: N.PlanNode, catalog, start_slot: int = 0):
     """Auto-parameterize a pruned plan: every eligible ``Literal``
     becomes a typed ``Param`` slot (numbered from ``start_slot``, after

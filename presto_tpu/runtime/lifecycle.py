@@ -323,6 +323,13 @@ class QueryManager:
         #: in-flight query coalescing (plan-template parameterization's
         #: cross-query batching rung; see InflightCoalescer)
         self.coalescer = InflightCoalescer()
+        #: cross-query BATCHED dispatch (server/batcher.py): concurrent
+        #: same-template different-literal queries meet here and fuse
+        #: into one vmapped dispatch when the ``batched_dispatch``
+        #: session property is on (the serving layer's default)
+        from presto_tpu.server.batcher import TemplateBatchGate
+
+        self.batch_gate = TemplateBatchGate()
 
     # -- admission ------------------------------------------------------
     def admission_limit(self) -> int:
@@ -338,7 +345,7 @@ class QueryManager:
 
         return device_budget_bytes() * DEFAULT_POOL_HEADROOM
 
-    def admit(self, plan, info, pool) -> None:
+    def admit(self, plan, info, pool, scale: int = 1) -> int:
         """Admission in two stages: the per-query limit rejects
         (ResourceExhausted) before launch when the plan's peak
         estimated materialization exceeds it; then the shared memory
@@ -349,6 +356,18 @@ class QueryManager:
         offending node type, and the live pool reservations."""
         limit = self.admission_limit()
         peak, node = peak_estimate_bytes(plan, self.session.catalog)
+        # a cross-query batch leader executes `scale` fused lanes in
+        # one dispatch: its reservation should cover them all (loose —
+        # lanes share the scan — but admission estimates are loose
+        # upper shapes everywhere). The scale is CLAMPED so it can
+        # never fail a query the serial path would have admitted:
+        # batching multiplies work, never failures — the reject below
+        # keeps its serial (scale=1) semantics.
+        scale = max(1, int(scale))
+        if scale > 1 and peak > 0:
+            scale = min(scale,
+                        max(1, limit // peak),
+                        max(1, pool.capacity_bytes // peak))
         if peak > limit:
             REGISTRY.counter("query.admission_rejected").add()
             raise ResourceExhausted(
@@ -370,16 +389,24 @@ class QueryManager:
         t0 = time.monotonic()
         try:
             queued_s = pool.reserve(
-                info.query_id, peak,
+                info.query_id, peak * scale,
                 timeout_s=timeout_s,
-                detail=f"peak estimate {peak} bytes at {node}",
+                detail=f"peak estimate {peak} bytes at {node}"
+                       + (f" x{scale} batch lanes" if scale > 1 else ""),
+                # serving-layer attribution: the reservation carries the
+                # query's tenant so the fairness scheduler's byte quotas
+                # (server/scheduler.py) gate on REAL pool residency
+                tenant=info.tenant or None,
             )
         except ResourceExhausted:
             # a timed-out query queued the LONGEST — record its wait
             info.memory_queued_s = time.monotonic() - t0
             raise
-        info.memory_reserved_bytes = peak
+        info.memory_reserved_bytes = peak * scale
         info.memory_queued_s = queued_s
+        # the GRANTED width: when the clamp shrank it, the batch leader
+        # must trim its dispatch to the lanes this reservation covers
+        return scale
 
     # -- execution scope ------------------------------------------------
     def _context(self, info) -> QueryContext:
@@ -477,7 +504,14 @@ class QueryManager:
     def _run_admitted(self, executor, plan, info, recorder, pool):
         try:
             with trace_span("admission", "lifecycle"):
-                self.admit(plan, info, pool)
+                granted = self.admit(
+                    plan, info, pool,
+                    scale=getattr(executor, "admission_scale", 1))
+                if granted != getattr(executor, "admission_scale", 1):
+                    # a clamped batch leader may only dispatch the
+                    # lanes its reservation covers; the rest re-queue
+                    # at the gate (server/batcher.BatchRunner.run)
+                    executor.admission_scale_granted = granted
         finally:
             # admission — including any time blocked in the pool's
             # FIFO queue — is QUEUED time, not execution: re-stamp the

@@ -100,6 +100,15 @@ class MemoryPool:
         self._cv = threading.Condition()
         self._reservations: dict[str, int] = {}
         self._queue: deque = deque()  # FIFO waiter tickets
+        #: serving-layer attribution: query_id -> tenant, plus the
+        #: per-tenant byte rollup the fairness scheduler's byte quotas
+        #: read (server/scheduler.py)
+        self._tenant_of: dict[str, str] = {}
+        self._tenant_bytes: dict[str, int] = {}
+        #: callbacks fired (outside the lock) after every release —
+        #: lets the fairness scheduler re-check byte-quota-blocked
+        #: waiters the moment capacity frees
+        self._release_listeners: list = []
 
     # ---- observability ---------------------------------------------------
     @property
@@ -149,9 +158,31 @@ class MemoryPool:
                 f"{len(self._queue)} queued"
             )
 
+    def add_release_listener(self, fn) -> None:
+        """Register a callback invoked (with no arguments, outside the
+        pool lock) after every release."""
+        with self._cv:
+            self._release_listeners.append(fn)
+
+    def remove_release_listener(self, fn) -> None:
+        """Unregister (idempotent) — a scheduler detaching from the
+        process-global pool must not stay pinned by its listener."""
+        with self._cv:
+            try:
+                self._release_listeners.remove(fn)
+            except ValueError:
+                pass
+
+    def tenant_reserved_bytes(self, tenant: str) -> int:
+        """Live bytes reserved by queries tagged with ``tenant`` (the
+        fairness scheduler's byte-quota operand)."""
+        with self._cv:
+            return self._tenant_bytes.get(tenant, 0)
+
     # ---- reserve / release ----------------------------------------------
     def reserve(self, query_id: str, nbytes: int,
-                timeout_s: float | None = None, detail: str = "") -> float:
+                timeout_s: float | None = None, detail: str = "",
+                tenant: str | None = None) -> float:
         """Reserve ``nbytes`` for ``query_id``, blocking FIFO until the
         pool has room. Returns the seconds spent queued. Raises
         ``ResourceExhausted`` immediately when the reservation can
@@ -204,6 +235,11 @@ class MemoryPool:
                 self._reservations[query_id] = (
                     self._reservations.get(query_id, 0) + nbytes
                 )
+                if tenant:
+                    self._tenant_of[query_id] = tenant
+                    self._tenant_bytes[tenant] = (
+                        self._tenant_bytes.get(tenant, 0) + nbytes
+                    )
             finally:
                 self._queue.remove(ticket)
                 self._cv.notify_all()
@@ -219,10 +255,24 @@ class MemoryPool:
         state calls this). Returns the bytes freed."""
         with self._cv:
             freed = self._reservations.pop(query_id, None)
+            if freed is not None:
+                tenant = self._tenant_of.pop(query_id, None)
+                if tenant is not None:
+                    left = self._tenant_bytes.get(tenant, 0) - freed
+                    if left > 0:
+                        self._tenant_bytes[tenant] = left
+                    else:
+                        self._tenant_bytes.pop(tenant, None)
+            listeners = list(self._release_listeners)
             self._cv.notify_all()
         if freed is None:
             return 0
         REGISTRY.counter("memory.released").add()
+        for fn in listeners:
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — listeners never leak back
+                pass
         return freed
 
 

@@ -134,6 +134,37 @@ SESSION_PROPERTIES: dict[str, PropertyDef] = {
             "baked, counted under prepare.slot_ineligible.*.",
         ),
         PropertyDef(
+            "batched_dispatch", bool, False,
+            "Cross-query batched dispatch (server/batcher.py): "
+            "concurrent same-template different-literal queries stack "
+            "their literal-slot bindings on a leading axis and execute "
+            "as ONE vmapped device dispatch (one scan, one fused "
+            "program, N results) instead of N serialized warm calls. "
+            "Results are bit-identical to serial execution — the "
+            "batched replay traces the same compiled step bodies — and "
+            "the result cache stays keyed per binding. Templates "
+            "outside the pure scan/filter/project/global-agg/sort/topN "
+            "whitelist fall back to the serialized template slot, "
+            "counted under batch.fallback.*. Off by default for "
+            "embedded sessions (a batch dispatch compiles one extra "
+            "vmapped signature per width); the serving layer "
+            "(presto_tpu.server) turns it on.",
+        ),
+        PropertyDef(
+            "batch_max_size", int, 8,
+            "Most bindings one cross-query batched dispatch may fuse "
+            "(also the bound on distinct compiled batch widths — jit "
+            "caches one signature per width).",
+            _positive,
+        ),
+        PropertyDef(
+            "tenant", str, None,
+            "Default tenant identity stamped on this session's "
+            "QueryInfo records (system.query_history attribution). The "
+            "serving front-end overrides it per request via the "
+            "request-scoped tenant context.",
+        ),
+        PropertyDef(
             "collect_node_stats", bool, False,
             "Record per-plan-node wall time and output rows on every "
             "query (the EXPLAIN ANALYZE recorder, always on).",

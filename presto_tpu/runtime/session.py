@@ -42,6 +42,17 @@ from presto_tpu.sql.parser import parse
 
 _query_seq = itertools.count(1)
 
+#: request-scoped tenant identity, set by the serving front-end
+#: (presto_tpu/server/frontend.py) around each tenant's execution so
+#: QueryInfo attribution works through one shared session without
+#: threading a parameter into every sql()/execute() signature. Falls
+#: back to the ``tenant`` session property, then "".
+from contextvars import ContextVar
+
+CURRENT_TENANT: ContextVar[Optional[str]] = ContextVar(
+    "presto_tpu_current_tenant", default=None
+)
+
 
 def _ast_literal_value(node):
     """EXECUTE ... USING argument -> logical Python value (literals
@@ -139,6 +150,10 @@ class Session:
         self.catalog.add_invalidation_listener(
             self.plan_stats.invalidate_table
         )
+        #: serving-layer tenant registry (server/scheduler.FairScheduler
+        #: when a QueryServer fronts this session) — the backing store
+        #: of system.tenants; None outside the serving layer
+        self.tenants = None
         #: prepared statements (PREPARE name FROM ... / Session.prepare)
         self._prepared: dict[str, object] = {}
         #: plan templates this session has executed at least once —
@@ -590,6 +605,10 @@ class Session:
             created_mono=time.monotonic(),
             planning_s=planning_s,
             trace_token=self.trace_token,
+            # serving-layer attribution: request-scoped tenant first
+            # (the front-end sets it around each client's execution),
+            # then the session-level default property
+            tenant=(CURRENT_TENANT.get() or self.prop("tenant") or ""),
         )
         tracer = None
         token = None
@@ -767,21 +786,45 @@ class Session:
             raise
         published = None  # the leader's successful result, for waiters
         try:
-            # same-template serialization: first binding compiles, the
-            # rest run warm back to back (leaders only; identical-fp
-            # followers wait on the entry event, not this lock)
-            slot_cm = (
-                self.query_manager.coalescer.template_slot(base_fp)
-                if entry is not None and bound and base_fp is not None
-                else contextlib.nullcontext()
+            # cross-query BATCHED dispatch (server/batcher.py): the
+            # bindings queued on this template fuse into one vmapped
+            # device dispatch when the template is batchable; falls
+            # back to (and interoperates with) the serialized template
+            # slot below via the same per-template executor lock
+            gate_on = (
+                entry is not None and bound and base_fp is not None
+                and bool(self.prop("batched_dispatch"))
             )
-            # the query.execution_s histogram is timed inside run_plan
-            # AFTER admission, so pool queue wait lands in queued_s /
-            # memory.queued_s, never in execution percentiles
-            with self._profiled(), slot_cm:
-                df = self.query_manager.run_plan(executor, plan, info,
-                                                 recorder)
-            published = df
+            if gate_on and not getattr(executor,
+                                       "supports_batched_dispatch", False):
+                # mesh sessions can't stack a binding axis onto
+                # shard_map fragments — loud, then the classic path
+                REGISTRY.counter("batch.fallback").add()
+                REGISTRY.counter("batch.fallback.distributed").add()
+                gate_on = False
+            if gate_on:
+                with self._profiled():
+                    df = self._run_template_batched(
+                        executor, plan, info, recorder, base_fp, bound)
+                published = df
+            else:
+                # same-template serialization: first binding compiles,
+                # the rest run warm back to back (leaders only;
+                # identical-fp followers wait on the entry event, not
+                # this lock)
+                slot_cm = (
+                    self.query_manager.coalescer.template_slot(base_fp)
+                    if entry is not None and bound and base_fp is not None
+                    else contextlib.nullcontext()
+                )
+                # the query.execution_s histogram is timed inside
+                # run_plan AFTER admission, so pool queue wait lands in
+                # queued_s / memory.queued_s, never in execution
+                # percentiles
+                with self._profiled(), slot_cm:
+                    df = self.query_manager.run_plan(executor, plan, info,
+                                                     recorder)
+                published = df
             token = install_delta(post)
             try:
                 info.state = "FINISHED"
@@ -832,6 +875,75 @@ class Session:
                 if v:
                     info.metrics["post_run." + k] = v
         return df, info
+
+    def _run_template_batched(self, executor, plan, info, recorder,
+                              base_fp, bound):
+        """Run one bound template through the batch gate
+        (server/batcher.TemplateBatchGate): enqueue the binding, then
+        either get SERVED by a concurrent leader's fused dispatch, or
+        LEAD — draining the queued bindings into one vmapped dispatch
+        when the template is batchable (``batch.dispatched``), else
+        running serially under the template executor lock (the PR 9
+        serialization, with the unbatchable reason counted). Patience
+        is bounded like the coalescer's wait; on timeout the query
+        executes itself unserialized (correct, just unbatched)."""
+        gate = self.query_manager.batch_gate
+        wait_s = (self.prop("query_max_run_time")
+                  or self.prop("admission_queue_timeout_s"))
+        max_batch = int(self.prop("batch_max_size"))
+        member = gate.enqueue(base_fp, bound)
+        deadline = (None if wait_s is None
+                    else time.monotonic() + float(wait_s))
+        while True:
+            remaining = (None if deadline is None
+                         else max(0.0, deadline - time.monotonic()))
+            role, payload = gate.lead_or_wait(base_fp, member, remaining,
+                                              max_batch=max_batch)
+            if role == "serve":
+                # a leader's batched dispatch computed this binding —
+                # same skip-the-lifecycle shape as a coalesced follower
+                # (the caller's FINISHED path still populates the
+                # result cache under THIS binding's fingerprint)
+                info.batched = True
+                REGISTRY.counter("batch.served").add()
+                return payload
+            if role == "timeout":
+                REGISTRY.counter("batch.gate_timeout").add()
+                return self.query_manager.run_plan(executor, plan, info,
+                                                   recorder)
+            if role == "retry":
+                if deadline is not None and time.monotonic() >= deadline:
+                    # leaving the gate without a verdict: abandon the
+                    # member first, or a later leader would burn a
+                    # lane on (and pin a ref for) a departed thread
+                    gate.abandon(base_fp, member)
+                    REGISTRY.counter("batch.gate_timeout").add()
+                    return self.query_manager.run_plan(executor, plan,
+                                                       info, recorder)
+                continue
+            # lead: this thread holds the template executor lock
+            members = payload
+            try:
+                runner = executor
+                if len(members) > 1:
+                    reason = gate.template_reason(base_fp, plan,
+                                                  self.catalog)
+                    if reason is None:
+                        from presto_tpu.server.batcher import BatchRunner
+
+                        runner = BatchRunner(executor, gate, members,
+                                             member, template_key=base_fp)
+                    else:
+                        REGISTRY.counter("batch.fallback").add()
+                        REGISTRY.counter(f"batch.fallback.{reason}").add()
+                df = self.query_manager.run_plan(runner, plan, info,
+                                                 recorder)
+                if runner is not executor:
+                    info.batched = bool(
+                        getattr(runner, "dispatched_batch", False))
+                return df
+            finally:
+                gate.finish_lead(base_fp, member, members)
 
     def _plan_hints(self, plan, fp=None) -> dict:
         """Plan-stats history for this plan, keyed by the LIVE plan

@@ -421,6 +421,15 @@ class QueryInfo:
     #: True when this query coalesced onto a concurrent identical
     #: in-flight execution (one device dispatch served N submissions)
     coalesced: bool = False
+    #: True when this query rode a cross-query BATCHED dispatch: its
+    #: literal binding was stacked with concurrent same-template
+    #: bindings and computed by one vmapped device program
+    #: (server/batcher.py) — as the leader or as a served member
+    batched: bool = False
+    #: serving-layer tenant identity ("" outside the serving front-end
+    #: unless the ``tenant`` session property is set) — the per-tenant
+    #: attribution column of system.query_history
+    tenant: str = ""
     #: True when the run probed an APPROXIMATE join sketch (the
     #: ``approx_join`` session property routed a semi join through the
     #: Bloom sketch): the result may contain false-positive rows.
@@ -519,6 +528,8 @@ class QueryInfo:
                 "cacheHit": self.cache_hit,
                 "templateHit": self.template_hit,
                 "coalesced": self.coalesced,
+                "batched": self.batched,
+                "tenant": self.tenant,
                 "approximate": self.approximate,
                 "outputRows": self.output_rows,
                 "nodeStats": self.node_stats,

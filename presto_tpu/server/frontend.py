@@ -1,0 +1,483 @@
+"""Multi-client front-end over the Session/QueryManager substrate.
+
+Reference parity: the coordinator's statement protocol —
+``POST /v1/statement`` returning a poll URI, clients following it to
+``QUEUED -> RUNNING -> FINISHED`` with results in the terminal page
+[SURVEY §2.1 protocol row] — plus ``PREPARE``/``EXECUTE`` riding the
+session's prepared-statement surface and a ``/metrics`` scrape of the
+existing OpenMetrics exposition. Two surfaces over ONE core:
+
+- :class:`QueryServer` — the in-process serving core (tenant identity,
+  fairness slots, submit/poll bookkeeping, graceful drain). Tests and
+  the bench harness drive it directly as the ``ServerClient`` — no
+  sockets, same code path.
+- :class:`HttpFrontend` — a stdlib ``ThreadingHTTPServer`` speaking
+  HTTP/JSON on top (no new dependencies). Tenant identity rides the
+  ``X-Presto-Tenant`` header, one tenant per connection/request.
+
+All tenants share one ``Session`` (so ``system.query_history``,
+``system.tenants``, and the flight recorder see the whole serving
+process) and therefore one memory pool; per-tenant isolation is the
+scheduler's job, attribution is ``QueryInfo.tenant``'s.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import threading
+import time
+from typing import Mapping, Optional
+
+from presto_tpu.runtime.errors import PrestoError, UserError, error_code
+from presto_tpu.runtime.metrics import REGISTRY
+from presto_tpu.server.scheduler import FairScheduler, TenantSpec
+
+_submit_seq = itertools.count(1)
+
+
+def _df_payload(df) -> dict:
+    """DataFrame -> the JSON result page shape ({columns, data})."""
+    return {
+        "columns": [str(c) for c in df.columns],
+        "data": json.loads(
+            df.to_json(orient="values", date_format="iso")),
+    }
+
+
+class QueryServer:
+    """The in-process serving core: tenant-scoped execution over one
+    shared Session, gated by a :class:`FairScheduler`.
+
+    ``connectors`` builds a fresh session (with ``batched_dispatch``
+    ON — the serving layer exists to exploit load shape); passing an
+    explicit ``session`` serves through it unchanged. Tests and the
+    bench drive this class directly — the HTTP front-end adds only
+    transport."""
+
+    def __init__(self, connectors: Optional[Mapping[str, object]] = None,
+                 *, session=None, tenants=None,
+                 total_slots: Optional[int] = None,
+                 properties: Optional[dict] = None,
+                 default_tenant: str = "default",
+                 query_record_limit: int = 256,
+                 submit_limit: int = 128,
+                 submit_timeout_s: float = 300.0):
+        from presto_tpu.runtime.session import Session
+
+        if session is None:
+            props = {"batched_dispatch": True}
+            props.update(properties or {})
+            session = Session(dict(connectors or {}), properties=props)
+        self.session = session
+        self.default_tenant = default_tenant
+        self.scheduler = FairScheduler(tenants, total_slots=total_slots,
+                                       pool=session.pool())
+        #: the registry behind system.tenants (connectors/system.py)
+        session.tenants = self.scheduler
+        #: submit/poll records, RING-bounded: terminal records beyond
+        #: the limit retire oldest-first (clients that still hold the
+        #: id get "unknown query id" — the reference protocol's retired
+        #: -query behavior). In-flight records are never evicted.
+        self.query_record_limit = max(1, int(query_record_limit))
+        #: backpressure on async submission: at most this many
+        #: NON-terminal submitted queries (each owns one worker thread
+        #: blocked in the fair scheduler) — beyond it, submit() rejects
+        #: loudly instead of growing a thread per request
+        self.submit_limit = max(1, int(submit_limit))
+        #: fair-queue patience for ASYNC submissions: a worker thread
+        #: must never block in the scheduler forever (a starved tenant
+        #: flooding /v1/statement would otherwise pin threads and
+        #: exhaust submit_limit for everyone); expiry surfaces as the
+        #: typed admission-timeout failure on the poll page
+        self.submit_timeout_s = submit_timeout_s
+        self._queries: "dict[str, dict]" = {}
+        self._qlock = threading.Lock()
+        self._accepting = True
+        self._inflight = 0
+        self._drain_cv = threading.Condition()
+
+    # ---- lifecycle accounting -------------------------------------------
+    def _enter(self, tenant: str):
+        with self._drain_cv:
+            if not self._accepting:
+                raise UserError("server is draining: not accepting queries")
+            self._inflight += 1
+        return tenant
+
+    def _leave(self):
+        with self._drain_cv:
+            self._inflight -= 1
+            self._drain_cv.notify_all()
+
+    # ---- synchronous execution ------------------------------------------
+    def _execute_admitted(self, fn, tenant: str,
+                          timeout_s: Optional[float] = None,
+                          on_start=None):
+        """The ONE admission wrapper AFTER in-flight accounting: fair
+        slot, tenant attribution, then ``fn()`` against the shared
+        session. ``on_start`` fires once the slot is held (the
+        QUEUED->RUNNING transition submit/poll reports — a query
+        starved at the scheduler must poll as QUEUED, not RUNNING).
+        Callers own ``_enter``/``_leave`` (submit() enters at accept
+        time so a drain never drops an already-accepted query)."""
+        from presto_tpu.runtime.session import CURRENT_TENANT
+
+        with self.scheduler.slot(tenant, timeout_s):
+            if on_start is not None:
+                on_start()
+            token = CURRENT_TENANT.set(tenant)
+            try:
+                return fn()
+            finally:
+                CURRENT_TENANT.reset(token)
+
+    def execute(self, sql: str, tenant: Optional[str] = None,
+                timeout_s: Optional[float] = None):
+        """Run one statement as ``tenant`` (fair slot + attribution);
+        returns the DataFrame."""
+        tenant = tenant or self.default_tenant
+        self._enter(tenant)
+        try:
+            return self._execute_admitted(lambda: self.session.sql(sql),
+                                          tenant, timeout_s)
+        finally:
+            self._leave()
+
+    def _prepared_key(self, tenant: str, name: str) -> str:
+        """Per-tenant prepared-statement namespace: handles register
+        in the shared session under ``tenant::name``, so one tenant
+        can never overwrite, execute, or deallocate another's
+        statement through the shared-session design."""
+        return f"{tenant}::{name}"
+
+    def prepare(self, sql: str, name: Optional[str] = None,
+                tenant: Optional[str] = None):
+        """PREPARE (no slot needed: planning only); returns the
+        client-visible handle name (scoped to ``tenant``) to pass to
+        :meth:`execute_prepared` / :meth:`deallocate`."""
+        tenant = tenant or self.default_tenant
+        if name is None:
+            name = f"stmt_{next(_submit_seq)}"
+        self.session.prepare(sql, self._prepared_key(tenant, name))
+        return name
+
+    def execute_prepared(self, name: str, params=(),
+                         tenant: Optional[str] = None,
+                         timeout_s: Optional[float] = None):
+        tenant = tenant or self.default_tenant
+        key = self._prepared_key(tenant, name)
+        self._enter(tenant)
+        try:
+            return self._execute_admitted(
+                lambda: self.session.execute_prepared(key,
+                                                      list(params))[0],
+                tenant, timeout_s)
+        finally:
+            self._leave()
+
+    def deallocate(self, name: str, tenant: Optional[str] = None) -> None:
+        from presto_tpu.runtime.errors import UserError as _UE
+
+        tenant = tenant or self.default_tenant
+        key = self._prepared_key(tenant, name)
+        if self.session._prepared.pop(key, None) is None:
+            raise _UE(f"prepared statement not found: {name}")
+
+    # ---- submit / poll (the /v1/statement shape) ------------------------
+    def _retire_records(self) -> None:
+        """Evict oldest TERMINAL records beyond the ring bound (under
+        ``_qlock``): a long-running server must not hold every result
+        frame it ever produced."""
+        over = len(self._queries) - self.query_record_limit
+        if over <= 0:
+            return
+        for qid in [q for q, r in self._queries.items()
+                    if r["state"] in ("FINISHED", "FAILED")][:over]:
+            del self._queries[qid]
+
+    def submit(self, sql: str, tenant: Optional[str] = None) -> str:
+        """Asynchronous submission; returns a server query id to poll.
+        In-flight accounting happens HERE (not on the worker thread):
+        an accepted query is part of the drain set immediately, so a
+        shutdown between the accept and the worker's first instruction
+        still waits for it. Submission is bounded by ``submit_limit``
+        pending queries — beyond it, reject loudly instead of growing
+        one blocked thread per request."""
+        tenant = tenant or self.default_tenant
+        with self._qlock:
+            pending = sum(1 for r in self._queries.values()
+                          if r["state"] in ("QUEUED", "RUNNING"))
+        if pending >= self.submit_limit:
+            REGISTRY.counter("server.submit_rejected").add()
+            raise UserError(
+                f"server busy: {pending} submitted queries pending "
+                f"(submit_limit={self.submit_limit})")
+        self._enter(tenant)  # raises while draining; worker leaves
+        qid = f"srv_{next(_submit_seq)}"
+        rec = {"id": qid, "tenant": tenant, "sql": sql, "state": "QUEUED",
+               "df": None, "error": None, "error_code": None,
+               "submitted_at": time.time(), "done": threading.Event()}
+        with self._qlock:
+            self._queries[qid] = rec
+            self._retire_records()
+        REGISTRY.counter("server.submitted").add()
+
+        def work():
+            try:
+                rec["df"] = self._execute_admitted(
+                    lambda: self.session.sql(sql), tenant,
+                    timeout_s=self.submit_timeout_s,
+                    # QUEUED until the fair slot is actually held:
+                    # scheduler starvation must be observable as
+                    # QUEUED, not mislabeled RUNNING
+                    on_start=lambda: rec.__setitem__("state", "RUNNING"))
+                rec["state"] = "FINISHED"
+            except Exception as e:  # noqa: BLE001 — reported to the client
+                rec["state"] = "FAILED"
+                rec["error"] = f"{type(e).__name__}: {e}"
+                rec["error_code"] = (error_code(e)
+                                     if isinstance(e, PrestoError)
+                                     else "INTERNAL")
+                REGISTRY.counter("server.failed").add()
+            finally:
+                rec["done"].set()
+                self._leave()
+
+        t = threading.Thread(target=work, daemon=True,
+                             name=f"presto-tpu-{qid}")
+        rec["thread"] = t
+        try:
+            t.start()
+        except BaseException:
+            self._leave()  # thread never ran; balance the accounting
+            raise
+        return qid
+
+    def poll(self, qid: str) -> dict:
+        """Current state page for a submitted query (terminal pages
+        carry results or the typed error)."""
+        with self._qlock:
+            rec = self._queries.get(qid)
+        if rec is None:
+            raise UserError(f"unknown query id: {qid}")
+        page = {"id": qid, "tenant": rec["tenant"], "state": rec["state"]}
+        if rec["state"] == "FINISHED":
+            payload = rec.get("payload")
+            if payload is None:
+                # serialized once, on first poll of the terminal page —
+                # repeat polls (or several clients sharing the id) must
+                # not re-pay O(rows) JSON encoding per request
+                payload = rec["payload"] = _df_payload(rec["df"])
+            page.update(payload)
+        elif rec["state"] == "FAILED":
+            page["error"] = rec["error"]
+            page["errorCode"] = rec["error_code"]
+        return page
+
+    def result(self, qid: str, timeout_s: Optional[float] = None):
+        """Block until a submitted query finishes; returns the frame
+        (raises UserError with the captured failure on FAILED)."""
+        with self._qlock:
+            rec = self._queries.get(qid)
+        if rec is None:
+            raise UserError(f"unknown query id: {qid}")
+        if not rec["done"].wait(timeout_s):
+            raise UserError(f"query {qid} still running")
+        if rec["state"] == "FAILED":
+            raise UserError(f"query {qid} failed: {rec['error']}")
+        return rec["df"]
+
+    # ---- observability / shutdown ---------------------------------------
+    def metrics_text(self) -> str:
+        return self.session.export_metrics()
+
+    def tenants_snapshot(self) -> "list[dict]":
+        return self.scheduler.snapshot()
+
+    def shutdown(self, drain_timeout_s: float = 30.0,
+                 flight_path: Optional[str] = None) -> dict:
+        """Graceful drain: stop accepting, wait for in-flight queries,
+        then report pool state (reservations release on every terminal
+        state, so a clean drain leaves the pool empty) and optionally
+        flush the flight-recorder ring to ``flight_path``."""
+        deadline = time.monotonic() + drain_timeout_s
+        with self._drain_cv:
+            self._accepting = False
+            while self._inflight > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._drain_cv.wait(remaining)
+            drained_clients = self._inflight == 0
+        pool = self.session.pool()
+        if flight_path is not None:
+            try:
+                self.session.export_flight_record(flight_path)
+            except Exception:  # noqa: BLE001 — a drain must not fail
+                REGISTRY.counter("flight.capture_errors").add()
+        # detach the scheduler's pool listener: the process-global pool
+        # must not keep a retired server's scheduler alive
+        self.scheduler.close()
+        REGISTRY.counter("server.shutdowns").add()
+        return {
+            "drained": drained_clients,
+            "inflight": self._inflight,
+            "pool_reserved_bytes": pool.snapshot()["reserved_bytes"],
+            "flight_records": len(self.session.flight),
+        }
+
+
+#: the no-sockets client surface tests and the bench harness use; it
+#: IS the server core — one name per role, one implementation
+ServerClient = QueryServer
+
+
+# ---------------------------------------------------------------------------
+# HTTP transport
+# ---------------------------------------------------------------------------
+
+
+class HttpFrontend:
+    """stdlib HTTP/JSON transport over a :class:`QueryServer`.
+
+    Routes::
+
+        POST /v1/statement           body = SQL text; 200 -> {id, state,
+                                     nextUri}; tenant via X-Presto-Tenant
+        GET  /v1/statement/<id>      poll page (FINISHED pages carry
+                                     {columns, data})
+        POST /v1/prepared            JSON {action: prepare|execute|
+                                     deallocate, name, sql?, params?}
+        GET  /metrics                OpenMetrics text exposition
+        GET  /v1/tenants             scheduler snapshot JSON
+
+    ``port=0`` binds an ephemeral port (tests); ``.port`` reports it.
+    """
+
+    def __init__(self, server: QueryServer, host: str = "127.0.0.1",
+                 port: int = 8080):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        qserver = server
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # quiet by default
+                pass
+
+            def _send(self, code: int, payload, ctype="application/json"):
+                body = (payload if isinstance(payload, bytes)
+                        else json.dumps(payload, default=str).encode())
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _tenant(self) -> str:
+                return (self.headers.get("X-Presto-Tenant")
+                        or self.headers.get("X-Presto-User")
+                        or qserver.default_tenant)
+
+            def _body(self) -> bytes:
+                n = int(self.headers.get("Content-Length") or 0)
+                return self.rfile.read(n)
+
+            def do_GET(self):
+                try:
+                    if self.path == "/metrics":
+                        self._send(200, qserver.metrics_text().encode(),
+                                   ctype=("application/openmetrics-text; "
+                                          "version=1.0.0"))
+                        return
+                    if self.path == "/v1/tenants":
+                        self._send(200, qserver.tenants_snapshot())
+                        return
+                    if self.path.startswith("/v1/statement/"):
+                        qid = self.path.rsplit("/", 1)[1]
+                        self._send(200, qserver.poll(qid))
+                        return
+                    self._send(404, {"error": f"no route {self.path}"})
+                except UserError as e:
+                    self._send(400, {"error": str(e)})
+                except Exception as e:  # noqa: BLE001 — HTTP boundary
+                    self._send(500, {"error": f"{type(e).__name__}: {e}"})
+
+            def do_POST(self):
+                try:
+                    if self.path == "/v1/statement":
+                        sql = self._body().decode("utf-8")
+                        qid = qserver.submit(sql, self._tenant())
+                        self._send(201, {
+                            "id": qid, "state": "QUEUED",
+                            "nextUri": f"/v1/statement/{qid}",
+                        })
+                        return
+                    if self.path == "/v1/prepared":
+                        try:
+                            req = json.loads(self._body().decode("utf-8"))
+                            action = req.get("action")
+                            if action in ("prepare", "execute",
+                                          "deallocate"):
+                                req["name"]  # required for all actions
+                            if action == "prepare":
+                                req["sql"]
+                        except (ValueError, KeyError) as e:
+                            # malformed CLIENT input is a 400, not a
+                            # 500 (json.JSONDecodeError is ValueError)
+                            self._send(400, {"error": "bad request: "
+                                             f"{type(e).__name__}: {e}"})
+                            return
+                        if action == "prepare":
+                            name = qserver.prepare(req["sql"],
+                                                   req.get("name"),
+                                                   self._tenant())
+                            self._send(201, {"prepared": name})
+                            return
+                        if action == "execute":
+                            df = qserver.execute_prepared(
+                                req["name"], req.get("params", ()),
+                                self._tenant())
+                            self._send(200, _df_payload(df))
+                            return
+                        if action == "deallocate":
+                            qserver.deallocate(req["name"],
+                                               self._tenant())
+                            self._send(200, {"deallocated": req["name"]})
+                            return
+                        self._send(400, {"error": "action must be "
+                                         "prepare|execute|deallocate"})
+                        return
+                    self._send(404, {"error": f"no route {self.path}"})
+                except UserError as e:
+                    self._send(400, {"error": str(e)})
+                except Exception as e:  # noqa: BLE001 — HTTP boundary
+                    self._send(500, {"error": f"{type(e).__name__}: {e}"})
+
+        self.server = server
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    def serve_forever(self):
+        REGISTRY.counter("server.started").add()
+        self.httpd.serve_forever()
+
+    def start_background(self) -> "HttpFrontend":
+        self._thread = threading.Thread(target=self.serve_forever,
+                                        daemon=True,
+                                        name="presto-tpu-http")
+        self._thread.start()
+        return self
+
+    def shutdown(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(10)

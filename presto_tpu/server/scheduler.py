@@ -1,0 +1,364 @@
+"""Fairness-aware multi-tenant admission: the layer between the
+serving front-end and the memory pool's strict FIFO.
+
+Reference parity: resource groups + ``NodeScheduler`` — the
+coordinator tier that decides WHOSE query runs next when demand
+exceeds capacity, before per-query admission decides whether it fits
+[SURVEY §2.1 resource-group row]. ``MemoryPool.reserve`` is strict
+FIFO on purpose (head-of-line keeps big queries from starving), which
+is exactly wrong between *tenants*: one aggressor flooding cheap
+queries would fill the FIFO and starve an interactive tenant's
+occasional query. This scheduler sits in front: every query first
+takes a weighted-fair concurrency slot, then admits through the pool
+as before.
+
+Mechanics — classic weighted fair queuing over a condition variable:
+
+- Each tenant carries a **virtual time**; every ENQUEUED waiter
+  advances it by ``1 / weight`` (stamping at admission instead would
+  give a whole burst one shared stamp and let the backlog admit
+  shoulder-to-shoulder). Waiters carry their virtual *finish* time,
+  and the lowest stamp among quota-eligible waiters runs next — a
+  flooding tenant's vtime races ahead, so a lighter tenant's next
+  query overtakes the flood's backlog (the p99-protection property
+  the sustained-load bench measures).
+- **Quotas** are hard gates: a tenant at ``max_concurrent`` running
+  queries, or holding more than ``max_bytes`` of live memory-pool
+  reservations (tenant-tagged in ``runtime/memory.py``), is skipped
+  regardless of its stamp — that is the preemption rung: over-quota
+  tenants lose their place in line until they release. (There is no
+  mid-flight kill: a compiled XLA step runs to completion, so
+  preemption happens at admission boundaries, like every other
+  lifecycle control in this engine.)
+- ``total_slots`` bounds overall concurrency; ``None`` leaves global
+  concurrency to the memory pool and engages fairness only through
+  per-tenant quotas.
+
+Counters: ``tenant.admitted`` / ``tenant.queued`` /
+``tenant.over_quota_blocked`` / ``tenant.queue_timeouts`` (each also
+suffixed ``.<tenant>``), histogram ``tenant.queued_s``. Live state is
+queryable as ``system.tenants`` when a server attaches the scheduler
+to its session.
+"""
+
+from __future__ import annotations
+
+import itertools
+import re
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass
+from typing import Iterable, Mapping, Optional
+
+from presto_tpu.runtime.errors import ResourceExhausted
+from presto_tpu.runtime.metrics import REGISTRY
+
+_NAME_RE = re.compile(r"[^A-Za-z0-9_]")
+
+
+def _metric_name(tenant: str) -> str:
+    """Tenant name sanitized for OpenMetrics suffixes."""
+    return _NAME_RE.sub("_", tenant) or "_"
+
+
+@dataclass(frozen=True)
+class TenantSpec:
+    """One tenant's fairness contract: scheduling ``weight`` (share of
+    contended slots), ``max_concurrent`` running queries, and
+    ``max_bytes`` of live memory-pool reservations (both ``None`` =
+    unlimited)."""
+
+    name: str
+    weight: float = 1.0
+    max_concurrent: Optional[int] = None
+    max_bytes: Optional[int] = None
+
+    def __post_init__(self):
+        if self.weight <= 0:
+            raise ValueError(f"tenant {self.name!r}: weight must be > 0")
+
+
+class _TenantState:
+    __slots__ = ("running", "peak_running", "admitted", "over_quota_blocked",
+                 "queue_timeouts", "vtime")
+
+    def __init__(self):
+        self.running = 0
+        self.peak_running = 0
+        self.admitted = 0
+        self.over_quota_blocked = 0
+        self.queue_timeouts = 0
+        self.vtime = 0.0
+
+
+class _Waiter:
+    __slots__ = ("stamp", "seq", "tenant", "counted_block")
+
+    def __init__(self, stamp: float, seq: int, tenant: str):
+        self.stamp = stamp
+        self.seq = seq
+        self.tenant = tenant
+        self.counted_block = False
+
+    @property
+    def order(self):
+        return (self.stamp, self.seq)
+
+
+class FairScheduler:
+    """Weighted-fair, quota-gated concurrency slots for named tenants.
+
+    Unknown tenants auto-register with ``default_spec`` (weight 1, no
+    quotas unless overridden) — a serving front-end must not 500 a new
+    client, it must schedule it fairly.
+    """
+
+    def __init__(self, tenants: "Iterable[TenantSpec] | Mapping | None" = None,
+                 total_slots: Optional[int] = None,
+                 default_spec: Optional[TenantSpec] = None,
+                 pool=None, max_tenants: int = 256):
+        self._cv = threading.Condition()
+        self._specs: dict[str, TenantSpec] = {}
+        self._states: dict[str, _TenantState] = {}
+        self._waiters: list[_Waiter] = []
+        self._vclock = 0.0
+        self._seq = itertools.count()
+        self._running_total = 0
+        self.total_slots = total_slots
+        self.default_spec = default_spec or TenantSpec("default")
+        #: cap on auto-registered tenant names: the tenant header is
+        #: client-controlled, and each name permanently allocates
+        #: state, a system.tenants row, and per-tenant counters — past
+        #: the cap, walk-ins pool into one shared "__overflow__" lane
+        #: (still fairly scheduled, bounded cardinality, counted)
+        self.max_tenants = max(1, int(max_tenants))
+        #: optional MemoryPool whose tenant-tagged reservations back the
+        #: byte quotas (runtime/memory.py); its release listeners kick
+        #: this scheduler so byte-blocked waiters re-check promptly
+        #: (detached again by close() — a listener on the process-global
+        #: pool must not pin a dead scheduler forever)
+        self._pool = pool
+        self._pool_listener = None
+        if pool is not None and hasattr(pool, "add_release_listener"):
+            self._pool_listener = lambda *_: self.kick()
+            pool.add_release_listener(self._pool_listener)
+        if isinstance(tenants, Mapping):
+            tenants = tenants.values()
+        for spec in tenants or ():
+            self.register(spec)
+
+    # ---- registry --------------------------------------------------------
+    def register(self, spec: TenantSpec) -> None:
+        with self._cv:
+            self._specs[spec.name] = spec
+            self._states.setdefault(spec.name, _TenantState())
+
+    def spec(self, tenant: str) -> TenantSpec:
+        with self._cv:
+            return self._spec_locked(tenant)
+
+    def _resolve_locked(self, tenant: str) -> str:
+        """Effective tenant name: unknown tenants auto-register with
+        the default spec until ``max_tenants``; beyond it they pool
+        into the shared ``__overflow__`` lane (the header is
+        client-controlled — unbounded names must not grow state or
+        metric cardinality forever)."""
+        if tenant in self._specs:
+            return tenant
+        if len(self._specs) >= self.max_tenants:
+            REGISTRY.counter("tenant.overflow").add()
+            tenant = "__overflow__"
+            if tenant in self._specs:
+                return tenant
+        s = TenantSpec(tenant, self.default_spec.weight,
+                       self.default_spec.max_concurrent,
+                       self.default_spec.max_bytes)
+        self._specs[tenant] = s
+        self._states.setdefault(tenant, _TenantState())
+        return tenant
+
+    def _spec_locked(self, tenant: str) -> TenantSpec:
+        return self._specs[self._resolve_locked(tenant)]
+
+    # ---- quota / fairness predicates ------------------------------------
+    def _tenant_bytes(self, tenant: str) -> int:
+        if self._pool is None:
+            return 0
+        try:
+            return self._pool.tenant_reserved_bytes(tenant)
+        except Exception:  # noqa: BLE001 — quotas degrade open, not closed
+            return 0
+
+    def _under_quota(self, tenant: str) -> bool:
+        spec = self._spec_locked(tenant)
+        st = self._states[tenant]
+        if spec.max_concurrent is not None and st.running >= spec.max_concurrent:
+            return False
+        if spec.max_bytes is not None and self._tenant_bytes(tenant) >= spec.max_bytes:
+            return False
+        return True
+
+    def _blocker_of(self, w: _Waiter) -> Optional[str]:
+        """Why ``w`` cannot be admitted right now: its own tenant is
+        over quota ("quota"), the global slot pool is full ("slots"),
+        or an eligible waiter with an earlier virtual finish time is
+        ahead ("turn"). None = admissible. Quota verdicts are memoized
+        per tenant within one call: byte quotas read the pool under
+        ITS lock, and a deep queue must not pay one cross-lock probe
+        per earlier waiter."""
+        quota_memo: dict[str, bool] = {}
+
+        def under(name: str) -> bool:
+            v = quota_memo.get(name)
+            if v is None:
+                v = quota_memo[name] = self._under_quota(name)
+            return v
+
+        if not under(w.tenant):
+            return "quota"
+        if self.total_slots is not None and self._running_total >= self.total_slots:
+            return "slots"
+        for o in self._waiters:
+            if o is not w and o.order < w.order and under(o.tenant):
+                return "turn"
+        return None
+
+    # ---- acquire / release ----------------------------------------------
+    def acquire(self, tenant: str, timeout_s: Optional[float] = None) -> str:
+        """Block until ``tenant`` may start one query; returns the
+        tenant name as the release token. Raises ``ResourceExhausted``
+        after ``timeout_s`` in the queue."""
+        t0 = time.monotonic()
+        deadline = None if timeout_s is None else t0 + timeout_s
+        with self._cv:
+            # resolve once: past max_tenants, walk-ins share the
+            # overflow lane, and ALL accounting below (state, vtime,
+            # metric suffixes, the release token) uses the resolved
+            # name so it stays bounded
+            tenant = self._resolve_locked(tenant)
+            mname = _metric_name(tenant)
+            spec = self._specs[tenant]
+            st = self._states[tenant]
+            stamp = max(st.vtime, self._vclock) + 1.0 / spec.weight
+            # advance the tenant's virtual time at ENQUEUE, not
+            # admission: a burst of N waiters from one tenant must
+            # carry stamps v+1, v+2, ..., v+N — stamping them all v+1
+            # would let the backlog admit shoulder-to-shoulder and
+            # defeat exactly the overtake property the weights exist
+            # for (a timed-out waiter's stamp stays spent: a tenant
+            # that queues work it abandons still paid for the place it
+            # held in line)
+            st.vtime = stamp
+            w = _Waiter(stamp, next(self._seq), tenant)
+            self._waiters.append(w)
+            waited = False
+            try:
+                while True:
+                    blocker = self._blocker_of(w)
+                    if blocker is None:
+                        break
+                    if blocker == "quota" and not w.counted_block:
+                        w.counted_block = True
+                        st.over_quota_blocked += 1
+                        REGISTRY.counter("tenant.over_quota_blocked").add()
+                        REGISTRY.counter(
+                            f"tenant.over_quota_blocked.{mname}").add()
+                    remaining = (None if deadline is None
+                                 else deadline - time.monotonic())
+                    if remaining is not None and remaining <= 0:
+                        st.queue_timeouts += 1
+                        REGISTRY.counter("tenant.queue_timeouts").add()
+                        REGISTRY.counter(
+                            f"tenant.queue_timeouts.{mname}").add()
+                        raise ResourceExhausted(
+                            f"tenant {tenant!r} admission timeout: waited "
+                            f"{timeout_s}s for a fair slot "
+                            f"(blocked on {blocker}; {self.describe()})"
+                        )
+                    waited = True
+                    self._cv.wait(remaining)
+            finally:
+                self._waiters.remove(w)
+                # whoever was behind this waiter may be admissible now
+                # (including after a timeout or an async interrupt)
+                self._cv.notify_all()
+            st.running += 1
+            st.peak_running = max(st.peak_running, st.running)
+            st.admitted += 1
+            self._vclock = max(self._vclock, w.stamp)
+            self._running_total += 1
+        queued_s = time.monotonic() - t0
+        REGISTRY.counter("tenant.admitted").add()
+        REGISTRY.counter(f"tenant.admitted.{mname}").add()
+        if waited:
+            REGISTRY.counter("tenant.queued").add()
+            REGISTRY.counter(f"tenant.queued.{mname}").add()
+            REGISTRY.histogram("tenant.queued_s").add(queued_s)
+        return tenant
+
+    def release(self, token: str) -> None:
+        with self._cv:
+            st = self._states.get(token)
+            if st is not None and st.running > 0:
+                st.running -= 1
+                self._running_total -= 1
+            self._cv.notify_all()
+
+    @contextmanager
+    def slot(self, tenant: str, timeout_s: Optional[float] = None):
+        token = self.acquire(tenant, timeout_s)
+        try:
+            yield
+        finally:
+            self.release(token)
+
+    def kick(self) -> None:
+        """Re-check blocked waiters (wired to memory-pool releases so
+        byte-quota blocks clear as soon as reservations drop)."""
+        with self._cv:
+            self._cv.notify_all()
+
+    def close(self) -> None:
+        """Detach from the pool (idempotent): unregister the release
+        listener so a retired scheduler is collectable and pool
+        releases stop paying for it."""
+        if (self._pool is not None and self._pool_listener is not None
+                and hasattr(self._pool, "remove_release_listener")):
+            self._pool.remove_release_listener(self._pool_listener)
+        self._pool_listener = None
+
+    # ---- observability ---------------------------------------------------
+    def describe(self) -> str:
+        with self._cv:
+            return (f"{self._running_total} running, "
+                    f"{len(self._waiters)} queued across "
+                    f"{len(self._specs)} tenants")
+
+    def snapshot(self) -> "list[dict]":
+        """One row per registered tenant (the ``system.tenants``
+        backing store), internally consistent under one lock."""
+        with self._cv:
+            queued = {}
+            for w in self._waiters:
+                queued[w.tenant] = queued.get(w.tenant, 0) + 1
+            rows = []
+            for name, spec in sorted(self._specs.items()):
+                st = self._states[name]
+                rows.append({
+                    "tenant": name,
+                    "weight": spec.weight,
+                    "max_concurrent": (-1 if spec.max_concurrent is None
+                                       else spec.max_concurrent),
+                    "max_bytes": (-1 if spec.max_bytes is None
+                                  else spec.max_bytes),
+                    "running": st.running,
+                    "peak_running": st.peak_running,
+                    "queued": queued.get(name, 0),
+                    "admitted": st.admitted,
+                    "over_quota_blocked": st.over_quota_blocked,
+                    "queue_timeouts": st.queue_timeouts,
+                    "reserved_bytes": self._tenant_bytes(name),
+                    "vtime": st.vtime,
+                })
+            return rows

@@ -52,7 +52,13 @@
 #      with plan render + spans + metric delta; a warm template re-run
 #      must show system.exec_cache hits with compile_s_saved > 0; the
 #      global pool must drain (ISSUE-12 acceptance).
-#  11. The tier-1 pytest suite on the CPU backend (virtual-device
+#  11. Serving smoke: the in-process multi-tenant server — concurrent
+#      clients across two tenants through the fairness scheduler, the
+#      /metrics exposition parses, an over-quota tenant stays bounded
+#      at its concurrency cap, cross-query batched dispatch fires at
+#      least once with results identical to serial execution, and the
+#      global memory pool drains (ISSUE-14 acceptance).
+#  12. The tier-1 pytest suite on the CPU backend (virtual-device
 #      distributed tests included; `slow` marks excluded), with the
 #      same flags and timeout the driver uses.
 #
@@ -498,6 +504,152 @@ print("flight smoke: zipf skew %.1fx / balanced %.1fx, post-mortem "
       "JSON ok (%d spans), ledger saved %.3fs over %d hits, pool 0"
       % (ratio_hot, ratio_flat, len(d["spans"]),
          float(ec["saved"][0]), int(ec["h"][0])))
+PY
+
+timeout -k 10 300 env JAX_PLATFORMS=cpu JAX_ENABLE_X64=1 python - <<'PY' || exit $?
+# Serving smoke (ISSUE-14 acceptance): two tenants through the
+# fairness scheduler, over-quota bounded, batched dispatch fires with
+# results bit-identical to serial, /metrics parses, pool drains.
+import re
+import sys
+import threading
+
+sys.path.insert(0, ".")
+import pandas as pd
+
+from presto_tpu.connectors.tpch import TpchConnector
+from presto_tpu.runtime.memory import global_pool
+from presto_tpu.runtime.metrics import REGISTRY
+from presto_tpu.runtime.session import Session
+from presto_tpu.server.frontend import QueryServer
+from presto_tpu.server.scheduler import TenantSpec
+
+conn = TpchConnector(sf=0.005)
+# aggressor cap 4 with 5 clients: the 5th parks at the scheduler
+# (over-quota preemption, asserted below) while the admitted four meet
+# at the batch gate — a cap below the client count at the GATE side
+# would starve batch formation, the quota must bite at the SCHEDULER
+qs = QueryServer({"tpch": conn},
+                 tenants=[TenantSpec("aggressor", weight=1.0,
+                                     max_concurrent=4),
+                          TenantSpec("interactive", weight=4.0)],
+                 properties={"result_cache_enabled": False})
+fmt = ("select l_orderkey, l_linenumber, l_quantity from lineitem"
+       " where l_extendedprice < {}"
+       " order by l_orderkey, l_linenumber limit 25")
+inter_q = ("select l_returnflag, count(*) c from lineitem"
+           " group by l_returnflag order by l_returnflag")
+qs.execute(fmt.format(1000), tenant="aggressor")  # warm the template
+qs.execute(inter_q, tenant="interactive")
+d0 = REGISTRY.snapshot().get("batch.dispatched", 0)
+results, errors = {}, []
+
+def agg_worker(v):
+    try:
+        results[v] = qs.execute(fmt.format(v), tenant="aggressor",
+                                timeout_s=120)
+    except Exception as e:  # noqa: BLE001
+        errors.append(f"aggressor {v}: {e}")
+
+def inter_worker(i):
+    try:
+        results[f"i{i}"] = qs.execute(inter_q, tenant="interactive",
+                                      timeout_s=120)
+    except Exception as e:  # noqa: BLE001
+        errors.append(f"interactive {i}: {e}")
+
+# deterministic batch formation (the test-suite hold): the FIRST query
+# through run_plan blocks until followers have queued at the batch
+# gate, so the next leader provably drains a multi-binding batch —
+# no scheduler/GIL timing race decides whether the gate fuses
+from presto_tpu.runtime.lifecycle import QueryManager
+
+gate = qs.session.query_manager.batch_gate
+release = threading.Event()
+first = threading.Event()
+orig_run_plan = QueryManager.run_plan
+
+def gated(self, executor, plan, info, recorder):
+    if not first.is_set():
+        first.set()
+        release.wait(60)
+    return orig_run_plan(self, executor, plan, info, recorder)
+
+QueryManager.run_plan = gated
+lits = [3000, 22000, 47000, 72000, 91000]
+threads = [threading.Thread(target=agg_worker, args=(v,)) for v in lits]
+threads.append(threading.Thread(target=inter_worker, args=(0,)))
+threads[0].start()
+assert first.wait(60), "first aggressor never reached run_plan"
+for t in threads[1:]:
+    t.start()
+import time as _time
+deadline = _time.monotonic() + 60
+while _time.monotonic() < deadline:
+    if sum(gate.queue_depth(fp) for fp in list(gate._templates)) >= 2:
+        break
+    _time.sleep(0.01)
+release.set()
+for t in threads:
+    t.join(120)
+QueryManager.run_plan = orig_run_plan
+assert not errors, errors
+fused = REGISTRY.snapshot().get("batch.dispatched", 0) - d0
+assert fused >= 1, "batched dispatch did not fire"
+# a second unheld burst exercises the scheduler+gate interplay live
+threads = [threading.Thread(target=agg_worker, args=(v + 100,))
+           for v in lits] + \
+          [threading.Thread(target=inter_worker, args=(1,))]
+for t in threads:
+    t.start()
+for t in threads:
+    t.join(120)
+assert not errors, errors
+
+# batched results identical to serial execution (templates off)
+off = Session({"tpch": conn}, properties={
+    "result_cache_enabled": False, "plan_templates": False})
+checked = 0
+for v, df in results.items():
+    if isinstance(v, int) and checked < 6:
+        assert df.equals(off.sql(fmt.format(v))), \
+            f"batched result differs at binding {v}"
+        checked += 1
+
+# over-quota tenant bounded at its concurrency cap (the 5th client
+# was preempted at admission while at the cap)
+snap = {r["tenant"]: r for r in qs.scheduler.snapshot()}
+assert snap["aggressor"]["peak_running"] <= 4, snap["aggressor"]
+assert snap["aggressor"]["over_quota_blocked"] >= 1, snap["aggressor"]
+assert snap["interactive"]["admitted"] >= 1
+
+# tenant attribution visible in system.query_history
+hist = qs.session.sql("select tenant from query_history"
+                      " where tenant <> ''")
+assert {"aggressor", "interactive"} <= set(hist["tenant"].tolist())
+
+# /metrics scrape parses line-by-line (the gate-7 grammar)
+text = qs.metrics_text()
+lines = text.splitlines()
+assert lines[-1] == "# EOF"
+sample = re.compile(r'^[a-zA-Z_][a-zA-Z0-9_]*(\{quantile="0\.\d+"\})? '
+                    r'-?\d+(\.\d+)?(e-?\d+)?$')
+for line in lines[:-1]:
+    if line.startswith("# TYPE ") or line.startswith("# HELP "):
+        continue
+    assert sample.match(line), f"unparseable exposition line: {line!r}"
+assert "presto_tpu_batch_dispatched_total" in text
+assert "presto_tpu_tenant_admitted_total" in text
+
+summary = qs.shutdown(drain_timeout_s=15)
+assert summary["drained"] and summary["pool_reserved_bytes"] == 0
+assert global_pool().reserved_bytes == 0, "global pool reservation leak"
+served = int(REGISTRY.snapshot().get("batch.served", 0))
+print("serving smoke: %d batch dispatches (%d served), aggressor peak "
+      "%d <= cap 4 (%d over-quota blocks), %d bindings verified "
+      "identical, metrics parse ok, pool 0"
+      % (int(fused), served, snap["aggressor"]["peak_running"],
+         int(snap["aggressor"]["over_quota_blocked"]), checked))
 PY
 
 rm -f /tmp/_t1.log

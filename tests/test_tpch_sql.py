@@ -33,7 +33,20 @@ def normalize(df: pd.DataFrame) -> pd.DataFrame:
         elif pd.api.types.is_datetime64_any_dtype(df[c]):
             df[c] = df[c].astype("datetime64[s]")
         elif df[c].dtype == object or pd.api.types.is_string_dtype(df[c]):
-            df[c] = df[c].astype(str).str.rstrip()
+            # engine NULL doubles ride object columns as Python None
+            # beside real floats (stddev of a 1-row sample, NULL lag
+            # windows); astype(str) would freeze those None values into
+            # the literal string 'None' and poison the float compare
+            # below. A numeric-or-null object column aligns with the
+            # oracle's NaN floats instead.
+            vals = df[c].dropna()
+            if len(vals) == 0 or vals.map(
+                lambda v: isinstance(v, (int, float, np.number))
+                and not isinstance(v, bool)
+            ).all():
+                df[c] = df[c].astype(np.float64).round(2)
+            else:
+                df[c] = df[c].astype(str).str.rstrip()
         else:
             df[c] = pd.to_numeric(df[c]).astype(np.int64)
     return df.sort_values(list(df.columns), kind="stable").reset_index(drop=True)
