@@ -1,0 +1,29 @@
+"""Engine-invariant static analysis (the lint subsystem).
+
+The engine's correctness rests on hand-enforced invariants — no host
+sync inside jitted step builders, every behavior-changing knob folded
+into the exec-cache key, lock-guarded mutation of shared runtime
+state, restore discipline for process-global ``PRESTO_TPU_*`` env and
+registries. CHANGES.md records multiple review rounds burned on
+exactly these bug classes (PR 8's in-trace Pallas-eligibility check,
+PR 9's phantom ``exec.traces`` regression, PR 10's ``_TimedStep``
+bypass hazard). This package machine-checks them: a pure-stdlib
+``ast`` pass, run as tier-1 gate 12 (``scripts/lint.sh``), failing on
+any unsuppressed finding.
+
+Usage::
+
+    python -m presto_tpu.analysis [--format json|text] [--rule ID] \
+        [paths...]
+
+See README "Static analysis & invariants" for the rule catalog and
+suppression policy.
+"""
+
+from presto_tpu.analysis.engine import (  # noqa: F401
+    RULES,
+    AnalysisResult,
+    analyze,
+    load_baseline,
+)
+from presto_tpu.analysis.findings import Finding  # noqa: F401

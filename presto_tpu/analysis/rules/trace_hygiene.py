@@ -1,0 +1,265 @@
+"""Trace-hygiene rules (PT1xx).
+
+The engine's performance contract is that a jitted step's Python body
+runs ONCE per trace and the compiled program thereafter — so any
+host-sync inside a traced body (forcing a device value back to Python)
+either crashes at trace time on a tracer, or silently freezes one
+binding's concrete value into the compiled program. Both shipped as
+real bugs: PR 8's in-trace ``is``-identity eligibility check silently
+disabled the Pallas kernel (the decision must be HOISTED out of the
+trace, as ``_build_local_step``'s ``pallas_ok`` now documents), and
+the plan-template work (PR 9) only stays correct because traced steps
+close over tracers — never over one binding's constants.
+
+Traced functions are found structurally: decorated with / passed to
+``jax.jit`` / ``shard_map`` / ``pl.pallas_call`` (including through
+``functools.partial``), or defined as the conventional ``step`` body
+inside a ``_make_*_step`` / ``_build_*_step`` builder. Everything
+lexically inside a traced function runs at trace time, including
+nested helpers.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from presto_tpu.analysis import astutil as A
+from presto_tpu.analysis.engine import ModuleInfo, Rule, register
+
+#: entry points whose function argument is traced
+TRACE_WRAPPERS = {
+    "jax.jit", "jit", "shard_map", "jax.experimental.shard_map.shard_map",
+    "pl.pallas_call", "pallas_call",
+}
+
+#: attribute chains that keep a value STATIC at trace time — reading a
+#: tracer's shape/dtype is metadata, not a host sync
+STATIC_ATTRS = {"shape", "ndim", "size", "dtype", "nbytes", "itemsize",
+                "aval", "sharding"}
+
+#: method calls that force device->host (always wrong in a trace)
+SYNC_METHODS = {"item", "tolist", "block_until_ready", "copy_to_host"}
+
+#: callables that force device->host when fed a traced value
+SYNC_CALLS = {"jax.device_get", "device_get", "np.asarray", "np.array",
+              "numpy.asarray", "numpy.array", "onp.asarray", "onp.array"}
+
+#: builtins that force a concrete Python scalar out of their argument
+SCALAR_BUILTINS = {"int", "float", "bool", "complex"}
+
+
+def _decorator_traces(dec: ast.expr) -> bool:
+    name = A.dotted(dec)
+    if name in TRACE_WRAPPERS:
+        return True
+    if isinstance(dec, ast.Call):
+        fname = A.call_name(dec)
+        if fname in TRACE_WRAPPERS:
+            return True
+        if fname in ("partial", "functools.partial") and dec.args:
+            return A.dotted(dec.args[0]) in TRACE_WRAPPERS
+    return False
+
+
+def traced_functions(mod: ModuleInfo) -> "list[ast.FunctionDef]":
+    """Every function whose body executes under a jax trace."""
+    out: "dict[ast.AST, ast.FunctionDef]" = {}
+    by_scope: "dict[tuple, dict[str, ast.FunctionDef]]" = {}
+    for fn in A.iter_functions(mod.tree):
+        scope = mod.enclosing_function(fn)
+        by_scope.setdefault((id(scope),), {})[fn.name] = fn
+        if any(_decorator_traces(d) for d in fn.decorator_list):
+            out[fn] = fn
+
+    for call in ast.walk(mod.tree):
+        if not isinstance(call, ast.Call):
+            continue
+        fname = A.call_name(call)
+        if fname in TRACE_WRAPPERS and call.args:
+            target = call.args[0]
+            if isinstance(target, ast.Call) and \
+                    A.call_name(target) in ("partial", "functools.partial") \
+                    and target.args:
+                target = target.args[0]
+            if isinstance(target, ast.Name):
+                scope = mod.enclosing_function(call)
+                fn = by_scope.get((id(scope),), {}).get(target.id)
+                if fn is None:  # fall back to module scope
+                    fn = by_scope.get((id(None),), {}).get(target.id)
+                if fn is not None:
+                    out[fn] = fn
+    # the conventional builder shape, for steps not wrapped at the def
+    # site (e.g. handed to a caller that jits them)
+    for fn in A.iter_functions(mod.tree):
+        if fn.name == "step" or fn.name.endswith("_step"):
+            builder = mod.enclosing_function(fn)
+            if builder is not None and (
+                    "make" in builder.name or "build" in builder.name):
+                out[fn] = fn
+    return list(out.values())
+
+
+def _under_static_attr(mod: ModuleInfo, name_node: ast.AST,
+                      stop: ast.AST) -> bool:
+    """True when the name is read through a static-metadata attribute
+    (``batch.shape[0]``, ``x.dtype``) somewhere below ``stop``."""
+    for anc in mod.ancestors(name_node):
+        if anc is stop:
+            return False
+        if isinstance(anc, ast.Attribute) and anc.attr in STATIC_ATTRS:
+            return True
+    return False
+
+
+def _references_traced_value(mod: ModuleInfo, expr: ast.expr,
+                             params: "set[str]") -> bool:
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load) and \
+                n.id in params and not _under_static_attr(mod, n, expr):
+            return True
+    return False
+
+
+@register
+class HostSyncInTracedStep(Rule):
+    id = "PT101"
+    name = "host-sync-in-traced-step"
+    severity = "error"
+    description = (
+        "host-sync operation (int()/float()/.item()/np.asarray/"
+        "jax.device_get/.block_until_ready) inside a function traced by "
+        "jax.jit/shard_map/pallas_call")
+    motivation = (
+        "PR 8: an in-trace `is`-identity eligibility check silently "
+        "disabled the Pallas kernel — trace-time Python must never "
+        "depend on device values")
+
+    def check_module(self, mod: ModuleInfo, project) -> Iterator:
+        for fn in traced_functions(mod):
+            params = A.func_params(fn)
+            # names assigned from params flow traced values onward
+            tainted = set(params)
+            for name, val in A.simple_assignments(fn).items():
+                if _references_traced_value(mod, val, tainted):
+                    tainted.add(name)
+            for call in ast.walk(fn):
+                if not isinstance(call, ast.Call):
+                    continue
+                fname = A.call_name(call)
+                if fname is None:
+                    continue
+                tail = fname.rsplit(".", 1)[-1]
+                if tail in SYNC_METHODS and "." in fname:
+                    yield mod.finding(
+                        self.id, self.severity, call,
+                        f"`.{tail}()` forces a device->host sync inside "
+                        f"traced step `{fn.name}`",
+                        hint="hoist the host read out of the traced "
+                             "body (compute it before building the step "
+                             "and bake it in via the cache key)")
+                    continue
+                if (fname in SYNC_CALLS or tail in SCALAR_BUILTINS and
+                        fname == tail):
+                    syncs = any(
+                        _references_traced_value(mod, a, tainted)
+                        for a in list(call.args) +
+                        [k.value for k in call.keywords])
+                    if syncs:
+                        yield mod.finding(
+                            self.id, self.severity, call,
+                            f"`{fname}(...)` concretizes a traced value "
+                            f"inside traced step `{fn.name}`",
+                            hint="use jnp ops on the tracer, or hoist "
+                                 "the concrete read out of the trace")
+
+
+@register
+class BranchOnTracedValue(Rule):
+    id = "PT102"
+    name = "python-branch-on-traced-value"
+    severity = "error"
+    description = (
+        "Python if/while on a comparison over a traced parameter — the "
+        "branch freezes at trace time (one binding decides for all)")
+    motivation = (
+        "PR 9 plan templates: steps must close over tracers, never one "
+        "binding's constants; a Python branch on a traced value bakes "
+        "the first binding's outcome into the shared executable")
+
+    def check_module(self, mod: ModuleInfo, project) -> Iterator:
+        for fn in traced_functions(mod):
+            params = A.func_params(fn)
+            for node in ast.walk(fn):
+                if not isinstance(node, (ast.If, ast.While)):
+                    continue
+                for cmp in ast.walk(node.test):
+                    if not isinstance(cmp, ast.Compare):
+                        continue
+                    if any(isinstance(op, (ast.Is, ast.IsNot))
+                           for op in cmp.ops):
+                        continue  # identity tests are static plumbing
+                    sides = [cmp.left] + list(cmp.comparators)
+                    if any(isinstance(s, ast.Name) and s.id in params and
+                           not _under_static_attr(mod, s, cmp)
+                           for s in sides):
+                        yield mod.finding(
+                            self.id, self.severity, node,
+                            f"Python branch on traced parameter inside "
+                            f"step `{fn.name}` — the outcome freezes at "
+                            f"trace time",
+                            hint="use jnp.where / lax.cond, or hoist "
+                                 "the decision out of the traced body")
+                        break
+
+
+@register
+class ParamScopeDiscipline(Rule):
+    id = "PT103"
+    name = "param-scope-discipline"
+    severity = "warning"
+    description = (
+        "expression evaluation with bindings in hand but no installed "
+        "param_scope, or direct _PARAM_VALUES access outside expr.py")
+    motivation = (
+        "plan-template parameterization (PR 9): a Param evaluated "
+        "outside an installed scope raises at runtime only on the "
+        "first parameterized query that reaches the site")
+
+    EVAL_FUNCS = {"evaluate", "evaluate_predicate", "expr.evaluate",
+                  "expr.evaluate_predicate"}
+
+    def check_module(self, mod: ModuleInfo, project) -> Iterator:
+        if mod.rel.endswith("expr.py") or mod.is_test:
+            return
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Attribute) and \
+                    node.attr == "_PARAM_VALUES":
+                yield mod.finding(
+                    self.id, "error", node,
+                    "direct _PARAM_VALUES access outside expr.py",
+                    hint="use expr.param_scope() — the ContextVar is "
+                         "an implementation detail")
+        for fn in A.iter_functions(mod.tree):
+            bound = A.func_params(fn) | set(A.simple_assignments(fn))
+            if "params" not in bound:
+                continue
+            for call in ast.walk(fn):
+                if not isinstance(call, ast.Call):
+                    continue
+                if A.call_name(call) not in self.EVAL_FUNCS:
+                    continue
+                if mod.enclosing_function(call) is not fn:
+                    continue  # nested def: judged in its own right
+                if A.in_with_block(
+                        mod, call,
+                        lambda e: isinstance(e, ast.Call) and
+                        (A.call_name(e) or "").endswith("param_scope")):
+                    continue
+                yield mod.finding(
+                    self.id, self.severity, call,
+                    f"`{A.call_name(call)}(...)` in `{fn.name}` with "
+                    "`params` in scope but no enclosing "
+                    "`with param_scope(...)`",
+                    hint="wrap the evaluation in `with param_scope("
+                         "params):` so Param slots resolve")

@@ -259,11 +259,13 @@ class Session:
         if pallas is not None:
             # the string-kernel probe reads the env at trace time;
             # mirror the property there (documented as process-wide)
+            # presto-lint: ignore[PT401] -- deliberate documented mirror: the property IS the process-wide env switch (properties.py documents it); tests restore via the conftest guard
             os.environ["PRESTO_TPU_PALLAS"] = "1" if pallas else "0"
         narrow = self.prop("narrow_storage")
         if narrow is not None:
             # connectors read the switch at scan time (spi.narrow_enabled);
             # mirror the property there (documented as process-wide)
+            # presto-lint: ignore[PT401] -- deliberate documented mirror: the property IS the process-wide env switch (properties.py documents it); tests restore via the conftest guard
             os.environ["PRESTO_TPU_NARROW"] = "1" if narrow else "0"
         if self.mesh is None:
             budget = self.prop("join_build_budget_bytes")

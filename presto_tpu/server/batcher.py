@@ -289,7 +289,7 @@ class TemplateBatchGate:
             t["refs"] += 1
         return m
 
-    def _drop(self, template_key: str, n: int = 1) -> None:
+    def _drop_locked(self, template_key: str, n: int = 1) -> None:
         t = self._templates.get(template_key)
         if t is None:
             return
@@ -307,7 +307,7 @@ class TemplateBatchGate:
                 # KeyError out of the session
                 return "timeout", None
             if member.served:
-                self._drop(template_key)
+                self._drop_locked(template_key)
                 return "serve", member.df
             if t["exec"].acquire(blocking=False):
                 q = t["queue"]
@@ -329,13 +329,13 @@ class TemplateBatchGate:
                 return "timeout", None
             member.event.clear()
             if member.served:
-                self._drop(template_key)
+                self._drop_locked(template_key)
                 return "serve", member.df
             if not served:
                 member.abandoned = True
                 if member in t["queue"]:
                     t["queue"].remove(member)
-                self._drop(template_key)
+                self._drop_locked(template_key)
                 return "timeout", None
         return "retry", None
 
@@ -352,7 +352,7 @@ class TemplateBatchGate:
             member.abandoned = True
             if member in t["queue"]:
                 t["queue"].remove(member)
-            self._drop(template_key)
+            self._drop_locked(template_key)
 
     def serve(self, member: _BatchMember, df) -> bool:
         """Leader-side result delivery; returns False when the member
@@ -383,7 +383,7 @@ class TemplateBatchGate:
             # already dropped theirs in the timeout branch — dropping
             # them again would pop the template out from under members
             # still queued (stranding them with a held exec lock)
-            self._drop(template_key)
+            self._drop_locked(template_key)
             t = self._templates.get(template_key)
             if t is not None:
                 t["exec"].release()

@@ -185,7 +185,7 @@ class QueryServer:
             raise _UE(f"prepared statement not found: {name}")
 
     # ---- submit / poll (the /v1/statement shape) ------------------------
-    def _retire_records(self) -> None:
+    def _retire_records_locked(self) -> None:
         """Evict oldest TERMINAL records beyond the ring bound (under
         ``_qlock``): a long-running server must not hold every result
         frame it ever produced."""
@@ -220,7 +220,7 @@ class QueryServer:
                "submitted_at": time.time(), "done": threading.Event()}
         with self._qlock:
             self._queries[qid] = rec
-            self._retire_records()
+            self._retire_records_locked()
         REGISTRY.counter("server.submitted").add()
 
         def work():

@@ -58,7 +58,13 @@
 #      at its concurrency cap, cross-query batched dispatch fires at
 #      least once with results identical to serial execution, and the
 #      global memory pool drains (ISSUE-14 acceptance).
-#  12. The tier-1 pytest suite on the CPU backend (virtual-device
+#  12. Static-analysis gate (scripts/lint.sh): the engine-invariant
+#      linter (`python -m presto_tpu.analysis` — trace hygiene,
+#      cache-key completeness, lock discipline, global-state hygiene)
+#      must exit 0 on the repo, AND each rule family must flag its
+#      seeded known-bad fixture — proving the gate can actually fail
+#      (ISSUE-15 acceptance).
+#  13. The tier-1 pytest suite on the CPU backend (virtual-device
 #      distributed tests included; `slow` marks excluded), with the
 #      same flags and timeout the driver uses.
 #
@@ -651,6 +657,8 @@ print("serving smoke: %d batch dispatches (%d served), aggressor peak "
       % (int(fused), served, snap["aggressor"]["peak_running"],
          int(snap["aggressor"]["over_quota_blocked"]), checked))
 PY
+
+timeout -k 10 180 env JAX_PLATFORMS=cpu bash scripts/lint.sh || exit $?
 
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

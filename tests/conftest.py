@@ -41,3 +41,58 @@ def _memory_pool_leak_check():
 
     leaks = pool_leaks()
     assert not leaks, f"memory pool reservation leak: {leaks}"
+
+
+@pytest.fixture(autouse=True)
+def _global_state_guard(request):
+    """Process-global state invariant, enforced suite-wide (the static
+    twin is lint family PT4xx): a test must leave the ``PRESTO_TPU_*``
+    env switches, the exec-cache bound/population, and the metrics
+    registry exactly as it found them — sessions mirror properties into
+    the env and caches are process-wide, so an unrestored mutation
+    silently re-routes every later test (the recurring CHANGES.md
+    gotcha this guard retires). Unrestorable wipes (REGISTRY.reset)
+    must be declared with ``@pytest.mark.resets_global_state``.
+
+    On a leak the guard restores what it can (env, cache bound) before
+    failing, so one offender does not cascade."""
+    from presto_tpu.cache.exec_cache import EXEC_CACHE
+    from presto_tpu.runtime.metrics import REGISTRY
+
+    env_before = {k: v for k, v in os.environ.items()
+                  if k.startswith("PRESTO_TPU_")}
+    max_before = EXEC_CACHE.max_entries
+    entries_before = len(EXEC_CACHE)
+    # identity sentinel: REGISTRY.reset() drops the stat object, so a
+    # fresh fetch after the test returning a DIFFERENT object proves a
+    # reset happened even if something re-created the name since
+    sentinel = REGISTRY.counter("conftest.guard_sentinel")
+    yield
+    declared = request.node.get_closest_marker(
+        "resets_global_state") is not None
+    leaks = []
+    env_after = {k: v for k, v in os.environ.items()
+                 if k.startswith("PRESTO_TPU_")}
+    if env_after != env_before:
+        leaks.append(f"PRESTO_TPU_* env leaked: "
+                     f"{env_before!r} -> {env_after!r}")
+        for k in set(env_before) | set(env_after):
+            if k in env_before:
+                os.environ[k] = env_before[k]
+            else:
+                os.environ.pop(k, None)
+    if EXEC_CACHE.max_entries != max_before:
+        leaks.append(f"exec_cache_max_entries leaked: "
+                     f"{max_before} -> {EXEC_CACHE.max_entries}")
+        EXEC_CACHE.set_max_entries(max_before)
+    if len(EXEC_CACHE) < entries_before:
+        # growth and at-bound eviction are normal; a shrink means an
+        # undeclared EXEC_CACHE.clear()/bound drop
+        leaks.append(f"exec-cache entries shrank: "
+                     f"{entries_before} -> {len(EXEC_CACHE)}")
+    if REGISTRY.counter("conftest.guard_sentinel") is not sentinel:
+        leaks.append("metrics REGISTRY was reset")
+    if leaks and not declared:
+        raise AssertionError(
+            "process-global state leak (declare deliberate wipes with "
+            "@pytest.mark.resets_global_state): " + "; ".join(leaks))

@@ -1,0 +1,630 @@
+"""The analyzer analyzed: per-rule seeded-violation fixtures.
+
+Every rule family must (a) flag its known-bad snippet, (b) stay silent
+on the known-good twin, (c) honor inline suppressions WITH reasons,
+(d) honor the reviewed baseline, and (e) emit the stable JSON schema —
+the contract tier-1 gate 12 (scripts/lint.sh) builds on. Fixtures are
+written to tmp_path and analyzed with that directory as the repo root,
+so nothing here touches (or imports) the real engine code: the
+analyzer is pure-``ast`` by design and these tests prove it stays so.
+"""
+
+import json
+
+import pytest
+
+from presto_tpu.analysis import RULES, analyze
+from presto_tpu.analysis.findings import SCHEMA_VERSION
+
+
+def run(tmp_path, sources: dict, rules=None, baseline=None):
+    """Write {filename: source} under tmp_path and analyze it as a
+    standalone project (empty baseline unless given)."""
+    for name, src in sources.items():
+        (tmp_path / name).write_text(src)
+    return analyze([str(tmp_path)], root=str(tmp_path), rule_ids=rules,
+                   baseline=baseline or [])
+
+
+def rule_ids(result):
+    return [f.rule for f in result.findings]
+
+
+def test_rule_catalog_registered():
+    import presto_tpu.analysis.rules  # noqa: F401
+
+    assert {"PT001", "PT101", "PT102", "PT103", "PT201", "PT301",
+            "PT302", "PT303", "PT401", "PT402", "PT403"} <= set(RULES)
+    for rid, rule in RULES.items():
+        assert rule.description and rule.motivation, rid
+        assert rule.severity in ("error", "warning")
+
+
+# ---------------------------------------------------------------------------
+# PT1xx trace hygiene
+# ---------------------------------------------------------------------------
+
+BAD_STEP = """
+import jax
+import numpy as np
+
+
+def _make_bad_step():
+    def step(batch, params=()):
+        n = int(batch["count"])
+        arr = np.asarray(batch)
+        v = batch.item()
+        return n + arr + v
+    return jax.jit(step)
+"""
+
+GOOD_STEP = """
+import jax
+import jax.numpy as jnp
+
+
+def _make_good_step(cap):
+    def step(batch, params=()):
+        rows = int(batch.shape[0])          # static metadata: fine
+        fill = float(cap)                   # closure constant: fine
+        return jnp.sum(batch) + rows + fill
+    return jax.jit(step)
+"""
+
+
+def test_pt101_flags_host_sync_in_traced_step(tmp_path):
+    res = run(tmp_path, {"mod.py": BAD_STEP}, rules=["PT101"])
+    assert rule_ids(res) == ["PT101", "PT101", "PT101"]
+    assert "int(" in res.findings[0].message
+
+
+def test_pt101_silent_on_static_metadata(tmp_path):
+    res = run(tmp_path, {"mod.py": GOOD_STEP}, rules=["PT101"])
+    assert res.findings == []
+
+
+def test_pt102_flags_branch_on_traced_param(tmp_path):
+    src = """
+import jax
+
+
+def _make_step():
+    def step(batch):
+        if batch > 0:
+            return batch
+        return -batch
+    return jax.jit(step)
+"""
+    res = run(tmp_path, {"mod.py": src}, rules=["PT102"])
+    assert rule_ids(res) == ["PT102"]
+
+
+def test_pt102_silent_on_identity_and_shape_tests(tmp_path):
+    src = """
+import jax
+
+
+def _make_step():
+    def step(batch, aux=None):
+        if aux is not None:
+            batch = batch + aux
+        if batch.shape[0] > 8:
+            batch = batch[:8]
+        return batch
+    return jax.jit(step)
+"""
+    res = run(tmp_path, {"mod.py": src}, rules=["PT102"])
+    assert res.findings == []
+
+
+def test_pt103_flags_eval_without_param_scope(tmp_path):
+    src = """
+from presto_tpu.expr import evaluate
+
+
+def project(batch, params):
+    return evaluate(batch, None)
+"""
+    good = """
+from presto_tpu.expr import evaluate, param_scope
+
+
+def project(batch, params):
+    with param_scope(params):
+        return evaluate(batch, None)
+"""
+    res = run(tmp_path, {"mod.py": src}, rules=["PT103"])
+    assert rule_ids(res) == ["PT103"]
+    res = run(tmp_path, {"mod.py": good}, rules=["PT103"])
+    assert res.findings == []
+
+
+def test_pt103_flags_param_values_access_outside_expr(tmp_path):
+    src = """
+from presto_tpu import expr
+
+
+def peek():
+    return expr._PARAM_VALUES.get()
+"""
+    res = run(tmp_path, {"mod.py": src}, rules=["PT103"])
+    assert rule_ids(res) == ["PT103"]
+    assert res.findings[0].severity == "error"
+
+
+# ---------------------------------------------------------------------------
+# PT2xx cache-key completeness
+# ---------------------------------------------------------------------------
+
+BAD_CACHE = """
+import os
+
+from presto_tpu.cache.exec_cache import EXEC_CACHE
+
+
+def build():
+    def builder():
+        flag = os.environ.get("PRESTO_TPU_SPECIAL", "0") == "1"
+        return lambda b: b if flag else -b
+    return EXEC_CACHE.get_or_build(
+        EXEC_CACHE.key_of("step", 42), builder)
+"""
+
+GOOD_CACHE = """
+import os
+
+from presto_tpu.cache.exec_cache import EXEC_CACHE
+
+
+def build():
+    special = os.environ.get("PRESTO_TPU_SPECIAL", "0") == "1"
+
+    def builder():
+        return (lambda b: b) if special else (lambda b: -b)
+    return EXEC_CACHE.get_or_build(
+        EXEC_CACHE.key_of("step", 42, special), builder)
+"""
+
+
+def test_pt201_flags_unkeyed_env_knob(tmp_path):
+    res = run(tmp_path, {"mod.py": BAD_CACHE}, rules=["PT201"])
+    assert rule_ids(res) == ["PT201"]
+    assert "PRESTO_TPU_SPECIAL" in res.findings[0].message
+
+
+def test_pt201_silent_when_hoisted_knob_is_keyed(tmp_path):
+    res = run(tmp_path, {"mod.py": GOOD_CACHE}, rules=["PT201"])
+    assert res.findings == []
+
+
+def test_pt201_flags_captured_knob_missing_from_key(tmp_path):
+    src = """
+from presto_tpu.cache.exec_cache import EXEC_CACHE
+from presto_tpu.spi import narrow_enabled
+
+
+def build():
+    narrow = narrow_enabled()
+    return EXEC_CACHE.get_or_build(
+        EXEC_CACHE.key_of("step", 7),
+        lambda: (lambda b: b + (1 if narrow else 0)))
+"""
+    res = run(tmp_path, {"mod.py": src}, rules=["PT201"])
+    assert rule_ids(res) == ["PT201"]
+    assert "narrow_enabled" in res.findings[0].message
+
+
+def test_pt201_use_pallas_is_implicitly_keyed_via_key_of(tmp_path):
+    # key_of itself folds use_pallas() into every fingerprint — a
+    # builder reading it with a key_of-built key is complete
+    src = """
+from presto_tpu.cache.exec_cache import EXEC_CACHE
+from presto_tpu.ops.strings import use_pallas
+
+
+def build():
+    return EXEC_CACHE.get_or_build(
+        EXEC_CACHE.key_of("step", 7),
+        lambda: (lambda b: b if use_pallas() else -b))
+"""
+    res = run(tmp_path, {"mod.py": src}, rules=["PT201"])
+    assert res.findings == []
+
+
+# ---------------------------------------------------------------------------
+# PT3xx lock discipline
+# ---------------------------------------------------------------------------
+
+BAD_LOCKS = """
+import threading
+
+
+class Shared:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+
+    def add(self, x):
+        with self._lock:
+            self._items.append(x)
+
+    def drop(self, x):
+        self._items.remove(x)
+"""
+
+GOOD_LOCKS = """
+import threading
+
+
+class Shared:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+
+    def add(self, x):
+        with self._lock:
+            self._items.append(x)
+            self._trim_locked()
+
+    def drop(self, x):
+        with self._lock:
+            self._items.remove(x)
+
+    def _trim_locked(self):
+        del self._items[8:]
+"""
+
+
+def test_pt301_flags_unguarded_mutation(tmp_path):
+    res = run(tmp_path, {"mod.py": BAD_LOCKS}, rules=["PT301"])
+    assert rule_ids(res) == ["PT301"]
+    assert "_items" in res.findings[0].message
+
+
+def test_pt301_honors_locked_suffix_and_init(tmp_path):
+    res = run(tmp_path, {"mod.py": GOOD_LOCKS}, rules=["PT301"])
+    assert res.findings == []
+
+
+def test_pt303_flags_self_deadlock_not_rlock(tmp_path):
+    src = """
+import threading
+
+
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def reserve(self):
+        with self._lock:
+            self._n += 1
+            return self.describe()
+
+    def describe(self):
+        with self._lock:
+            return str(self._n)
+
+
+class RPool:
+    def __init__(self):
+        self._cv = threading.Condition()   # RLock-backed: reentrant
+        self._n = 0
+
+    def reserve(self):
+        with self._cv:
+            self._n += 1
+            return self.describe()
+
+    def describe(self):
+        with self._cv:
+            return str(self._n)
+"""
+    res = run(tmp_path, {"mod.py": src}, rules=["PT303"])
+    assert rule_ids(res) == ["PT303"]
+    assert res.findings[0].data.get("cls") == "Pool" or \
+        "Pool" in res.findings[0].message
+
+
+def test_pt302_flags_lock_order_cycle(tmp_path):
+    src = """
+import threading
+
+
+class Alpha:
+    def __init__(self, other):
+        self._lock = threading.Lock()
+        self.other = other
+
+    def ping_alpha(self):
+        with self._lock:
+            self.other.pong_beta()
+
+
+class Beta:
+    def __init__(self, other):
+        self._lock = threading.Lock()
+        self.other = other
+
+    def pong_beta(self):
+        with self._lock:
+            pass
+
+    def back(self):
+        with self._lock:
+            self.other.ping_alpha()
+"""
+    res = run(tmp_path, {"mod.py": src}, rules=["PT302"])
+    assert rule_ids(res) == ["PT302"]
+    assert "Alpha" in res.findings[0].message
+    assert "Beta" in res.findings[0].message
+
+
+def test_pt302_silent_on_one_way_edges(tmp_path):
+    src = """
+import threading
+
+
+class Alpha:
+    def __init__(self, other):
+        self._lock = threading.Lock()
+        self.other = other
+
+    def ping_alpha(self):
+        with self._lock:
+            self.other.pong_beta()
+
+
+class Beta:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def pong_beta(self):
+        with self._lock:
+            pass
+"""
+    res = run(tmp_path, {"mod.py": src}, rules=["PT302"])
+    assert res.findings == []
+
+
+# ---------------------------------------------------------------------------
+# PT4xx global-state hygiene
+# ---------------------------------------------------------------------------
+
+def test_pt401_flags_unrestored_env_mutation(tmp_path):
+    src = """
+import os
+
+
+def test_toggle():
+    os.environ["PRESTO_TPU_NARROW"] = "1"
+"""
+    res = run(tmp_path, {"test_env.py": src}, rules=["PT401"])
+    assert rule_ids(res) == ["PT401"]
+
+
+def test_pt401_honors_try_finally_and_fixture_teardown(tmp_path):
+    src = """
+import os
+
+import pytest
+
+
+def test_toggle():
+    before = os.environ.get("PRESTO_TPU_NARROW")
+    os.environ["PRESTO_TPU_NARROW"] = "1"
+    try:
+        pass
+    finally:
+        if before is None:
+            os.environ.pop("PRESTO_TPU_NARROW", None)
+        else:
+            os.environ["PRESTO_TPU_NARROW"] = before
+
+
+@pytest.fixture
+def narrow_env():
+    os.environ["PRESTO_TPU_NARROW"] = "1"
+    yield
+    os.environ.pop("PRESTO_TPU_NARROW", None)
+"""
+    res = run(tmp_path, {"test_env.py": src}, rules=["PT401"])
+    assert res.findings == []
+
+
+def test_pt401_partial_restore_still_flags_the_unrestored_key(tmp_path):
+    src = """
+import os
+
+
+def test_two_keys():
+    a = os.environ.get("PRESTO_TPU_A")
+    os.environ["PRESTO_TPU_A"] = "1"
+    os.environ["PRESTO_TPU_B"] = "1"
+    try:
+        pass
+    finally:
+        os.environ.pop("PRESTO_TPU_A", None)
+"""
+    res = run(tmp_path, {"test_env.py": src}, rules=["PT401"])
+    assert rule_ids(res) == ["PT401"]
+    assert "PRESTO_TPU_B" in res.findings[0].message
+
+
+def test_pt303_flags_acquire_release_style_hold(tmp_path):
+    src = """
+import threading
+
+
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def reserve(self):
+        self._lock.acquire()
+        try:
+            self._n += 1
+            return self.describe()
+        finally:
+            self._lock.release()
+
+    def describe(self):
+        with self._lock:
+            return str(self._n)
+"""
+    res = run(tmp_path, {"mod.py": src}, rules=["PT303"])
+    assert rule_ids(res) == ["PT303"]
+
+
+def test_pt402_requires_marker_for_registry_reset(tmp_path):
+    bad = """
+from presto_tpu.runtime.metrics import REGISTRY
+
+
+def test_counters():
+    REGISTRY.reset()
+"""
+    good = """
+import pytest
+
+from presto_tpu.runtime.metrics import REGISTRY
+
+
+@pytest.mark.resets_global_state
+def test_counters():
+    REGISTRY.reset()
+"""
+    pytestmarked = """
+import pytest
+
+from presto_tpu.runtime.metrics import REGISTRY
+
+pytestmark = pytest.mark.resets_global_state
+
+
+def test_counters():
+    REGISTRY.reset()
+"""
+    res = run(tmp_path, {"test_reg.py": bad}, rules=["PT402"])
+    assert rule_ids(res) == ["PT402"]
+    res = run(tmp_path, {"test_reg.py": good}, rules=["PT402"])
+    assert res.findings == []
+    # module-level pytestmark is the same declaration surface the
+    # runtime conftest guard accepts — the static rule must agree
+    res = run(tmp_path, {"test_reg.py": pytestmarked}, rules=["PT402"])
+    assert res.findings == []
+
+
+def test_pt403_flags_raw_trace_probe_outside_window(tmp_path):
+    bad = """
+from presto_tpu.runtime.metrics import REGISTRY
+
+
+def test_warm(session):
+    t0 = REGISTRY.snapshot().get("exec.traces", 0)
+    session.sql("select 1")
+    assert REGISTRY.snapshot().get("exec.traces", 0) == t0
+"""
+    good = """
+from presto_tpu.cache.exec_cache import trace_delta
+
+
+def test_warm(session):
+    with trace_delta() as td:
+        session.sql("select 1")
+    assert td.traces == 0
+"""
+    res = run(tmp_path, {"test_tr.py": bad}, rules=["PT403"])
+    assert rule_ids(res) == ["PT403", "PT403"]
+    res = run(tmp_path, {"test_tr.py": good}, rules=["PT403"])
+    assert res.findings == []
+
+
+# ---------------------------------------------------------------------------
+# suppression / baseline / output schema
+# ---------------------------------------------------------------------------
+
+def test_suppression_with_reason_is_honored(tmp_path):
+    src = BAD_LOCKS.replace(
+        "        self._items.remove(x)",
+        "        # presto-lint: ignore[PT301] -- benchmark-only path, "
+        "single-threaded by construction\n"
+        "        self._items.remove(x)")
+    res = run(tmp_path, {"mod.py": src}, rules=["PT301"])
+    assert res.findings == []
+    assert len(res.suppressed) == 1
+    assert "single-threaded" in res.suppressed[0][1].reason
+
+
+def test_suppression_without_reason_does_not_suppress(tmp_path):
+    src = BAD_LOCKS.replace(
+        "        self._items.remove(x)",
+        "        self._items.remove(x)  # presto-lint: ignore[PT301]")
+    res = run(tmp_path, {"mod.py": src})
+    ids = rule_ids(res)
+    assert "PT301" in ids      # not suppressed
+    assert "PT001" in ids      # and the reasonless comment is flagged
+
+
+def test_baseline_is_honored_and_content_anchored(tmp_path):
+    res = run(tmp_path, {"mod.py": BAD_LOCKS}, rules=["PT301"])
+    (finding,) = res.findings
+    entry = {"rule": "PT301", "path": finding.path,
+             "anchor": finding.anchor,
+             "reason": "grandfathered: pre-lint code, scheduled fix"}
+    res2 = run(tmp_path, {"mod.py": BAD_LOCKS}, rules=["PT301"],
+               baseline=[entry])
+    assert res2.findings == [] and len(res2.baselined) == 1
+    # editing the flagged line orphans the entry: the finding returns
+    drifted = dict(entry, anchor="self._items.remove(x, strict=True)")
+    res3 = run(tmp_path, {"mod.py": BAD_LOCKS}, rules=["PT301"],
+               baseline=[drifted])
+    assert rule_ids(res3) == ["PT301"]
+
+
+def test_json_output_schema_is_stable(tmp_path):
+    res = run(tmp_path, {"mod.py": BAD_LOCKS}, rules=["PT301"])
+    doc = json.loads(res.to_json())
+    assert doc["version"] == SCHEMA_VERSION
+    assert set(doc["counts"]) == {"open", "suppressed", "baselined"}
+    (f,) = doc["findings"]
+    assert set(f) == {"rule", "severity", "path", "line", "col",
+                      "message", "hint", "anchor", "data"}
+    assert f["rule"] == "PT301" and f["path"] == "mod.py"
+    assert isinstance(f["line"], int) and f["line"] > 0
+    assert f["data"] == {"cls": "Shared", "attr": "_items"}
+
+
+def test_cli_exit_codes_and_rule_filter(tmp_path, capsys):
+    from presto_tpu.analysis.__main__ import main
+
+    (tmp_path / "mod.py").write_text(BAD_LOCKS)
+    rc = main([str(tmp_path / "mod.py"), "--no-baseline"])
+    assert rc == 1
+    out = capsys.readouterr().out
+    assert "PT301" in out
+    rc = main([str(tmp_path / "mod.py"), "--no-baseline",
+               "--rule", "PT403"])
+    assert rc == 0
+    assert main(["--list-rules"]) == 0
+
+
+def test_unknown_rule_id_is_a_usage_error(tmp_path, capsys):
+    from presto_tpu.analysis.__main__ import main
+
+    assert main(["--rule", "PT999", str(tmp_path)]) == 2
+
+
+def test_repo_analyzes_clean():
+    """The acceptance gate in miniature: the shipped tree has zero
+    unsuppressed findings against the shipped baseline."""
+    import os
+
+    import presto_tpu
+
+    root = os.path.dirname(os.path.dirname(
+        os.path.abspath(presto_tpu.__file__)))
+    res = analyze([os.path.join(root, "presto_tpu"),
+                   os.path.join(root, "tests")], root=root)
+    assert res.findings == [], [f.render() for f in res.findings]

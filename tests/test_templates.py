@@ -300,46 +300,70 @@ def test_concurrent_identical_queries_coalesce(monkeypatch):
     """N concurrent submissions of one identical query = ONE device
     dispatch + N correct results. The leader is gated inside run_plan
     until every follower has registered, so the coalesce is
-    deterministic, not a timing accident."""
+    deterministic, not a timing accident. The registration gate itself
+    is re-attempted (a worker thread can be scheduled arbitrarily late
+    on a loaded 1-core box — then a second dispatch is CORRECT
+    opportunistic behavior, not a coalescing bug); worker exceptions
+    are captured and surfaced, never swallowed into a thread death."""
     s = make_session()
     q = ("select o_orderpriority, count(*) c from orders"
          " group by o_orderpriority order by o_orderpriority")
     expected = s.sql(q)  # warm compile; also the correctness oracle
     coal = s.query_manager.coalescer
-    release = threading.Event()
-    calls = []
     orig = QueryManager.run_plan
 
-    def gated(self, executor, plan, info, recorder):
-        calls.append(info.query_id)
-        release.wait(20)
-        return orig(self, executor, plan, info, recorder)
+    for attempt in range(3):
+        release = threading.Event()
+        calls = []
 
-    monkeypatch.setattr(QueryManager, "run_plan", gated)
-    results = {}
+        def gated(self, executor, plan, info, recorder,
+                  _release=release, _calls=calls):
+            _calls.append(info.query_id)
+            _release.wait(20)
+            return orig(self, executor, plan, info, recorder)
 
-    def worker(i):
-        results[i] = s.sql(q)
+        monkeypatch.setattr(QueryManager, "run_plan", gated)
+        results, errors = {}, []
 
-    c0 = counter("prepare.coalesced")
-    threads = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
-    for t in threads:
-        t.start()
-    deadline = time.monotonic() + 20
-    while time.monotonic() < deadline:
-        with coal._lock:
-            waiting = sum(e.waiters for e in coal._inflight.values())
-        if calls and waiting == 3:
+        def worker(i, _results, _errors):
+            try:
+                _results[i] = s.sql(q)
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                _errors.append((i, repr(e)))
+
+        c0 = counter("prepare.coalesced")
+        threads = [threading.Thread(target=worker,
+                                    args=(i, results, errors))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 20
+        registered = False
+        while time.monotonic() < deadline:
+            with coal._lock:
+                waiting = sum(e.waiters
+                              for e in coal._inflight.values())
+            if calls and waiting == 3:
+                registered = True
+                break
+            time.sleep(0.01)
+        release.set()
+        for t in threads:
+            t.join(60)
+        monkeypatch.setattr(QueryManager, "run_plan", orig)
+        assert not errors, f"worker exceptions (attempt {attempt}): " \
+                           f"{errors}"
+        if registered:
             break
-        time.sleep(0.01)
-    release.set()
-    for t in threads:
-        t.join(60)
+    else:
+        pytest.fail("followers never all registered in 3 attempts "
+                    f"(last: calls={calls})")
+
     assert len(calls) == 1, f"expected one dispatch, saw {len(calls)}"
     assert counter("prepare.coalesced") == c0 + 3
     for df in results.values():
         pd.testing.assert_frame_equal(df, expected)
-    assert sum(i.coalesced for i in s.query_history) == 3
+    assert sum(i.coalesced for i in s.query_history) >= 3
 
 
 def test_concurrent_distinct_literals_ride_one_warm_template():
