@@ -106,6 +106,12 @@ SCHEMAS: dict[str, dict[str, DataType]] = {
         "error_code": fixed_bytes(32),
         "oom_rung": BIGINT,
         "rungs": BIGINT,
+        # rung-history totals: ``rungs`` counts LADDER entries only
+        # (runtime-OOM re-plans); ``rungs_total`` also counts the
+        # planned_hybrid/planned_grouped out-of-core decisions, and
+        # ``first_rung_error`` is the error that started the ladder
+        "rungs_total": BIGINT,
+        "first_rung_error": fixed_bytes(64),
         "fragment_retries": BIGINT,
         "degraded": BIGINT,
         "spans": BIGINT,
@@ -282,6 +288,12 @@ class SystemConnector:
                     mis, skews, runs)
         if table == "flight_recorder":
             recs = self._session.flight.records()
+
+            def ladder(r):
+                # pre-spill-tier entries carry no "kind": treat as ladder
+                return [e for e in r.rung_history
+                        if e.get("kind", "ladder") == "ladder"]
+
             return (
                 [r.query_id for r in recs],
                 [r.state for r in recs],
@@ -289,7 +301,10 @@ class SystemConnector:
                 [",".join(r.triggers) for r in recs],
                 [r.error_code or "" for r in recs],
                 [r.oom_rung for r in recs],
+                [len(ladder(r)) for r in recs],
                 [len(r.rung_history) for r in recs],
+                [(ladder(r)[0].get("error", "") if ladder(r) else "")
+                 for r in recs],
                 [r.fragment_retries for r in recs],
                 [int(r.degraded_to_local) for r in recs],
                 [len(r.spans) for r in recs],
@@ -434,7 +449,8 @@ class SystemConnector:
                 "runs": np.asarray(runs, np.int64),
             }
         elif table == "flight_recorder":
-            (qid, state, sql, trig, ecode, rung, rungs, retries, degr,
+            (qid, state, sql, trig, ecode, rung, rungs, rungs_total,
+             first_err, retries, degr,
              spans, mdeltas, hot, execs, cap, poolb) = rows
             arrays = {
                 "query_id": _bytes_col(qid, 24),
@@ -444,6 +460,8 @@ class SystemConnector:
                 "error_code": _bytes_col(ecode, 32),
                 "oom_rung": np.asarray(rung, np.int64),
                 "rungs": np.asarray(rungs, np.int64),
+                "rungs_total": np.asarray(rungs_total, np.int64),
+                "first_rung_error": _bytes_col(first_err, 64),
                 "fragment_retries": np.asarray(retries, np.int64),
                 "degraded": np.asarray(degr, np.int64),
                 "spans": np.asarray(spans, np.int64),

@@ -190,6 +190,7 @@ class DistributedExecutor(OomLadderMixin):
         gather_limit: int = 1 << 22,
         direct_group_limit: int | None = None,
         join_build_budget: int | None = None,
+        spill_host_budget: int | None = None,
     ):
         from presto_tpu.exec.local_planner import DIRECT_LIMIT
 
@@ -271,6 +272,15 @@ class DistributedExecutor(OomLadderMixin):
         #: destination ids that tripped a receive-capacity overflow
         #: (the hot partitions the doubled-buffer retries paid for)
         self.hot_partitions: list = []
+        #: session-scoped host-RAM spill budget override (the
+        #: ``spill_host_budget_bytes`` property); None -> the
+        #: process-wide ``runtime/memory.global_host_spill_budget``
+        self.spill_host_budget = spill_host_budget
+        self._host_budget = None
+        #: executed spill-decision summaries of the LAST run (the
+        #: flight recorder copies these into failure post-mortems, the
+        #: lifecycle layer into planned_hybrid rung-history entries)
+        self.spill_events: list = []
 
     # ------------------------------------------------------------------
     def run(self, plan: N.PlanNode):
@@ -293,6 +303,7 @@ class DistributedExecutor(OomLadderMixin):
         # re-enters run(); each rung flushes its own observations)
         self._skew_accum.clear()
         self.hot_partitions = []
+        self.spill_events = []
         scalars: dict[str, Any] = {}
         try:
             # concrete literal-slot values scope the whole run (eager
@@ -452,7 +463,8 @@ class DistributedExecutor(OomLadderMixin):
                 "hot_partition": int(np.argmax(counts)),
             })
             if node is not None and self.recorder is not None:
-                self.recorder.record_skew(node, ratio, rows)
+                self.recorder.record_skew(node, ratio, rows,
+                                          hot=int(np.argmax(counts)))
         self._skew_accum.clear()
         self.exchange_skew = summaries
 
@@ -673,9 +685,10 @@ class DistributedExecutor(OomLadderMixin):
 
         est = estimate_node_bytes(node, self.catalog)
         if est > self.join_build_budget or self.oom_rung > 0:
+            decision = self._spill_decision(node, est)
             REGISTRY.counter("agg.strategy.partial").add()
-            return self._grouped_dist_agg(d.batch, keys, aggs, pax, est,
-                                          node=node)
+            return self._grouped_dist_agg(d.batch, keys, aggs, pax,
+                                          decision, node=node)
         # adaptive bypass (leaf_route.bypass_partial_agg): when group
         # cardinality ~ input cardinality, the per-device partial
         # group-sort reduces nothing before the shuffle — stream the
@@ -980,13 +993,18 @@ class DistributedExecutor(OomLadderMixin):
                 raise NotImplementedError(
                     "wide string keys in grouped (spilled) joins"
                 )
+            # the planned out-of-core choice (exec/spill.plan_spill):
+            # hybrid keeps the K hottest build buckets in one combined
+            # resident pass, grouped streams them all
+            decision = self._spill_decision(node, est)
             # hand over the ONLY references so the spill can actually
             # free the device-resident inputs (a `del` inside the callee
             # is void while this frame still holds them)
             sides = [left, right]
             del left, right
-            self._count_distribution("grouped")
-            return self._grouped_dist_join(node, sides, lkey, rkey, est)
+            self._count_distribution(decision.mode)
+            return self._grouped_dist_join(node, sides, lkey, rkey,
+                                           decision)
         fault_point("step.join_build")
         if (
             build_rows <= self.broadcast_limit
@@ -1504,14 +1522,40 @@ class DistributedExecutor(OomLadderMixin):
             cols[n] = Column(c.data, c.valid, c.dtype, dic)
         return DistBatch(Batch(cols, out.live), sharded=True)
 
+    def _host_spill_budget(self):
+        """Host-RAM budget spilled partitions reserve against: the
+        session's ``spill_host_budget_bytes`` property when set, else
+        the process-wide budget (device HBM x 16). Shared discipline
+        with the local tier (``exec/local_planner``): host memory for
+        spills is ACCOUNTED, and exhaustion is a typed loud failure
+        (SPILL_BUDGET_EXCEEDED), never silent growth."""
+        if self._host_budget is None:
+            from presto_tpu.runtime.memory import (
+                HostSpillBudget,
+                global_host_spill_budget,
+            )
+
+            if self.spill_host_budget:
+                self._host_budget = HostSpillBudget(
+                    self.spill_host_budget, name="session-spill")
+            else:
+                self._host_budget = global_host_spill_budget()
+        return self._host_budget
+
     def _grouped_dist_join(self, node, sides: list, lkey, rkey,
-                           est_bytes: int) -> DistBatch:
-        """Grouped (bucketed) distributed join: both sides spill to host
-        RAM partitioned by a key-hash bucket id, the device copies free,
-        then each bucket replays the NORMAL repartition join over the
-        whole mesh — peak HBM is one bucket's build plus probe instead
-        of the full relations. Bucketing by the join key is exact for
-        every join kind (a key's matches, null-extensions and
+                           decision) -> DistBatch:
+        """Out-of-core distributed join (hybrid or grouped): both sides
+        spill to host RAM partitioned by a key-hash bucket id, the
+        device copies free, then bucket passes replay the NORMAL
+        repartition join over the whole mesh — peak HBM is one pass's
+        build plus probe instead of the full relations. Under a
+        ``hybrid`` decision the resident buckets (clamped against
+        ACTUAL partition sizes by ``spill.fit_resident``) run as ONE
+        combined first pass — key-equal rows always share a bucket, so
+        merging disjoint buckets cannot create false matches — and the
+        cold buckets stream back through the double-buffered
+        ``spill.transfer_iter`` pipeline. Bucketing by the join key is
+        exact for every join kind (a key's matches, null-extensions and
         unmatched-build tail all live in its own bucket), so FULL OUTER
         works here even though the local grouped tier excludes it.
 
@@ -1521,37 +1565,82 @@ class DistributedExecutor(OomLadderMixin):
         free before the bucket passes start (a plain parameter would
         stay pinned by the caller's frame for the whole loop).
         """
+        from presto_tpu.exec.spill import fit_resident, transfer_iter
+        from presto_tpu.runtime.metrics import REGISTRY
+
         fault_point("step.grouped_join")
-        nbuckets = self._grouped_nbuckets(est_bytes)
+        nbuckets = decision.nbuckets
         lcols, llive, lbids = self._pull_host(sides[0], lkey, nbuckets)
         sides[0] = None
         rcols, rlive, rbids = self._pull_host(sides[1], rkey, nbuckets)
         sides[1] = None
-        outs = []
-        for bk in range(nbuckets):
-            lb = self._place_sharded(lcols, llive & (lbids == bk))
-            rb = self._place_sharded(rcols, rlive & (rbids == bk))
-            outs.append(
-                self._repartition_join(
-                    node, DistBatch(lb, True), DistBatch(rb, True),
-                    lkey, rkey,
-                ).batch
-            )
-        return self._concat_sharded_many(outs)
+        host_bytes = int(sum(
+            data.nbytes + valid.nbytes
+            for cols in (lcols, rcols)
+            for data, valid, _, _ in cols.values()
+        ))
+        budget = self._host_spill_budget()
+        budget.reserve("dist-spill", host_bytes)
+        try:
+            rcounts = np.bincount(
+                rbids[rlive].astype(np.int64), minlength=nbuckets)
+            row_bytes = max(
+                decision.est_bytes // max(int(rcounts.sum()), 1), 1)
+            resident, _ = fit_resident(
+                decision, lambda bk: int(rcounts[bk]), row_bytes)
+            rset = set(resident)
+            cold = [bk for bk in range(nbuckets) if bk not in rset]
+            outs = []
+            if resident:
+                res = np.asarray(sorted(rset), dtype=np.int64)
+                lb = self._place_sharded(lcols, llive & np.isin(lbids, res))
+                rb = self._place_sharded(rcols, rlive & np.isin(rbids, res))
+                outs.append(
+                    self._repartition_join(
+                        node, DistBatch(lb, True), DistBatch(rb, True),
+                        lkey, rkey,
+                    ).batch
+                )
+
+            def load(bk):
+                lb = self._place_sharded(lcols, llive & (lbids == bk))
+                rb = self._place_sharded(rcols, rlive & (rbids == bk))
+                return lb, rb
+
+            for bk, (lb, rb) in transfer_iter(load, cold):
+                REGISTRY.counter("spill.transfer_bytes").add(int(sum(
+                    c.data.nbytes + c.valid.nbytes
+                    for part in (lb, rb) for c in part.columns.values()
+                )))
+                outs.append(
+                    self._repartition_join(
+                        node, DistBatch(lb, True), DistBatch(rb, True),
+                        lkey, rkey,
+                    ).batch
+                )
+            self._note_spill(node, decision, resident=resident,
+                             streamed=len(cold), host_bytes=host_bytes)
+            return self._concat_sharded_many(outs)
+        finally:
+            # the host copies are locals of this frame — the reservation
+            # dies exactly when they do, success OR fault path
+            budget.release("dist-spill", host_bytes)
 
     def _grouped_dist_agg(self, b: Batch, keys, aggs, pax,
-                          est_bytes: int, node=None) -> DistBatch:
-        """Grouped aggregation: ``nbuckets`` sequential passes, each
-        filtering the input to one key-hash bucket (device-side, no
+                          decision, node=None) -> DistBatch:
+        """Grouped aggregation: ``decision.nbuckets`` sequential passes,
+        each filtering the input to one key-hash bucket (device-side, no
         spill — the input is already resident; what the budget bounds is
         the AGGREGATION STATE: partial capacities, exchange receive
         buffers and final group tables all shrink by ~1/nbuckets).
         Groups partition exactly by key hash, so the pass outputs are
-        disjoint and their union is the correct grouping."""
+        disjoint and their union is the correct grouping. Under a
+        ``hybrid`` decision the planned resident (hot) buckets run
+        first — the passes that benefit most from warm compile caches."""
         from presto_tpu.ops.hashing import bucket_ids
 
         Pn = self.nworkers
-        nbuckets = self._grouped_nbuckets(est_bytes)
+        nbuckets = decision.nbuckets
 
         def key_sortables(local: Batch):
             return [
@@ -1610,13 +1699,20 @@ class DistributedExecutor(OomLadderMixin):
             make_filter_step,
         )
         outs = []
-        for bk in range(nbuckets):
+        rset = set(decision.resident)
+        order = list(decision.resident) + [
+            bk for bk in range(nbuckets) if bk not in rset
+        ]
+        for bk in order:
             fb = fstep(b, bids, jnp.asarray(bk, jnp.int32))
             # node threads through so bucket-pass exchange skew still
             # attributes to the Aggregate (the budget-bounded queries
             # are exactly the ones most likely to be skewed)
             outs.append(self._dist_grouped_agg(fb, keys, aggs, pax,
                                                node=node).batch)
+        if node is not None:
+            self._note_spill(node, decision,
+                             streamed=nbuckets - len(rset))
         return self._concat_sharded_many(outs)
 
     def _exec_semijoin(self, node: N.SemiJoin, scalars) -> DistBatch:
@@ -1634,10 +1730,12 @@ class DistributedExecutor(OomLadderMixin):
         if est > self.join_build_budget or self.oom_rung > 0:
             # bucketing is exact for semi AND anti: a probe key's
             # existence is decided entirely within its own bucket
+            decision = self._spill_decision(node, est)
             sides = [left, right]
             del left, right
+            self._count_distribution(decision.mode)
             return self._grouped_dist_join(
-                _SemiShim(node), sides, lkey, rkey, est
+                _SemiShim(node), sides, lkey, rkey, decision
             )
         fault_point("step.join_build")
         if (
@@ -2115,3 +2213,6 @@ class _SemiShim:
         self.kind = "anti" if node.negated else "semi"
         self.unique = False
         self.output_right = ()
+        #: the real plan node, so spill/stats recording attributes to
+        #: the SemiJoin instead of this throwaway adapter
+        self.plan_node = node

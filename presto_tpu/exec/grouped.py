@@ -27,13 +27,26 @@ from presto_tpu.spi import batch_capacity
 
 
 class HostSpill:
-    """Per-bucket host-side row store for one relation."""
+    """Per-bucket host-side row store for one relation.
 
-    def __init__(self, nbuckets: int):
+    With a ``budget`` (a ``runtime/memory.HostSpillBudget``), every
+    appended chunk's bytes are reserved under ``tag`` before they are
+    retained and released by :meth:`release` (or per-bucket by
+    :meth:`release_bucket`) — host RAM is the spill tier's "disk", and
+    its growth is accounted, not silent."""
+
+    def __init__(self, nbuckets: int, budget=None, tag: str = "spill"):
         self.nbuckets = nbuckets
         #: bucket -> list of {col -> np.ndarray} row chunks
         self.chunks: list[list[dict]] = [[] for _ in range(nbuckets)]
         self.meta: dict[str, tuple] = {}  # col -> (dtype, dictionary)
+        self.budget = budget
+        self.tag = tag
+        self._bytes = 0
+
+    @staticmethod
+    def _chunk_bytes(rows: dict) -> int:
+        return sum(d.nbytes + v.nbytes for d, v in rows.values())
 
     def append(self, batch: Batch, bucket_ids: np.ndarray) -> None:
         live = np.asarray(batch.live)
@@ -48,7 +61,31 @@ class HostSpill:
             rows = {}
             for name, (data, valid) in host.items():
                 rows[name] = (data[sel], valid[sel])
+            nbytes = self._chunk_bytes(rows)
+            if self.budget is not None:
+                self.budget.reserve(self.tag, nbytes)
+            self._bytes += nbytes
             self.chunks[b].append(rows)
+
+    def total_bytes(self) -> int:
+        """Host bytes currently retained (== reserved under ``tag``)."""
+        return self._bytes
+
+    def release_bucket(self, b: int) -> int:
+        """Drop bucket ``b``'s chunks, returning its reservation."""
+        freed = sum(self._chunk_bytes(c) for c in self.chunks[b])
+        self.chunks[b] = []
+        if freed and self.budget is not None:
+            self.budget.release(self.tag, freed)
+        self._bytes -= freed
+        return freed
+
+    def release(self) -> int:
+        """Drop every chunk and return the whole reservation."""
+        freed = 0
+        for b in range(self.nbuckets):
+            freed += self.release_bucket(b)
+        return freed
 
     def bucket_rows(self, b: int) -> int:
         return sum(
@@ -105,9 +142,12 @@ def bucket_ids_for(batch: Batch, key: Expr, nbuckets: int) -> np.ndarray:
     return np.asarray(partition_ids([v.data], nbuckets))
 
 
-def spill_stream(stream, key: Expr, nbuckets: int) -> HostSpill:
-    """Drain a batch stream into a per-bucket host spill."""
-    spill = HostSpill(nbuckets)
+def spill_stream(stream, key: Expr, nbuckets: int,
+                 spill: HostSpill | None = None) -> HostSpill:
+    """Drain a batch stream into a per-bucket host spill (optionally a
+    pre-made — e.g. budget-accounted — store)."""
+    if spill is None:
+        spill = HostSpill(nbuckets)
     for batch in stream:
         spill.append(batch, bucket_ids_for(batch, key, nbuckets))
     return spill

@@ -4,22 +4,24 @@ One mixin so the two executors cannot drift: the lifecycle layer
 (``runtime/lifecycle.QueryManager._run_with_oom_ladder``) catches a
 runtime ``DeviceOutOfMemory``, calls :meth:`degrade_for_oom`, and
 re-runs the plan; the executors consult :attr:`oom_rung` at every
-grouped-execution decision. Rung semantics:
+out-of-core strategy point (``exec/spill.plan_spill``). Rung semantics:
 
-- rung 0: trust the stats estimates (the normal path);
-- rung 1: force grouped (bucketed) execution for joins/semi-joins —
-  and, on the distributed tier, grouped aggregation — even though the
-  estimate said the build fits, and drop plan-time proven-broadcast
-  shortcuts (the OOM just refuted the proof);
-- rung k>=2: multiply grouped bucket counts by 2^(k-1) (capped) and
-  divide probe-chunk rows by the same factor (floored — the local
-  tier's host-spill chunks; the distributed tier's per-bucket
-  capacities already derive from actual counts).
+- rung 0: trust the stats estimates (the normal path) — estimates over
+  the budget plan a HYBRID spill up front (K hottest build partitions
+  device-resident, cold ones streamed from host), so larger-than-HBM
+  is a plan choice, not an error path;
+- rung 1: the estimate lied (a runtime OOM refuted it) — re-plan into
+  hybrid with a SHRUNK resident set and doubled partition count (a
+  cheap re-bucket), and drop plan-time proven-broadcast shortcuts;
+- rung 2: shrink the resident share again (quartered), double buckets
+  again, and halve probe-chunk rows;
+- rung k>=3: fully-grouped — nothing resident, bucket counts scaled by
+  2^k (capped), probe chunks floored; the pre-spill-tier behavior.
 
-Local aggregations have no spill tier to re-plan onto (they already
-fold one morsel at a time into bounded device state), so for them a
-rung is a plain re-run — which only helps when the pressure was
-transient; the ladder cap keeps that bounded.
+Local aggregations whose estimate fits the budget have no spill state
+to re-plan onto (they already fold one morsel at a time into bounded
+device state), so for them a rung is a plain re-run — which only helps
+when the pressure was transient; the ladder cap keeps that bounded.
 """
 
 from __future__ import annotations
@@ -48,7 +50,7 @@ class OomLadderMixin:
 
     def _oom_factor(self) -> int:
         """Knob multiplier of the current rung (1 at rungs 0 and 1 —
-        rung 1 only forces grouped mode; 2^(k-1) from rung 2 on)."""
+        rung 1 only re-plans the spill mode; 2^(k-1) from rung 2 on)."""
         return 1 << (self.oom_rung - 1) if self.oom_rung > 1 else 1
 
     def _grouped_nbuckets(self, est_bytes: int) -> int:
@@ -62,3 +64,62 @@ class OomLadderMixin:
     def _oom_probe_chunk(self, probe_chunk: int) -> int:
         """Probe-chunk rows under the current rung (floored)."""
         return max(probe_chunk // self._oom_factor(), 1 << 10)
+
+    # ---- planned spill tier (exec/spill.py) ------------------------------
+    def _spill_decision(self, node, est_bytes: int):
+        """The plan-time out-of-core choice for one join build / agg
+        state: ``exec/spill.plan_spill`` over the byte estimate, the
+        build budget, the current ladder rung, and — when this plan's
+        fingerprint has recurred with measured exchange skew — the
+        skew-history hot partition as the resident-set seed."""
+        from presto_tpu.exec.spill import plan_spill
+
+        hot = None
+        hint = getattr(self, "plan_hints", None)
+        hint = hint.get(id(node)) if hint else None
+        if hint is not None and int(hint.get("hot_partition", -1)) >= 0:
+            hot = int(hint["hot_partition"])
+        return plan_spill(est_bytes, self.join_build_budget,
+                          hot_partition=hot, oom_rung=self.oom_rung)
+
+    def _note_spill(self, node, decision, resident=None,
+                    streamed: int = 0, host_bytes: int = 0) -> None:
+        """Record one executed spill decision end-to-end: ``spill.*``
+        counters/histograms, ``NodeStats.spill_*`` (-> EXPLAIN ANALYZE
+        + plan-stats history), and the ``spill_events`` summary list
+        the flight recorder captures."""
+        from presto_tpu.runtime.metrics import REGISTRY
+
+        # distributed semi-joins pass an adapter shim; unwrap so the
+        # recording attributes to the real plan node
+        node = getattr(node, "plan_node", node)
+        res = len(decision.resident if resident is None else resident)
+        REGISTRY.counter(f"spill.planned_{decision.mode}").add()
+        if res:
+            REGISTRY.counter("spill.partitions_resident").add(res)
+        if streamed:
+            REGISTRY.counter("spill.partitions_streamed").add(streamed)
+        if decision.nbuckets:
+            REGISTRY.histogram("spill.resident_fraction").add(
+                res / decision.nbuckets)
+        recorder = getattr(self, "recorder", None)
+        if recorder is not None:
+            try:
+                recorder.record_spill(node, decision.mode,
+                                      decision.nbuckets, res,
+                                      int(host_bytes))
+            except Exception:  # noqa: BLE001 — telemetry never raises
+                pass
+        events = getattr(self, "spill_events", None)
+        if events is not None:
+            events.append({
+                "node": type(node).__name__,
+                "mode": decision.mode,
+                "partitions": int(decision.nbuckets),
+                "resident": int(res),
+                "streamed": int(streamed),
+                "est_bytes": int(decision.est_bytes),
+                "budget_bytes": int(decision.budget),
+                "host_bytes": int(host_bytes),
+                "oom_rung": int(self.oom_rung),
+            })

@@ -96,19 +96,32 @@ class JoinFilterSlot:
         return self._declared_dev
 
 
-def _probe_capacity(lspill, nbuckets: int, probe_chunk: int) -> int:
+def _probe_capacity(lspill, nbuckets: int, probe_chunk: int,
+                    extra=()) -> int:
     """Compiled capacity of grouped-join probe chunks: bounded by the
     rows a chunk can actually carry — ``probe_chunk`` caps accumulation,
     the largest bucket caps the data, a single oversized spill chunk
     passes through whole. Without the data bound, a budget-derived
     ``probe_chunk`` (huge when grouped execution is FORCED by the OOM
     ladder rather than by a genuine spill) would compile probe steps at
-    millions of padded rows for kilobytes of input."""
+    millions of padded rows for kilobytes of input.
+
+    ``extra``: the streamed units' spill stores. Recursive splits
+    (``exec/spill.expand_units``) move oversized buckets into fresh
+    stores and RELEASE the parent bucket, so their chunks are invisible
+    to ``lspill`` — the shared capacity must cover them too."""
     max_bucket = max(
         (lspill.bucket_rows(b) for b in range(nbuckets)), default=0
     )
+    max_chunk = lspill.max_chunk_rows()
+    for sp in extra:
+        if sp is None or sp is lspill:
+            continue
+        max_bucket = max(max_bucket, max(
+            (sp.bucket_rows(b) for b in range(sp.nbuckets)), default=0))
+        max_chunk = max(max_chunk, sp.max_chunk_rows())
     return batch_capacity(
-        max(min(probe_chunk, max_bucket), lspill.max_chunk_rows(), 16),
+        max(min(probe_chunk, max_bucket), max_chunk, 16),
         minimum=16,
     )
 
@@ -174,7 +187,8 @@ class LocalExecutor(OomLadderMixin):
                  direct_group_limit: int = DIRECT_LIMIT,
                  runtime_join_filters: bool = True,
                  pallas_join_enabled: bool = True,
-                 approx_join: bool = False):
+                 approx_join: bool = False,
+                 spill_host_budget: int | None = None):
         self.catalog = catalog
         #: literal-slot values of the current query's plan template
         #: (plan/templates.py device scalars, set by the Session before
@@ -229,6 +243,21 @@ class LocalExecutor(OomLadderMixin):
         #: runtime/lifecycle.py bumps it via degrade_for_oom after a
         #: runtime DeviceOutOfMemory and re-runs the plan)
         self.oom_rung = 0
+        #: host-RAM byte budget for spilled partitions (the
+        #: ``spill_host_budget_bytes`` session property; None = the
+        #: process-wide budget shared by every executor)
+        self.spill_host_budget = spill_host_budget
+        self._host_budget = None
+        #: executed spill-decision summaries of the CURRENT run
+        #: (exec/ladder._note_spill; the flight recorder captures them)
+        self.spill_events: list = []
+        #: live HostSpill stores of the current run — released (and
+        #: their host-budget reservations returned) when run_batches
+        #: finishes, success or not. Release cannot happen per-bucket
+        #: inside the bucket generators: BatchStreams are REPLAYABLE
+        #: (a fragment retry re-drains), so the host partitions must
+        #: outlive the stream
+        self._spill_stores: list = []
 
     # ------------------------------------------------------------------
     def run(self, plan: N.PlanNode):
@@ -239,6 +268,10 @@ class LocalExecutor(OomLadderMixin):
             from presto_tpu.runtime.errors import InternalError
 
             raise InternalError("top-level plan must be an Output node")
+        # per-run summary (the OOM ladder re-enters run() on the same
+        # executor): flight records and rung history read the LAST
+        # run's spill decisions, not an accumulation across rungs
+        self.spill_events = []
         batches, names = self.run_batches(plan)
         if not batches:
             return pd.DataFrame(columns=names)
@@ -260,33 +293,70 @@ class LocalExecutor(OomLadderMixin):
         self.used_approx = False
         scalars: dict[str, Any] = {}
         child = plan.child
-        # the CONCRETE literal-slot values scope the whole run: eager
-        # evaluation sites read them directly; traced step bodies
-        # shadow them with their traced params argument (expr.py)
-        with param_scope(self.params):
-            batches = self._exec(child, scalars)
+        # host-spill lifetime = this drain: output batches are fully
+        # materialized below, so nothing downstream can still need the
+        # host partitions. Nested runs (scalar subqueries re-enter
+        # run_batches) release only THEIR stores — the mark snapshot
+        mark = len(self._spill_stores)
+        try:
+            # the CONCRETE literal-slot values scope the whole run:
+            # eager evaluation sites read them directly; traced step
+            # bodies shadow them with their traced params argument
+            with param_scope(self.params):
+                batches = self._exec(child, scalars)
 
-            # the sink drain is a fragment boundary too: in a
-            # streaming-only plan (no pipeline breaker) the lazy scan
-            # work happens HERE, so a retryable fault raised mid-drain
-            # must be retried here — the stream is replayable, a retry
-            # re-drains from the top
-            def drain():
-                out = []
-                for b in batches:
-                    ren = b.select(list(plan.sources)).rename(
-                        dict(zip(plan.sources, plan.names))
-                    )
-                    out.append(ren)
-                return out
+                # the sink drain is a fragment boundary too: in a
+                # streaming-only plan (no pipeline breaker) the lazy
+                # scan work happens HERE, so a retryable fault raised
+                # mid-drain must be retried here — the stream is
+                # replayable, a retry re-drains from the top
+                def drain():
+                    out = []
+                    for b in batches:
+                        ren = b.select(list(plan.sources)).rename(
+                            dict(zip(plan.sources, plan.names))
+                        )
+                        out.append(ren)
+                    return out
 
-            with trace_span("node:Output", "node",
-                            {"plan_node_id": self._nid(plan)}):
-                out = run_fragment("fragment:Output", drain)
+                with trace_span("node:Output", "node",
+                                {"plan_node_id": self._nid(plan)}):
+                    out = run_fragment("fragment:Output", drain)
+        finally:
+            for sp in self._spill_stores[mark:]:
+                sp.release()
+            del self._spill_stores[mark:]
         # every lazy scan has drained by here: one readback flushes
         # the runtime-join-filter pruning stats for the whole query
         self._flush_filter_stats()
         return out, list(plan.names)
+
+    def _host_spill_budget(self):
+        """This executor's host-spill byte budget: a private one when
+        the ``spill_host_budget_bytes`` property set it, else the
+        process-wide budget (runtime/memory.global_host_spill_budget)."""
+        if self._host_budget is None:
+            from presto_tpu.runtime.memory import (
+                HostSpillBudget,
+                global_host_spill_budget,
+            )
+
+            self._host_budget = (
+                HostSpillBudget(self.spill_host_budget, name="session-spill")
+                if self.spill_host_budget is not None
+                else global_host_spill_budget()
+            )
+        return self._host_budget
+
+    def _host_spill(self, nbuckets: int, tag: str = "spill"):
+        """A budget-accounted HostSpill registered for release at the
+        end of the current run_batches drain."""
+        from presto_tpu.exec.grouped import HostSpill
+
+        spill = HostSpill(nbuckets, budget=self._host_spill_budget(),
+                          tag=tag)
+        self._spill_stores.append(spill)
+        return spill
 
     # ------------------------------------------------------------------
     def _exec(self, node: N.PlanNode, scalars: dict) -> BatchStream:
@@ -454,6 +524,25 @@ class LocalExecutor(OomLadderMixin):
             REGISTRY.counter("agg.strategy.single").add()
             op = GlobalAggregationOperator(aggs, params=self.params)
             return BatchStream.of(Pipeline(child, [op]).run())
+        if keys:
+            # planned out-of-core aggregation: the estimated GROUP
+            # state above the budget partitions the input by key hash
+            # into host buckets and aggregates bucket-by-bucket (each
+            # group lives in exactly one bucket). Triggered by the
+            # ESTIMATE only — a ladder rung alone re-runs the normal
+            # path (a fitting aggregation has no spill state to
+            # re-plan onto; the pressure may have been transient)
+            from presto_tpu.runtime.memory import estimate_node_bytes
+
+            agg_est = estimate_node_bytes(node, self.catalog)
+            if agg_est > self.join_build_budget:
+                decision = self._spill_decision(node, agg_est)
+                hybrid = self._exec_hybrid_agg(node, child, keys, aggs,
+                                               pax, decision)
+                if hybrid is not None:
+                    REGISTRY.counter(
+                        f"agg.strategy.{decision.mode}").add()
+                    return hybrid
         strategy = self._pick_group_strategy(keys, pax, node, child)
         if isinstance(strategy, SortStrategy) and self._use_agg_bypass(node):
             # adaptive bypass (leaf_route.bypass_partial_agg): group
@@ -524,6 +613,128 @@ class LocalExecutor(OomLadderMixin):
             keys, pax, dict_len, estimate_rows(node.child, self.catalog),
             direct_limit=0 if force_sort else self.direct_group_limit,
         )
+
+    def _exec_hybrid_agg(self, node: N.Aggregate, child, keys, aggs, pax,
+                         decision):
+        """Out-of-core keyed aggregation: partition the input rows by
+        the hash of the FULL key tuple into host buckets (every group
+        lives in exactly one bucket, so per-bucket aggregations are
+        disjoint and concatenate exactly), aggregate the resident
+        buckets in one combined pass, then stream the cold units
+        through the two-slot transfer pipeline. Returns None when the
+        keys cannot be hash-partitioned (wide BYTES keys) — the caller
+        falls back to the normal single-state path."""
+        from presto_tpu.exec.grouped import bucket_batches
+        from presto_tpu.exec.spill import (
+            expand_units,
+            fit_resident,
+            transfer_iter,
+        )
+        from presto_tpu.expr import evaluate
+        from presto_tpu.ops.groupby import ValueBitsOverflow
+        from presto_tpu.runtime.memory import node_row_bytes
+        from presto_tpu.runtime.metrics import REGISTRY
+        from presto_tpu.runtime.trace import span as trace_span
+
+        if any(e.dtype.kind is TypeKind.BYTES for _, e in keys):
+            return None
+        key_exprs = [e for _, e in keys]
+
+        def bids(batch, modulus):
+            from presto_tpu.ops.hashing import partition_ids
+
+            cols = []
+            for e in key_exprs:
+                v = evaluate(e, batch)
+                if v.data.ndim != 1:
+                    raise NotImplementedError(
+                        "non-scalar aggregation key in hybrid spill")
+                # NULL keys mask to 0 so the group tuple hashes
+                # deterministically; the per-bucket SortStrategy still
+                # groups NULL apart from a genuine 0
+                cols.append(jnp.where(batch.live & v.valid,
+                                      v.data.astype(jnp.int64), 0))
+            return np.asarray(partition_ids(cols, modulus))
+
+        nbuckets = decision.nbuckets
+        aspill = self._host_spill(nbuckets, "agg")
+        for b in child:
+            aspill.append(b, bids(b, nbuckets))
+        row_bytes = max(node_row_bytes(node.child, self.catalog), 1)
+        resident, resident_bytes = fit_resident(
+            decision, aspill.bucket_rows, row_bytes)
+        cold = [b for b in range(nbuckets) if b not in set(resident)]
+        unit_budget = max(decision.budget - resident_bytes,
+                          decision.budget // 2, 1)
+        units = expand_units(
+            aspill, None, cold, unit_budget, row_bytes, build_ids=bids,
+            make_spill=lambda: self._host_spill(1, "agg-split"),
+        )
+        self._note_spill(node, decision, resident=resident,
+                         streamed=len(units),
+                         host_bytes=aspill.total_bytes())
+        chunk_rows = self._oom_probe_chunk(1 << 18)
+        chunk_cap = _probe_capacity(aspill, nbuckets, chunk_rows,
+                                    extra=[u.build for u in units])
+        state = {"aggs": list(aggs)}
+
+        def agg_pass(batches, rows):
+            """One bucket-pass aggregation with the usual overflow
+            retries; groups <= rows sizes the sort strategy, so a
+            genuine capacity overflow is bounded doubling, not a loop."""
+            strategy = SortStrategy(
+                min(batch_capacity(max(rows, 16)), MAX_GROUP_CAP))
+            src = BatchStream.of(list(batches))
+            for _ in range(MAX_RETRIES):
+                op = HashAggregationOperator(
+                    keys, state["aggs"], strategy, passengers=pax,
+                    params=self.params)
+                try:
+                    return Pipeline(src, [op]).run()
+                except ValueBitsOverflow:
+                    state["aggs"] = [
+                        dataclasses.replace(a, value_bits=63)
+                        for a in state["aggs"]
+                    ]
+                except CapacityOverflow as e:
+                    if e.op != "HashAggregation":
+                        raise
+                    strategy = SortStrategy(strategy.max_groups * 2)
+            raise CapacityOverflow("Aggregate", strategy.max_groups)
+
+        def load_unit(u):
+            out = list(bucket_batches(u.build, u.bucket, chunk_rows,
+                                      chunk_cap))
+            rows = u.build.bucket_rows(u.bucket)
+            if rows:
+                REGISTRY.counter("spill.transfer_bytes").add(
+                    rows * row_bytes)
+            return out
+
+        def make():
+            from presto_tpu.runtime.faults import fault_point
+
+            fault_point("step.agg")
+            res_rows = sum(aspill.bucket_rows(b) for b in resident)
+            if res_rows:
+                res_chunks = [
+                    pb for b in resident
+                    for pb in bucket_batches(aspill, b, chunk_rows,
+                                             chunk_cap)
+                ]
+                yield from agg_pass(res_chunks, res_rows)
+            for u, batches in transfer_iter(load_unit, units,
+                                            label="spill:transfer"):
+                unit_out = []
+                with trace_span("spill:unit", "step",
+                                {"residue": u.residue,
+                                 "modulus": u.modulus}):
+                    rows = u.build.bucket_rows(u.bucket)
+                    if rows:
+                        unit_out = agg_pass(batches, rows)
+                yield from unit_out
+
+        return BatchStream(make)
 
     # ---- joins -----------------------------------------------------------
     def _join_key_exprs(
@@ -784,7 +995,8 @@ class LocalExecutor(OomLadderMixin):
         # subqueries (q51/q97 shapes), and the grouped tier has no
         # unmatched-build tail yet
         spill = est > self.join_build_budget
-        if (spill or self.oom_rung > 0) and node.kind != "full":
+        decision = self._spill_decision(node, est)
+        if decision.mode != "resident" and node.kind != "full":
             lkey, rkey, verify = self._join_key_exprs(
                 node.left_keys, node.right_keys, left, right_stream, scalars,
                 node.left, node.right,
@@ -796,12 +1008,13 @@ class LocalExecutor(OomLadderMixin):
             if not verify:
                 from presto_tpu.runtime.metrics import REGISTRY
 
-                REGISTRY.counter("join.strategy.grouped").add()
+                REGISTRY.counter(f"join.strategy.{decision.mode}").add()
                 return self._exec_grouped_join(
-                    node, left, right_stream, lkey, rkey, est
+                    node, left, right_stream, lkey, rkey, decision
                 )
-            # ladder-forced grouped execution cannot handle wide string
-            # keys; the estimate said the build fits, so stay in-memory
+            # ladder-forced out-of-core execution cannot handle wide
+            # string keys; the estimate said the build fits, so stay
+            # in-memory
         # the build side is inherently materialized (the lookup source
         # concatenates it); the PROBE side streams batch-by-batch
         right = right_stream.materialize()
@@ -938,38 +1151,87 @@ class LocalExecutor(OomLadderMixin):
             cols[f.name] = _null_column(f.dtype, 1, tail)
         return Batch(cols, jnp.zeros(1, dtype=bool))
 
+    def _spill_both_sides(self, node, left, right_stream, lkey, rkey,
+                          decision, build_row_bytes: int, tag: str):
+        """Shared out-of-core partitioning for joins and semi joins:
+        hash-spill BOTH sides to budget-accounted host stores, clamp
+        the planned resident set against actual partition sizes, and
+        expand the cold buckets into streamed units (recursively split
+        while oversized). Returns ``(rspill, lspill, resident, units)``
+        and records the executed decision."""
+        from presto_tpu.exec.grouped import bucket_ids_for, spill_stream
+        from presto_tpu.exec.spill import expand_units, fit_resident
+
+        nbuckets = decision.nbuckets
+        rspill = spill_stream(right_stream, rkey, nbuckets,
+                              spill=self._host_spill(nbuckets, f"{tag}-build"))
+        lspill = spill_stream(left, lkey, nbuckets,
+                              spill=self._host_spill(nbuckets, f"{tag}-probe"))
+        resident, resident_bytes = fit_resident(
+            decision, rspill.bucket_rows, build_row_bytes)
+        res_set = set(resident)
+        cold = [b for b in range(nbuckets) if b not in res_set]
+        # a streamed unit's build must fit beside the resident set (and
+        # the in-flight transfer slots); never below half the budget so
+        # recursion depth stays bounded by data skew, not arithmetic
+        unit_budget = max(decision.budget - resident_bytes,
+                          decision.budget // 2, 1)
+        units = expand_units(
+            rspill, lspill, cold, unit_budget, build_row_bytes,
+            build_ids=lambda b, m: bucket_ids_for(b, rkey, m),
+            probe_ids=lambda b, m: bucket_ids_for(b, lkey, m),
+            make_spill=lambda: self._host_spill(1, f"{tag}-split"),
+        )
+        self._note_spill(
+            node, decision, resident=resident, streamed=len(units),
+            host_bytes=rspill.total_bytes() + lspill.total_bytes(),
+        )
+        return rspill, lspill, resident, units
+
     def _exec_grouped_join(self, node: N.Join, left, right_stream, lkey, rkey,
-                           est_bytes: int):
-        """Grouped (bucketed) join: both sides hash-spill to host RAM,
-        then each bucket runs the normal device join — HBM bounded by
-        one bucket's build plus one probe chunk (SURVEY §7.4 #5).
+                           decision):
+        """Out-of-core (hybrid/grouped) join: both sides hash-spill to
+        host RAM; the K hottest build partitions stay device-resident
+        as ONE combined build (key-equal rows always share a bucket, so
+        merging disjoint buckets cannot create false matches) probed
+        first, and the cold partitions stream host->device through the
+        two-slot transfer pipeline (exec/spill.transfer_iter), each
+        running the normal device join — HBM bounded by the resident
+        set plus one streamed unit's build and probe chunk.
 
-        Compile economy: every bucket's build pads to ONE shared
-        capacity and every probe chunk to one shared capacity, and the
-        lookup operators (whose jitted steps take the build state as an
-        argument) are reused across buckets by swapping the shared
-        JoinBuildOperator's published state — O(distinct capacities)
-        XLA programs, not O(buckets x chunks).
+        Compile economy: every build (combined resident AND streamed
+        unit) pads to ONE shared capacity and every probe chunk to one
+        shared capacity, and the lookup operators (whose jitted steps
+        take the build state as an argument) are reused across passes
+        by swapping the shared JoinBuildOperator's published state —
+        O(distinct capacities) XLA programs, not O(buckets x chunks).
         """
-        from presto_tpu.exec.grouped import bucket_batches, spill_stream
+        from presto_tpu.exec.grouped import bucket_batches
+        from presto_tpu.exec.spill import transfer_iter
         from presto_tpu.runtime.memory import node_row_bytes
+        from presto_tpu.runtime.metrics import REGISTRY
+        from presto_tpu.runtime.trace import span as trace_span
 
-        nbuckets = self._grouped_nbuckets(est_bytes)
+        row_bytes_r = max(node_row_bytes(node.right, self.catalog), 1)
         # probe chunks sized so a chunk stays well under the budget
         probe_chunk = self._oom_probe_chunk(max(
             1 << 14,
             self.join_build_budget
             // max(node_row_bytes(node.left, self.catalog), 1) // 4,
         ))
-        rspill = spill_stream(right_stream, rkey, nbuckets)
-        lspill = spill_stream(left, lkey, nbuckets)
+        rspill, lspill, resident, units = self._spill_both_sides(
+            node, left, right_stream, lkey, rkey, decision, row_bytes_r,
+            "join")
+        nbuckets = decision.nbuckets
         outs = [BuildOutput(n, n) for n in node.output_right]
         rfields = {f.name: f for f in node.right.fields}
+        resident_rows = sum(rspill.bucket_rows(b) for b in resident)
+        unit_build_rows = max(
+            (u.build.bucket_rows(u.bucket) for u in units), default=0)
         build_cap = batch_capacity(
-            max(max((rspill.bucket_rows(b) for b in range(nbuckets)), default=0), 16),
-            minimum=16,
-        )
-        probe_cap = _probe_capacity(lspill, nbuckets, probe_chunk)
+            max(resident_rows, unit_build_rows, 16), minimum=16)
+        probe_cap = _probe_capacity(lspill, nbuckets, probe_chunk,
+                                    extra=[u.probe for u in units])
         build = JoinBuildOperator(rkey, capacity=build_cap, params=self.params)
         probe_ops: dict[tuple, LookupJoinOperator] = {}
 
@@ -993,34 +1255,72 @@ class LocalExecutor(OomLadderMixin):
 
         state = {"cap": batch_capacity(max(build_cap, probe_cap, 1024))}
 
+        def probe_all(probe_chunks):
+            for pb in probe_chunks:
+                if node.unique:
+                    yield probe_op(None).process(pb)[0]
+                    continue
+                for _ in range(MAX_RETRIES):
+                    try:
+                        out = probe_op(state["cap"]).process(pb)[0]
+                        break
+                    except CapacityOverflow:
+                        state["cap"] *= 2
+                else:
+                    raise CapacityOverflow("GroupedJoin", state["cap"])
+                yield out
+
+        def load_unit(u):
+            b = u.build.bucket_batch(u.bucket, capacity=build_cap)
+            if b is not None:
+                REGISTRY.counter("spill.transfer_bytes").add(
+                    u.build.bucket_rows(u.bucket) * row_bytes_r)
+            return b
+
         def make():
             from presto_tpu.runtime.faults import fault_point
 
             fault_point("step.grouped_join")
-            for bk in range(nbuckets):
-                build_batch = rspill.bucket_batch(bk, capacity=build_cap)
-                probe_chunks = bucket_batches(lspill, bk, probe_chunk, probe_cap)
-                if build_batch is None:
-                    if node.kind == "left":
-                        for pb in probe_chunks:
-                            yield null_build_cols(pb)
-                    continue
-                build.batches = [build_batch]
+            # pass 1: the device-resident partitions, as ONE combined
+            # build — resident probes never wait on a transfer
+            res_batches = [
+                bb for b in resident
+                if (bb := rspill.bucket_batch(b, capacity=build_cap))
+                is not None
+            ]
+            res_probes = (pb for b in resident for pb in bucket_batches(
+                lspill, b, probe_chunk, probe_cap))
+            if res_batches:
+                build.batches = res_batches
                 build.build_side = None
                 build.finish()
-                for pb in probe_chunks:
-                    if node.unique:
-                        yield probe_op(None).process(pb)[0]
-                        continue
-                    for _ in range(MAX_RETRIES):
-                        try:
-                            out = probe_op(state["cap"]).process(pb)[0]
-                            break
-                        except CapacityOverflow:
-                            state["cap"] *= 2
+                yield from probe_all(res_probes)
+            elif node.kind == "left":
+                for pb in res_probes:
+                    yield null_build_cols(pb)
+            # pass 2: cold units stream through the two-slot pipeline.
+            # One unit's outputs materialize INSIDE its compute span
+            # (a unit fits the budget by construction), so the span
+            # closes before the yield — suspending mid-span would nest
+            # the consumer's spans under ours
+            for u, build_batch in transfer_iter(load_unit, units,
+                                                label="spill:transfer"):
+                unit_out = []
+                with trace_span("spill:unit", "step",
+                                {"residue": u.residue,
+                                 "modulus": u.modulus}):
+                    probe_chunks = bucket_batches(
+                        u.probe, u.bucket, probe_chunk, probe_cap)
+                    if build_batch is None:
+                        if node.kind == "left":
+                            unit_out = [null_build_cols(pb)
+                                        for pb in probe_chunks]
                     else:
-                        raise CapacityOverflow("GroupedJoin", state["cap"])
-                    yield out
+                        build.batches = [build_batch]
+                        build.build_side = None
+                        build.finish()
+                        unit_out = list(probe_all(probe_chunks))
+                yield from unit_out
 
         return BatchStream(make)
 
@@ -1032,7 +1332,8 @@ class LocalExecutor(OomLadderMixin):
         from presto_tpu.runtime.memory import estimate_node_bytes
 
         est = estimate_node_bytes(node.right, self.catalog)
-        if est > self.join_build_budget or self.oom_rung > 0:
+        decision = self._spill_decision(node, est)
+        if decision.mode != "resident":
             # grouped semi/anti: a probe key's existence is decided
             # entirely by its own hash bucket, so bucketing is exact
             # for both semi AND anti (an absent bucket means globally
@@ -1045,8 +1346,9 @@ class LocalExecutor(OomLadderMixin):
                 raise NotImplementedError("wide string semi-join keys")
             from presto_tpu.runtime.metrics import REGISTRY
 
-            REGISTRY.counter("join.strategy.grouped").add()
-            return self._exec_grouped_semijoin(left, right_stream, lkey, rkey, est, jt)
+            REGISTRY.counter(f"join.strategy.{decision.mode}").add()
+            return self._exec_grouped_semijoin(
+                node, left, right_stream, lkey, rkey, decision, jt)
         right = right_stream.materialize()
         from presto_tpu.runtime.faults import fault_point
 
@@ -1082,38 +1384,81 @@ class LocalExecutor(OomLadderMixin):
         op = LookupJoinOperator(build, lkey, (), jt, params=self.params)
         return left.map(lambda b: op.process(b)[0])
 
-    def _exec_grouped_semijoin(self, left, right_stream, lkey, rkey,
-                               est_bytes: int, jt: str):
-        from presto_tpu.exec.grouped import bucket_batches, spill_stream
+    def _exec_grouped_semijoin(self, node: N.SemiJoin, left, right_stream,
+                               lkey, rkey, decision, jt: str):
+        """Out-of-core semi/anti join, same shape as the grouped join:
+        combined resident pass first (existence is decided inside one
+        key's bucket, so merging disjoint resident buckets is exact),
+        then cold units through the two-slot transfer pipeline. An
+        absent build unit passes every anti probe row and drops every
+        semi row — globally correct because the probe rows routed there
+        can only match build rows routed there."""
+        from presto_tpu.exec.grouped import bucket_batches
+        from presto_tpu.exec.spill import transfer_iter
+        from presto_tpu.runtime.memory import node_row_bytes
+        from presto_tpu.runtime.metrics import REGISTRY
+        from presto_tpu.runtime.trace import span as trace_span
 
-        nbuckets = self._grouped_nbuckets(est_bytes)
+        row_bytes_r = max(node_row_bytes(node.right, self.catalog), 1)
         probe_chunk = self._oom_probe_chunk(1 << 18)
-        rspill = spill_stream(right_stream, rkey, nbuckets)
-        lspill = spill_stream(left, lkey, nbuckets)
+        rspill, lspill, resident, units = self._spill_both_sides(
+            node, left, right_stream, lkey, rkey, decision, row_bytes_r,
+            "semi")
+        nbuckets = decision.nbuckets
+        resident_rows = sum(rspill.bucket_rows(b) for b in resident)
+        unit_build_rows = max(
+            (u.build.bucket_rows(u.bucket) for u in units), default=0)
         build_cap = batch_capacity(
-            max(max((rspill.bucket_rows(b) for b in range(nbuckets)), default=0), 16),
-            minimum=16,
-        )
-        probe_cap = _probe_capacity(lspill, nbuckets, probe_chunk)
+            max(resident_rows, unit_build_rows, 16), minimum=16)
+        probe_cap = _probe_capacity(lspill, nbuckets, probe_chunk,
+                                    extra=[u.probe for u in units])
         build = JoinBuildOperator(rkey, capacity=build_cap, params=self.params)
         op = LookupJoinOperator(build, lkey, (), jt, params=self.params)
+
+        def load_unit(u):
+            b = u.build.bucket_batch(u.bucket, capacity=build_cap)
+            if b is not None:
+                REGISTRY.counter("spill.transfer_bytes").add(
+                    u.build.bucket_rows(u.bucket) * row_bytes_r)
+            return b
 
         def make():
             from presto_tpu.runtime.faults import fault_point
 
             fault_point("step.grouped_join")
-            for bk in range(nbuckets):
-                build_batch = rspill.bucket_batch(bk, capacity=build_cap)
-                probe_chunks = bucket_batches(lspill, bk, probe_chunk, probe_cap)
-                if build_batch is None:
-                    if jt == "anti":  # nothing to exclude: all pass
-                        yield from probe_chunks
-                    continue
-                build.batches = [build_batch]
+            res_batches = [
+                bb for b in resident
+                if (bb := rspill.bucket_batch(b, capacity=build_cap))
+                is not None
+            ]
+            res_probes = (pb for b in resident for pb in bucket_batches(
+                lspill, b, probe_chunk, probe_cap))
+            if res_batches:
+                build.batches = res_batches
                 build.build_side = None
                 build.finish()
-                for pb in probe_chunks:
+                for pb in res_probes:
                     yield op.process(pb)[0]
+            elif jt == "anti":  # nothing to exclude: all pass
+                yield from res_probes
+            for u, build_batch in transfer_iter(load_unit, units,
+                                                label="spill:transfer"):
+                unit_out = []
+                with trace_span("spill:unit", "step",
+                                {"residue": u.residue,
+                                 "modulus": u.modulus}):
+                    probe_chunks = bucket_batches(
+                        u.probe, u.bucket, probe_chunk, probe_cap)
+                    if build_batch is None:
+                        if jt == "anti":
+                            unit_out = list(probe_chunks)
+                    else:
+                        build.batches = [build_batch]
+                        build.build_side = None
+                        build.finish()
+                        unit_out = [op.process(pb)[0]
+                                    for pb in probe_chunks]
+                yield from unit_out
 
         return BatchStream(make)
 

@@ -160,9 +160,14 @@ def planned_join_strategy(node, catalog,
     if join_build_budget is None:
         join_build_budget = device_budget_bytes() // 4
     semi = isinstance(node, N.SemiJoin)
-    if estimate_node_bytes(node.right, catalog, memo) > join_build_budget \
-            and (semi or node.kind != "full"):
-        return "grouped"
+    est = estimate_node_bytes(node.right, catalog, memo)
+    if est > join_build_budget and (semi or node.kind != "full"):
+        # the planned out-of-core mode (exec/spill.plan_spill):
+        # "hybrid" keeps the K hottest build partitions resident,
+        # "grouped" streams every bucket — what the executors execute
+        from presto_tpu.exec.spill import plan_spill
+
+        return plan_spill(est, join_build_budget).mode
     iv = None
     if len(node.right_keys) == 1:
         iv = expr_interval(node.right_keys[0],

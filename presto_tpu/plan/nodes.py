@@ -321,7 +321,8 @@ def scan_physical_types(node: "TableScan", catalog) -> dict:
 
 def plan_tree_str(node: PlanNode, indent: int = 0, catalog=None,
                   _filters=None, approx_join: bool = False,
-                  plan_hints=None, agg_bypass: bool = True) -> str:
+                  plan_hints=None, agg_bypass: bool = True,
+                  join_build_budget=None) -> str:
     """EXPLAIN-style rendering (reference: PlanPrinter). With a
     ``catalog``, scan columns render their chosen PHYSICAL storage
     (``l_shipdate:date:int16``), joins render the stats-planned probe
@@ -370,12 +371,12 @@ def plan_tree_str(node: PlanNode, indent: int = 0, catalog=None,
                 detail += f" agg_strategy={s}"
     elif isinstance(node, (Join,)):
         detail = f" {node.kind}{' unique' if node.unique else ''}"
-        detail += _strategy_str(node, catalog, approx_join)
+        detail += _strategy_str(node, catalog, approx_join, join_build_budget)
     elif isinstance(node, Window):
         detail = f" funcs={[f.name for f in node.funcs]} frame={node.frame}"
     elif isinstance(node, SemiJoin):
         detail = f"{' anti' if node.negated else ''}"
-        detail += _strategy_str(node, catalog, approx_join)
+        detail += _strategy_str(node, catalog, approx_join, join_build_budget)
     elif isinstance(node, (TopN,)):
         detail = f" n={node.count}"
     elif isinstance(node, Limit):
@@ -388,17 +389,39 @@ def plan_tree_str(node: PlanNode, indent: int = 0, catalog=None,
     for c in node.children:
         out += plan_tree_str(c, indent + 1, catalog=catalog,
                              _filters=_filters or {}, approx_join=approx_join,
-                             plan_hints=plan_hints, agg_bypass=agg_bypass)
+                             plan_hints=plan_hints, agg_bypass=agg_bypass,
+                             join_build_budget=join_build_budget)
     return out
 
 
-def _strategy_str(node, catalog, approx_join: bool = False) -> str:
+def _strategy_str(node, catalog, approx_join: bool = False,
+                  join_build_budget=None) -> str:
     if catalog is None:
         return ""
     from presto_tpu.plan.joinfilters import planned_join_strategy
 
     try:
-        return (" strategy="
-                f"{planned_join_strategy(node, catalog, approx_join=approx_join)}")
+        s = planned_join_strategy(node, catalog,
+                                  join_build_budget=join_build_budget,
+                                  approx_join=approx_join)
     except Exception:  # noqa: BLE001 — EXPLAIN must render partial plans
         return ""
+    out = f" strategy={s}"
+    if s in ("hybrid", "grouped"):
+        # the planned out-of-core shape, visible BEFORE execution:
+        # spill=hybrid(2/8 resident) | spill=grouped(16 buckets)
+        try:
+            from presto_tpu.exec.spill import plan_spill
+            from presto_tpu.runtime.memory import (
+                device_budget_bytes,
+                estimate_node_bytes,
+            )
+
+            budget = (device_budget_bytes() // 4
+                      if join_build_budget is None else join_build_budget)
+            decision = plan_spill(
+                estimate_node_bytes(node.right, catalog), budget)
+            out += f" spill={decision.explain()}"
+        except Exception:  # noqa: BLE001
+            pass
+    return out

@@ -77,6 +77,30 @@ class DeviceOutOfMemory(ResourceExhausted):
     retryable = False
 
 
+class SpillBudgetExceeded(ResourceExhausted):
+    """The HOST-side spill store (``exec/grouped.HostSpill``) would
+    grow past ``spill_host_budget_bytes``: the out-of-core tier's
+    "disk" is host RAM, and silent growth there is the same bug as a
+    device OOM one level up. Not retryable and NOT ladder-eligible —
+    more buckets do not shrink the total spilled bytes; the fix is a
+    bigger host budget or a smaller query."""
+
+    error_code = "SPILL_BUDGET_EXCEEDED"
+    retryable = False
+
+
+class SpillPartitionOverflow(ResourceExhausted):
+    """A cold spill partition still exceeds the per-unit byte budget
+    after ``MAX_SPILL_RECURSION`` recursive re-partitionings
+    (exec/spill.py): the rows share one hash residue at every doubled
+    modulus — in practice one key's duplicate run — so further
+    splitting cannot help. Loud and typed instead of a silent device
+    blowup mid-stream."""
+
+    error_code = "SPILL_PARTITION_OVERFLOW"
+    retryable = False
+
+
 class ExceededTimeLimit(PrestoError, RuntimeError):
     """The per-query wall-clock deadline (``query_max_run_time``)
     expired. Not retryable within the query — a retry starts from zero

@@ -42,6 +42,8 @@ SITES = (
     "step.join_build",  # in-memory join build materialization/dispatch
     "step.grouped_join",  # grouped (bucketed) join bucket passes
     "step.agg",  # grouped-aggregation jitted-step dispatch
+    "step.spill_transfer",  # host->device cold-partition transfer submits
+    "step.spill_partition",  # recursive re-partition of an oversized bucket
 )
 
 

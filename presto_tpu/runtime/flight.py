@@ -111,6 +111,10 @@ class FlightRecord:
     #: exchange-skew summaries + hot partition ids of the LAST run
     exchange_skew: list = field(default_factory=list)
     hot_partitions: list = field(default_factory=list)
+    #: executed out-of-core spill decisions of the LAST run (mode,
+    #: partitions, resident/streamed counts, host bytes — ladder.py's
+    #: ``_note_spill`` summaries)
+    spill: list = field(default_factory=list)
     #: memory pool state at terminal time (reservation released —
     #: recording a post-mortem never holds pool capacity)
     pool: dict = field(default_factory=dict)
@@ -139,6 +143,7 @@ class FlightRecord:
                 {k: self.metrics[k] for k in sorted(self.metrics)}),
             "exchangeSkew": _json_safe(self.exchange_skew),
             "hotPartitions": _json_safe(self.hot_partitions),
+            "spill": _json_safe(self.spill),
             "pool": _json_safe(self.pool),
         }
 
@@ -196,6 +201,8 @@ class FlightRecorder:
                 approx_join=bool(session.prop("approx_join")),
                 plan_hints=getattr(executor, "plan_hints", None) or None,
                 agg_bypass=bool(getattr(executor, "agg_bypass", True)),
+                join_build_budget=getattr(executor, "join_build_budget",
+                                          None),
             )
         except Exception:  # noqa: BLE001 — a render bug must not eat
             render = "<plan render failed>"  # the rest of the record
@@ -234,6 +241,7 @@ class FlightRecorder:
                 getattr(executor, "exchange_skew", ()) or ()),
             hot_partitions=list(
                 getattr(executor, "hot_partitions", ()) or ()),
+            spill=list(getattr(executor, "spill_events", ()) or ()),
             pool=pool,
         )
         with self._lock:

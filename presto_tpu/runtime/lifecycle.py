@@ -562,6 +562,7 @@ class QueryManager:
                 # exactness is never silently degraded (ISSUE-7)
                 info.approximate = bool(
                     getattr(executor, "used_approx", False))
+                self._note_planned_spills(executor, info)
                 return result
             except DeviceOutOfMemory as e:
                 degrade = getattr(executor, "degrade_for_oom", None)
@@ -575,7 +576,8 @@ class QueryManager:
                 # rung ordinals are QUERY-level (they keep counting
                 # across a distributed->local degradation)
                 info.rung_history.append(
-                    {"rung": info.oom_retries, "error": str(e)[:200]})
+                    {"kind": "ladder", "rung": info.oom_retries,
+                     "error": str(e)[:200]})
                 REGISTRY.counter("query.oom_degraded").add()
                 self.session.events.query_degraded(info)
                 if recorder is not None:
@@ -597,6 +599,19 @@ class QueryManager:
                                          getattr(executor, "params", ()))
                 raise
 
+    @staticmethod
+    def _note_planned_spills(executor, info) -> None:
+        """Append the run's PLANNED out-of-core decisions to the rung
+        history with ``kind: "planned_hybrid"`` / ``"planned_grouped"``
+        — distinguishable from ``kind: "ladder"`` entries, so the
+        post-mortem separates 'the plan chose out-of-core up front'
+        from 'a runtime OOM forced a re-plan'. Ladder rung counting
+        (``oom_retries``) never includes these."""
+        for ev in getattr(executor, "spill_events", ()) or ():
+            if ev.get("mode") in ("hybrid", "grouped"):
+                info.rung_history.append(
+                    {"kind": f"planned_{ev['mode']}", **ev})
+
     def _degrade(self, plan, info, recorder, ctx, params=()):
         """Re-plan a failed distributed query onto the single-device
         local pipeline (graceful degradation; the deadline keeps
@@ -617,6 +632,7 @@ class QueryManager:
             runtime_join_filters=self.session.prop("runtime_join_filters"),
             pallas_join_enabled=self.session.prop("pallas_join"),
             approx_join=self.session.prop("approx_join"),
+            spill_host_budget=self.session.prop("spill_host_budget_bytes"),
         )
         if recorder is not None:
             # stats from the failed distributed attempt must not leak

@@ -276,8 +276,98 @@ class MemoryPool:
         return freed
 
 
+#: default host-side spill capacity as a multiple of the device budget
+#: (host RAM plays the spill-disk role; the ratio mirrors a typical
+#: host:HBM memory ratio, overridable per session via the
+#: ``spill_host_budget_bytes`` property)
+DEFAULT_HOST_SPILL_FACTOR = 16
+
+
+class HostSpillBudget:
+    """Byte budget over HOST-side spill state (exec/grouped.HostSpill).
+
+    The out-of-core tier's "disk" is host RAM, which before this class
+    grew invisibly: every spilled partition chunk now reserves its
+    bytes here under a per-store TAG (the tenant-tag discipline of
+    :class:`MemoryPool`), and overflow raises the typed
+    ``SpillBudgetExceeded`` instead of silently eating the host.
+    Reservations are additive per tag; ``release`` clamps and is
+    idempotent (success and fault paths both release in ``finally``)."""
+
+    def __init__(self, capacity_bytes: int, name: str = "host-spill"):
+        self.capacity_bytes = int(capacity_bytes)
+        self.name = name
+        self._lock = threading.Lock()
+        self._tags: dict[str, int] = {}
+        self.peak_bytes = 0
+
+    @property
+    def reserved_bytes(self) -> int:
+        with self._lock:
+            return sum(self._tags.values())
+
+    def snapshot(self) -> "dict":
+        with self._lock:
+            reserved = sum(self._tags.values())
+            return {
+                "capacity_bytes": self.capacity_bytes,
+                "reserved_bytes": reserved,
+                "free_bytes": self.capacity_bytes - reserved,
+                "tags": dict(self._tags),
+                "peak_bytes": self.peak_bytes,
+            }
+
+    def reserve(self, tag: str, nbytes: int) -> None:
+        """Add ``nbytes`` to ``tag``'s reservation, or fail typed and
+        loud when the total would exceed capacity."""
+        from presto_tpu.runtime.errors import SpillBudgetExceeded
+
+        nbytes = max(0, int(nbytes))
+        with self._lock:
+            total = sum(self._tags.values()) + nbytes
+            if total > self.capacity_bytes:
+                REGISTRY.counter("spill.host_rejected").add()
+                raise SpillBudgetExceeded(
+                    f"host spill budget {self.name!r}: reserving {nbytes} "
+                    f"more bytes for {tag!r} would hold {total} of "
+                    f"{self.capacity_bytes} capacity (raise the "
+                    "spill_host_budget_bytes session property)"
+                )
+            self._tags[tag] = self._tags.get(tag, 0) + nbytes
+            self.peak_bytes = max(self.peak_bytes, total)
+
+    def release(self, tag: str, nbytes: int | None = None) -> int:
+        """Drop ``nbytes`` of ``tag``'s reservation (all of it when
+        None). Clamped and idempotent; returns the bytes freed."""
+        with self._lock:
+            held = self._tags.get(tag, 0)
+            freed = held if nbytes is None else min(held, max(0, int(nbytes)))
+            left = held - freed
+            if left > 0:
+                self._tags[tag] = left
+            else:
+                self._tags.pop(tag, None)
+            return freed
+
+
+_GLOBAL_HOST_SPILL: HostSpillBudget | None = None
+
 _GLOBAL_POOL: MemoryPool | None = None
 _GLOBAL_POOL_LOCK = threading.Lock()
+
+
+def global_host_spill_budget() -> HostSpillBudget:
+    """The process-wide default host-spill budget (sessions without a
+    ``spill_host_budget_bytes`` override account against it). Sized
+    lazily so the device-budget snapshot rule holds."""
+    global _GLOBAL_HOST_SPILL
+    with _GLOBAL_POOL_LOCK:
+        if _GLOBAL_HOST_SPILL is None:
+            _GLOBAL_HOST_SPILL = HostSpillBudget(
+                device_budget_bytes() * DEFAULT_HOST_SPILL_FACTOR,
+                name="global-host-spill",
+            )
+        return _GLOBAL_HOST_SPILL
 
 
 def global_pool() -> MemoryPool:

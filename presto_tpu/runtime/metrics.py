@@ -155,6 +155,7 @@ SKEW_BOUNDS = (1.0, 1.1, 1.25, 1.5, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0,
 HISTOGRAM_BOUNDS: dict[str, tuple] = {
     "join.filter_selectivity": SELECTIVITY_BOUNDS,
     "exchange.skew": SKEW_BOUNDS,
+    "spill.resident_fraction": SELECTIVITY_BOUNDS,
 }
 
 
@@ -336,6 +337,26 @@ METRIC_HELP: dict[str, str] = {
     "flight_recorder_depth": (
         "post-mortem records currently retained in the session's "
         "flight-recorder ring"),
+    "spill.planned_hybrid": (
+        "joins/aggregations planned as hybrid spill (hot partitions "
+        "device-resident, cold ones streamed from host)"),
+    "spill.planned_grouped": (
+        "joins/aggregations planned as fully-grouped spill (no "
+        "resident partitions)"),
+    "spill.partitions_resident": (
+        "build partitions kept device-resident by hybrid spill plans"),
+    "spill.partitions_streamed": (
+        "build partitions streamed host->device by spill plans"),
+    "spill.resident_fraction": (
+        "resident/total partition fraction of each hybrid spill plan"),
+    "spill.partition_overflow": (
+        "cold spill partitions recursively re-partitioned because "
+        "they exceeded the per-unit byte budget"),
+    "spill.transfer_bytes": (
+        "host->device bytes moved by the spill transfer pipeline"),
+    "spill.host_rejected": (
+        "host-spill reservations refused by spill_host_budget_bytes "
+        "(typed SPILL_BUDGET_EXCEEDED failures)"),
 }
 
 

@@ -103,6 +103,16 @@ SESSION_PROPERTIES: dict[str, PropertyDef] = {
             _positive,
         ),
         PropertyDef(
+            "spill_host_budget_bytes", int, None,
+            "Host-RAM byte budget for spilled partitions "
+            "(exec/grouped.HostSpill): grouped/hybrid execution reserves "
+            "its host-side partition bytes against this budget and fails "
+            "loud (SPILL_BUDGET_EXCEEDED) instead of growing host memory "
+            "silently. Default: the process-wide host-spill budget "
+            "(device HBM x 16).",
+            _positive,
+        ),
+        PropertyDef(
             "direct_group_limit", int, DIRECT_LIMIT,
             "Grouped aggregation uses dense direct addressing when the "
             "product of the key dictionary domains is at most this; "

@@ -276,6 +276,7 @@ class Session:
                 runtime_join_filters=self.prop("runtime_join_filters"),
                 pallas_join_enabled=self.prop("pallas_join"),
                 approx_join=self.prop("approx_join"),
+                spill_host_budget=self.prop("spill_host_budget_bytes"),
             )
         from presto_tpu.exec.distributed import DistributedExecutor
 
@@ -286,6 +287,7 @@ class Session:
             gather_limit=self.prop("gather_row_limit"),
             direct_group_limit=self.prop("direct_group_limit"),
             join_build_budget=self.prop("join_build_budget_bytes"),
+            spill_host_budget=self.prop("spill_host_budget_bytes"),
         )
 
     def _profiled(self):
@@ -343,7 +345,9 @@ class Session:
         out = plan_tree_str(plan, catalog=self.catalog,
                             approx_join=bool(self.prop("approx_join")),
                             plan_hints=self._plan_hints(plan),
-                            agg_bypass=bool(self.prop("partial_agg_bypass")))
+                            agg_bypass=bool(self.prop("partial_agg_bypass")),
+                            join_build_budget=self.prop(
+                                "join_build_budget_bytes"))
         if bound:
             rendered = ", ".join(
                 f"?{i}={dt}:{v!r}" for i, (dt, v) in enumerate(bound)

@@ -141,6 +141,16 @@ class NodeStats:
     skew: float = 0.0
     #: live rows those exchanges delivered (the skew's weight)
     exchange_rows: int = 0
+    #: hottest partition id of the worst-skew exchange (-1: none seen)
+    hot_partition: int = -1
+    #: executed out-of-core mode ("" = resident / no spill tier ran)
+    spill_mode: str = ""
+    #: spill partition count (0 outside the spill tier)
+    spill_partitions: int = 0
+    #: partitions kept device-resident by a hybrid plan
+    spill_resident: int = 0
+    #: peak host-RAM bytes this node's spill stores held
+    spill_host_bytes: int = 0
 
     @property
     def misest(self) -> float:
@@ -165,6 +175,11 @@ class NodeStats:
             "misest": round(self.misest, 3),
             "skew": round(self.skew, 3),
             "exchange_rows": self.exchange_rows,
+            "hot_partition": self.hot_partition,
+            "spill_mode": self.spill_mode,
+            "spill_partitions": self.spill_partitions,
+            "spill_resident": self.spill_resident,
+            "spill_host_bytes": self.spill_host_bytes,
         }
 
 
@@ -285,18 +300,40 @@ class StatsRecorder:
         if device_bytes >= 0:
             st.device_bytes = max(st.device_bytes, device_bytes)
 
-    def record_skew(self, node, ratio: float, rows: int = 0) -> None:
+    def record_skew(self, node, ratio: float, rows: int = 0,
+                    hot: Optional[int] = None) -> None:
         """Attach an exchange-skew observation to the node that drove
         the exchange (distributed executor flush path): the WORST ratio
         wins — a post-mortem wants the hottest imbalance, and a
-        capacity-retried exchange reports once per dispatch."""
+        capacity-retried exchange reports once per dispatch. ``hot``
+        names the hottest destination of that worst exchange; it rides
+        the plan-stats history so a recurring fingerprint's hybrid
+        spill plan can seed its resident set from it."""
         key = self.ids.of(node)
         st = self.nodes.get(key)
         if st is None:
             st = NodeStats(type(node).__name__, node_id=key)
             self.nodes[key] = st
+        if float(ratio) >= st.skew and hot is not None:
+            st.hot_partition = int(hot)
         st.skew = max(st.skew, float(ratio))
         st.exchange_rows += int(rows)
+
+    def record_spill(self, node, mode: str, partitions: int,
+                     resident: int, host_bytes: int) -> None:
+        """Attach the executed out-of-core decision to a node (both
+        executors' spill strategy points): what mode actually ran, how
+        many partitions, how many stayed device-resident, and the peak
+        host bytes its spill stores held."""
+        key = self.ids.of(node)
+        st = self.nodes.get(key)
+        if st is None:
+            st = NodeStats(type(node).__name__, node_id=key)
+            self.nodes[key] = st
+        st.spill_mode = mode
+        st.spill_partitions = int(partitions)
+        st.spill_resident = int(resident)
+        st.spill_host_bytes = max(st.spill_host_bytes, int(host_bytes))
 
     def stats_for(self, node) -> Optional[NodeStats]:
         nid = self.ids.get(node)
@@ -362,6 +399,11 @@ class StatsRecorder:
                 # beside est/actual: recurring skew becomes visible at
                 # PLAN time (EXPLAIN (TYPE DISTRIBUTED) headers)
                 "skew": 0.0 if st is None else round(st.skew, 3),
+                # hottest partition + executed spill mode ride along so
+                # a recurring fingerprint's NEXT run can seed its
+                # hybrid resident set from measured skew
+                "hot_partition": -1 if st is None else st.hot_partition,
+                "spill_mode": "" if st is None else st.spill_mode,
             })
         return out
 
@@ -586,12 +628,18 @@ def render_analyzed_plan(plan, recorder: StatsRecorder,
             # exchange-partition skew of the exchanges this node drove
             # (distributed runs only): max/mean delivered-row ratio
             skew = f", skew {st.skew:.1f}x" if st.skew > 0 else ""
+            spill = ""
+            if st.spill_mode:
+                spill = (f", spill {st.spill_mode}"
+                         f"({st.spill_resident}/{st.spill_partitions} "
+                         f"resident, host "
+                         f"{_fmt_bytes(st.spill_host_bytes)})")
             lines.append(
                 f"{pad}{name}  [wall {st.wall_s * 1e3:.1f}ms, "
                 f"rows {in_rows}->{rows}"
                 f"{est_part(node, st)}, "
                 f"bytes {_fmt_bytes(st.output_bytes)}, "
-                f"calls {st.invocations}{skew}]" + strat
+                f"calls {st.invocations}{skew}{spill}]" + strat
             )
         else:
             lines.append(

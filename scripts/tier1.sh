@@ -58,13 +58,21 @@
 #      at its concurrency cap, cross-query batched dispatch fires at
 #      least once with results identical to serial execution, and the
 #      global memory pool drains (ISSUE-14 acceptance).
-#  12. Static-analysis gate (scripts/lint.sh): the engine-invariant
+#  12. Out-of-core spill smoke: a TPC-H join whose build side is ~4x
+#      over `join_build_budget_bytes` must execute through the PLANNED
+#      hybrid tier — `spill.planned_hybrid` fires, `query.oom_degraded`
+#      stays ZERO (no ladder round-trip), EXPLAIN renders the spill
+#      decision, rows are identical to the unconstrained run, and both
+#      the memory pool and the host-spill budget drain to zero
+#      (ISSUE-16 acceptance; the static gate below keeps the spill
+#      code PT-lint green).
+#  13. Static-analysis gate (scripts/lint.sh): the engine-invariant
 #      linter (`python -m presto_tpu.analysis` — trace hygiene,
 #      cache-key completeness, lock discipline, global-state hygiene)
 #      must exit 0 on the repo, AND each rule family must flag its
 #      seeded known-bad fixture — proving the gate can actually fail
 #      (ISSUE-15 acceptance).
-#  13. The tier-1 pytest suite on the CPU backend (virtual-device
+#  14. The tier-1 pytest suite on the CPU backend (virtual-device
 #      distributed tests included; `slow` marks excluded), with the
 #      same flags and timeout the driver uses.
 #
@@ -656,6 +664,56 @@ print("serving smoke: %d batch dispatches (%d served), aggressor peak "
       "identical, metrics parse ok, pool 0"
       % (int(fused), served, snap["aggressor"]["peak_running"],
          int(snap["aggressor"]["over_quota_blocked"]), checked))
+PY
+
+timeout -k 10 240 env JAX_PLATFORMS=cpu python - <<'PY' || exit $?
+# Gate 12: the planned hybrid-spill tier — larger-than-budget joins
+# execute out-of-core WITHOUT the OOM ladder's failed-attempt
+# round-trip, bit-identical to the resident run.
+from presto_tpu.connectors.tpch import TpchConnector
+from presto_tpu.runtime.memory import global_host_spill_budget
+from presto_tpu.runtime.metrics import REGISTRY
+from presto_tpu.runtime.session import Session
+
+Q3ISH = (
+    "select o_orderkey, sum(l_extendedprice * (1 - l_discount)) as rev "
+    "from orders, lineitem where o_orderkey = l_orderkey "
+    "and o_orderdate < date '1995-03-15' and l_shipdate > date '1995-03-15' "
+    "group by o_orderkey order by rev desc, o_orderkey limit 20"
+)
+conn = TpchConnector(sf=0.005, units_per_split=1 << 12)
+want = Session({"tpch": conn}).sql(Q3ISH)
+
+# the filtered orders build estimates ~17.5 KB at SF 0.005: a 4400-byte
+# budget puts it ~4x over, squarely in hybrid territory
+before = REGISTRY.snapshot()
+s = Session({"tpch": conn}, properties={"join_build_budget_bytes": 4400})
+plan = s.explain(Q3ISH)
+assert "spill=hybrid(" in plan, f"EXPLAIN missing spill decision:\n{plan}"
+got = s.sql(Q3ISH)
+assert got.equals(want), "hybrid-spill rows differ from resident run"
+snap = REGISTRY.snapshot()
+
+
+def delta(name):
+    return snap.get(name, 0) - before.get(name, 0)
+
+
+assert delta("spill.planned_hybrid") >= 1, "planned hybrid never executed"
+assert delta("query.oom_degraded") == 0, "planned spill paid a ladder rung"
+assert delta("query.backend_oom") == 0, "planned spill hit a backend OOM"
+assert delta("spill.partitions_streamed") >= 1, "no partition streamed"
+assert s.pool().reserved_bytes == 0, "memory pool reservation leak"
+assert global_host_spill_budget().reserved_bytes == 0, \
+    "host-spill budget reservation leak"
+hist = [e for e in s.query_history[-1].rung_history
+        if e.get("kind") == "planned_hybrid"]
+assert hist, "no planned_hybrid entry in rung history"
+print("spill smoke: %d hybrid decisions, %d partitions streamed, "
+      "%d transfer bytes, 0 ladder rungs, rows identical, pool 0"
+      % (int(delta("spill.planned_hybrid")),
+         int(delta("spill.partitions_streamed")),
+         int(delta("spill.transfer_bytes"))))
 PY
 
 timeout -k 10 180 env JAX_PLATFORMS=cpu bash scripts/lint.sh || exit $?

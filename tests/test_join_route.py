@@ -262,9 +262,11 @@ def test_q3_routes_pallas_and_prunes(conn):
 
 
 def test_forced_grouped_oom_rung(conn):
-    """The OOM ladder's forced-grouped rung: results identical to the
-    un-degraded run, and the fused route is NOT taken (grouped is the
-    robustness backstop)."""
+    """The OOM ladder's forced out-of-core rung: results identical to
+    the un-degraded run, and the fused route is NOT taken (the spill
+    tier is the robustness backstop). Rung 1 re-plans into hybrid
+    (shrunk resident set) rather than fully-grouped — either spill
+    mode satisfies the backstop contract."""
     from presto_tpu.plan.prune import prune
 
     s = _session(conn)
@@ -278,10 +280,12 @@ def test_forced_grouped_oom_rung(conn):
     got = ex.run(plan)
     after = REGISTRY.snapshot()
     _frames_equal(want, got)
-    assert after.get("join.strategy.grouped", 0) > before.get(
-        "join.strategy.grouped", 0)
+    spilled = sum(after.get(f"join.strategy.{m}", 0)
+                  - before.get(f"join.strategy.{m}", 0)
+                  for m in ("hybrid", "grouped"))
+    assert spilled > 0, "OOM rung did not route the spill tier"
     assert after.get("exec.pallas_join_route", 0) == before.get(
-        "exec.pallas_join_route", 0), "forced-grouped rung must not route pallas"
+        "exec.pallas_join_route", 0), "forced spill rung must not route pallas"
 
 
 def test_explain_renders_strategy_and_filters(conn):
