@@ -1259,6 +1259,118 @@ def run_multitenant_load(duration_s: float = 6.0, seed: int = 0,
     }
 
 
+def run_ingest_load(duration_s: float = 6.0, seed: int = 0,
+                    n_subscriptions: int = 4, seed_rows: int = 100_000,
+                    append_rows: int = 4000,
+                    append_interval_s: float = 0.15) -> dict:
+    """Streaming ingest + continuous-query load (presto_tpu.stream):
+    one writer lands micro-batch appends on a memory table while
+    ``n_subscriptions`` same-template dashboard subscriptions re-fire
+    on every epoch advance through the batch gate. Measures append
+    latency, refresh latency (the ``continuous_query_refresh_p99_s``
+    observability metric), end-to-end freshness lag (append landing ->
+    last dashboard holding that epoch), and the zero-stale contract:
+    every delivered frame carries at least the rows of its fire-time
+    epoch."""
+    import threading as _th
+    import time as _t
+
+    import numpy as np
+    import pandas as pd
+
+    from presto_tpu.connectors.memory import MemoryConnector
+    from presto_tpu.runtime.metrics import REGISTRY
+    from presto_tpu.runtime.session import Session
+    from presto_tpu.server.frontend import QueryServer
+    from presto_tpu.stream import StreamWriter
+
+    conn = MemoryConnector()
+    session = Session({"memory": conn}, properties={
+        "batched_dispatch": True,
+        "result_cache_enabled": True,
+    })
+    server = QueryServer(session=session)
+    w = StreamWriter(session)
+
+    def ticks(n, lo=0):
+        k = np.arange(lo, lo + n, dtype=np.int64)
+        return pd.DataFrame({"k": k, "v": (k * 3) % 100})
+
+    rows_at_epoch: dict = {}
+    r0 = w.append("ticks", ticks(seed_rows))
+    rows_at_epoch[r0.epoch] = r0.total_rows
+    # every literal above the value range (v in 0..99): each refresh
+    # returns ALL rows, so len(df) vs the append ledger is the
+    # zero-stale oracle
+    fmt = "select k, v from ticks where v < {} order by k limit 100000000"
+    subs = [server.subscribe(fmt.format(150 + 25 * i), f"dash-{i % 3}")
+            for i in range(n_subscriptions)]
+    for sub in subs:
+        sub.wait_for_seq(1, timeout_s=120)
+
+    before = REGISTRY.snapshot()
+    append_lat: list = []
+    lag: list = []
+    t_start = _t.perf_counter()
+    deadline = _t.monotonic() + duration_s
+    appends = 0
+    lo = seed_rows
+    while _t.monotonic() < deadline:
+        t0 = _t.perf_counter()
+        r = w.append("ticks", ticks(append_rows, lo=lo))
+        append_lat.append(_t.perf_counter() - t0)
+        rows_at_epoch[r.epoch] = r.total_rows
+        appends += 1
+        lo += append_rows
+        # freshness lag: append landing -> EVERY dashboard delivered a
+        # result at least as fresh as this epoch
+        for sub in subs:
+            sub.wait_for_epoch("ticks", r.epoch, timeout_s=120)
+        lag.append(_t.perf_counter() - t0)
+        _t.sleep(append_interval_s)
+    wall = _t.perf_counter() - t_start
+    after = REGISTRY.snapshot()
+
+    stale = 0
+    refresh_lat: list = []
+    for sub in subs:
+        for res in sub.results():
+            refresh_lat.append(res.refresh_s)
+            floor = rows_at_epoch.get(res.epochs.get("ticks"), None)
+            if floor is None or len(res.df) < floor:
+                stale += 1
+    summary = server.shutdown(drain_timeout_s=15)
+
+    def delta(name):
+        return after.get(name, 0.0) - before.get(name, 0.0)
+
+    als, rls, lgs = sorted(append_lat), sorted(refresh_lat), sorted(lag)
+    dispatched = delta("batch.dispatched")
+    fused = delta("batch.queries")
+    return {
+        "appends": appends,
+        "rows_ingested": appends * append_rows,
+        "appends_per_sec": round(appends / wall, 2) if wall > 0 else 0.0,
+        "append_p50_ms": round(_pctl(als, 0.50) * 1e3, 2),
+        "append_p99_ms": round(_pctl(als, 0.99) * 1e3, 2),
+        "refreshes": len(refresh_lat),
+        "continuous_query_refresh_p50_s": round(_pctl(rls, 0.50), 4),
+        "continuous_query_refresh_p99_s": round(_pctl(rls, 0.99), 4),
+        "freshness_lag_p50_s": round(_pctl(lgs, 0.50), 4),
+        "freshness_lag_p99_s": round(_pctl(lgs, 0.99), 4),
+        "stale_deliveries": stale,
+        "stale_blocked": int(delta("subscription.stale_blocked")),
+        "refresh_failed": int(delta("subscription.refresh_failed")),
+        "batch_dispatched": int(dispatched),
+        "batch_mean_size": (round(fused / dispatched, 2)
+                            if dispatched else None),
+        "dict_rebuilds": int(delta("stream.dict_rebuilds")),
+        "duration_s": round(wall, 2),
+        "pool_drained": bool(summary["drained"]
+                             and summary["pool_reserved_bytes"] == 0),
+    }
+
+
 def bench_sustained_load(extra: dict) -> None:
     """The sustained-load observability record (first-class ``metrics``
     entries beside the kernel rates): fair-weather queries/sec + tail
@@ -1305,6 +1417,13 @@ def bench_sustained_load(extra: dict) -> None:
             "interactive_solo": solo, "serial": serial,
             "batched": batched,
         }
+    # streaming ingest + continuous queries (ISSUE-17): append-driven
+    # dashboard refreshes — freshness lag, refresh p99, zero stale
+    if _remaining() > 45:
+        ing = run_ingest_load(duration_s=5.0, seed=4)
+        assert ing["stale_deliveries"] == 0, "ingest load delivered stale"
+        assert ing["pool_drained"], "ingest load leaked pool reservations"
+        extra["ingest_load"] = ing
     if _remaining() > 30:
         chaos_res = run_sustained_load(n_sessions=2, duration_s=5.0,
                                        seed=1, sf=0.002, chaos=True)
@@ -1822,6 +1941,20 @@ def _run(sf: float, stream_mode: bool) -> None:
             "interactive_p99_ratio": (
                 round(loaded_p99 / max(solo_p99, 1e-9), 2)
                 if solo_p99 else None),
+        })
+    if "ingest_load" in extra:
+        ing = extra["ingest_load"]
+        metrics.append({
+            "metric": "continuous_query_refresh_p99_s",
+            "value": ing["continuous_query_refresh_p99_s"],
+            "unit": "s",
+            "refresh_p50_s": ing["continuous_query_refresh_p50_s"],
+            "freshness_lag_p99_s": ing["freshness_lag_p99_s"],
+            "appends_per_sec": ing["appends_per_sec"],
+            "append_p99_ms": ing["append_p99_ms"],
+            "refreshes": ing["refreshes"],
+            "batch_mean_size": ing["batch_mean_size"],
+            "stale_deliveries": ing["stale_deliveries"],
         })
     if "sustained_load_chaos" in extra:
         sl = extra["sustained_load_chaos"]

@@ -55,6 +55,9 @@ CODEGEN_PROPERTIES = (
     # cached results. runtime_join_filters / pallas_join are deliberately
     # NOT here — both are bit-identical to their fallbacks.
     "approx_join",
+    # approx_scan_fraction < 1 drops splits (sampled scans): sampled and
+    # exact runs must never share cached results either
+    "approx_scan_fraction",
     # narrow_storage is deliberately NOT here: the fingerprint folds the
     # RESOLVED physical scan schemas (physical_scan_schemas below), which
     # capture the switch through the types it resolves to — keying on the

@@ -12,10 +12,22 @@ through the ordinary scan path with no special cases. Writes are
 all-or-nothing per statement: ``MemorySink`` buffers pages and
 publishes the table only on ``commit()`` (the reference's
 transactional ``finish``/``finishInsert`` posture [SURVEY §5.4]).
+
+Appends are **incremental** (the streaming-ingest contract,
+``presto_tpu/stream/``): a micro-batch is encoded as the table's
+EXISTING column types and concatenated, and the stored per-column
+stats are MERGED (min/max over the union of per-column unique-value
+arrays, null_fraction from exact valid counts) — never recomputed
+over the full table — yet remain bit-identical to a from-scratch
+``_store`` over the concatenated rows, so narrow physical storage and
+fused leaf-route admission decide the same either way. Every write
+bumps the table's **version epoch** (``table_epoch``), the clock
+continuous-query subscriptions fire on.
 """
 
 from __future__ import annotations
 
+import threading
 from typing import Mapping, Sequence
 
 import numpy as np
@@ -128,8 +140,20 @@ class MemoryConnector:
     def __init__(self, units_per_split: int | None = None):
         self.units_per_split = units_per_split or self.DEFAULT_UNITS_PER_SPLIT
         self._tables: dict[str, dict] = {}
+        #: per-table monotone version epochs: bumped on EVERY write
+        #: (store, append, drop) and never reset — the freshness clock
+        #: continuous-query subscriptions (presto_tpu/stream/) compare
+        #: delivered results against. Survives drop/recreate so a
+        #: subscription can never mistake a rebuilt table for fresh.
+        self._epochs: dict[str, int] = {}
+        #: serializes WRITERS only. Readers are lock-free: every write
+        #: builds a complete new entry dict and publishes it with one
+        #: atomic ``_tables[table] = entry`` swap, and appends only
+        #: ever GROW arrays, so a scan that captured the previous
+        #: entry still slices valid bounds
+        self._write_lock = threading.Lock()
         #: fired with the table name on EVERY write-path mutation
-        #: (CTAS store, INSERT commit, DROP). The session wires
+        #: (CTAS store, INSERT/append commit, DROP). The session wires
         #: ``Catalog.invalidate`` here so metadata- and result-cache
         #: invalidation cannot be bypassed by a direct Python-API
         #: write that skips the SQL DDL path. Held weakly: a connector
@@ -166,22 +190,36 @@ class MemoryConnector:
         return sink.commit()
 
     def insert(self, table: str, df) -> int:
-        """INSERT INTO: append rows (atomic per statement; the source
-        frames are kept, so appends re-encode but never decode)."""
+        """INSERT INTO: append rows (atomic per statement). Rides the
+        O(micro-batch) :meth:`append` path — the full table is never
+        re-encoded or re-scanned."""
+        return self.append(table, df)
+
+    def append(self, table: str, df) -> int:
+        """Append a micro-batch in O(batch) work: encode the new rows
+        as the table's EXISTING column types, concatenate, and MERGE
+        the stored stats (exact — see ``_merge_column``). The new
+        entry is built complete and published with one atomic dict
+        swap (all-or-nothing visibility, like ``_store``), then the
+        table's version epoch bumps and DDL listeners fire. A
+        zero-row batch is a no-op: no epoch bump, no invalidation."""
         if table not in self._tables:
             raise KeyError(f"table not found: {table}")
-        t = self._tables[table]
-        existing_df = t["df"]
-        if list(df.columns) != list(existing_df.columns):
+        types = self._tables[table]["types"]
+        if list(df.columns) != list(types):
             raise UserError(
                 f"insert schema {list(df.columns)} != table "
-                f"{list(existing_df.columns)}"
+                f"{list(types)}"
             )
+        if not len(df):
+            return 0
         self._check_types(table, df)
-        sink = MemorySink(self, table)
-        sink.append_df(existing_df)
-        sink.append_df(df)
-        return sink.commit() - len(existing_df)
+        with self._write_lock:
+            entry = self._appended_entry(self._tables[table], df)
+            self._tables[table] = entry
+            self._epochs[table] = self._epochs.get(table, 0) + 1
+        self._notify_ddl(table)
+        return len(df)
 
     def _check_types(self, table: str, df) -> None:
         """Inserted values must be coercible INTO the column's existing
@@ -209,10 +247,21 @@ class MemoryConnector:
                 )
 
     def drop_table(self, table: str) -> None:
-        del self._tables[table]
+        with self._write_lock:
+            del self._tables[table]
+            self._epochs[table] = self._epochs.get(table, 0) + 1
         self._notify_ddl(table)
 
     def _store(self, table: str, df) -> None:
+        entry = self._built_entry(df)
+        with self._write_lock:
+            self._tables[table] = entry
+            self._epochs[table] = self._epochs.get(table, 0) + 1
+        self._notify_ddl(table)
+
+    def _built_entry(self, df) -> dict:
+        """Full (re)encode of a DataFrame into a table entry — the
+        CTAS/replace path. Appends go through ``_appended_entry``."""
         cols: dict[str, np.ndarray] = {}
         types: dict[str, DataType] = {}
         dicts: dict[str, Dictionary] = {}
@@ -227,14 +276,21 @@ class MemoryConnector:
         # exact per-column min/max over NON-NULL values, computed once
         # per store: written tables get the same stats-driven planning
         # (join-key packing, narrow physical storage) as the generator
-        # connectors — a write IS the stats refresh
+        # connectors — a write IS the stats refresh. The sorted
+        # unique-value array and exact valid count are KEPT per stats
+        # column so appends can merge instead of rescanning and still
+        # produce bit-identical ndv/min/max/null_fraction.
         stats: dict[str, ColumnStats] = {}
+        uniques: dict[str, np.ndarray] = {}
+        valid_counts: dict[str, int] = {}
         for c in df.columns:
             t = types[c]
             data, valid = cols[c], cols.get(c + "$valid")
             if t.kind in (TypeKind.INTEGER, TypeKind.BIGINT, TypeKind.DATE):
                 vals = data if valid is None else data[valid]
-                ndv = float(len(np.unique(vals))) if len(vals) else 0.0
+                u = np.unique(vals)
+                uniques[c] = u
+                valid_counts[c] = int(len(vals))
                 # honest null_fraction: a stored valid mask means the
                 # column HAS NULLs, and declared NULL-freedom is what
                 # admits fused leaf routes — lying here would turn the
@@ -242,18 +298,114 @@ class MemoryConnector:
                 nf = (0.0 if valid is None or not len(data)
                       else float(1.0 - len(vals) / len(data)))
                 if len(vals):
-                    stats[c] = ColumnStats(ndv, int(vals.min()),
+                    stats[c] = ColumnStats(float(len(u)), int(vals.min()),
                                            int(vals.max()),
                                            null_fraction=nf)
                 else:
                     stats[c] = ColumnStats(0.0, null_fraction=nf)
-        # the source frame is kept so appends re-infer from original
-        # values (no decode round trip, no lossy re-inference)
-        self._tables[table] = {
+        return {
             "arrays": cols, "types": types, "dicts": dicts, "rows": len(df),
-            "df": df.reset_index(drop=True), "stats": stats,
+            "stats": stats, "uniques": uniques, "valid_counts": valid_counts,
         }
-        self._notify_ddl(table)
+
+    def _appended_entry(self, t: dict, df) -> dict:
+        """Entry for ``t``'s rows + the micro-batch ``df``, built in
+        O(batch) work (caller holds the write lock): each batch column
+        is encoded as the table's EXISTING type — no re-inference over
+        old rows — and stats merge through the kept unique-value
+        arrays and valid counts. The one O(column) escape hatch is a
+        VARCHAR batch introducing unseen strings: dictionary codes are
+        ordered (code order == value order), so that column's codes
+        are remapped through the merged dictionary — counted as
+        ``stream.dict_rebuilds``, never silent."""
+        import pandas as pd
+
+        n_old = t["rows"]
+        total = n_old + len(df)
+        arrays = dict(t["arrays"])
+        types = dict(t["types"])
+        dicts = dict(t["dicts"])
+        stats = dict(t["stats"])
+        uniques = dict(t["uniques"])
+        valid_counts = dict(t["valid_counts"])
+        for c in list(types):
+            told = types[c]
+            s = pd.Series(df[c])
+            bvalid = s.notna().to_numpy()
+            has_null = not bvalid.all()
+            if told.kind in (TypeKind.VARCHAR, TypeKind.BYTES):
+                strs = s.fillna("").astype(str)
+                d = dicts[c]
+                batch_vals = set(strs[bvalid].tolist())
+                if not batch_vals <= set(d.values.tolist()):
+                    from presto_tpu.runtime.metrics import REGISTRY
+
+                    merged = Dictionary(list(d.values) + sorted(batch_vals))
+                    remap = merged.encode(list(d.values)).astype(np.int32)
+                    arrays[c] = remap[arrays[c]]
+                    dicts[c] = d = merged
+                    REGISTRY.counter("stream.dict_rebuilds").add()
+                data = d.encode(
+                    strs.where(bvalid, d.values[0]).tolist()
+                ).astype(np.int32)
+            elif told.kind is TypeKind.BOOLEAN:
+                data = s.fillna(False).to_numpy(np.bool_)
+            elif told.kind is TypeKind.DATE:
+                days = (s.to_numpy("datetime64[D]")
+                        - np.datetime64("1970-01-01", "D")).astype(np.int32)
+                data = np.where(bvalid, days, 0).astype(np.int32)
+            elif told.kind is TypeKind.DOUBLE:
+                data = s.fillna(0.0).to_numpy().astype(told.np_dtype)
+            elif told.kind in (TypeKind.INTEGER, TypeKind.BIGINT):
+                data = s.fillna(0).to_numpy().astype(told.np_dtype)
+            else:
+                # _infer_column never stores such a kind, and
+                # _check_types only admits batches coercible into
+                # stored kinds — reaching here is a contract breach
+                raise UserError(
+                    f"append unsupported for column {c!r} of type "
+                    f"{told.kind.value}"
+                )
+            old_valid = arrays.get(c + "$valid")
+            if has_null or old_valid is not None:
+                ov = (old_valid if old_valid is not None
+                      else np.ones(n_old, dtype=np.bool_))
+                arrays[c + "$valid"] = np.concatenate([ov, bvalid])
+            arrays[c] = np.concatenate([arrays[c], data])
+            if told.kind in (TypeKind.INTEGER, TypeKind.BIGINT,
+                             TypeKind.DATE):
+                bvals = data[bvalid]
+                u = uniques[c]
+                if len(bvals):
+                    u = np.union1d(u, np.unique(bvals))
+                    uniques[c] = u
+                vc = valid_counts[c] + int(len(bvals))
+                valid_counts[c] = vc
+                # same expression shape as _built_entry — merged stats
+                # must be BIT-identical to a from-scratch recompute
+                # (leaf-route admission and narrow storage key on them)
+                nf = (0.0 if (c + "$valid") not in arrays or not total
+                      else float(1.0 - vc / total))
+                if len(u):
+                    stats[c] = ColumnStats(float(len(u)), int(u[0]),
+                                           int(u[-1]), null_fraction=nf)
+                else:
+                    stats[c] = ColumnStats(0.0, null_fraction=nf)
+        return {
+            "arrays": arrays, "types": types, "dicts": dicts, "rows": total,
+            "stats": stats, "uniques": uniques, "valid_counts": valid_counts,
+        }
+
+    # ---- version epochs -------------------------------------------------
+    def table_epoch(self, table: str) -> int:
+        """Monotone write-version of ``table`` (0 = never written).
+        Bumped by store/append/drop BEFORE listeners fire, so a reader
+        woken by invalidation always observes the new epoch."""
+        return self._epochs.get(table, 0)
+
+    def epochs(self) -> "dict[str, int]":
+        """Snapshot of every table's version epoch."""
+        return dict(self._epochs)
 
     # ---- metadata -------------------------------------------------------
     def tables(self) -> Sequence[str]:

@@ -188,6 +188,7 @@ class LocalExecutor(OomLadderMixin):
                  runtime_join_filters: bool = True,
                  pallas_join_enabled: bool = True,
                  approx_join: bool = False,
+                 scan_sample_fraction: float = 1.0,
                  spill_host_budget: int | None = None):
         self.catalog = catalog
         #: literal-slot values of the current query's plan template
@@ -206,6 +207,11 @@ class LocalExecutor(OomLadderMixin):
         #: allow the APPROXIMATE sketch probe (semi joins; false
         #: positives possible) where the exact table cannot fit
         self.approx_join = approx_join
+        #: APPROXIMATE sampled scans (the approx_scan_fraction session
+        #: property): below 1.0, _exec_tablescan keeps only an evenly
+        #: strided fraction of each table's splits and marks the run
+        #: used_approx — never a silent row drop
+        self.scan_sample_fraction = float(scan_sample_fraction or 1.0)
         #: id(probe scan node) -> [JoinFilterSlot] (runtime filters
         #: registered by ancestor joins before the probe side executes)
         self._scan_filters: dict[int, list[JoinFilterSlot]] = {}
@@ -442,6 +448,24 @@ class LocalExecutor(OomLadderMixin):
                                       params=self.params)
             )
         splits = list(conn.splits(node.table))
+        f = self.scan_sample_fraction
+        if f < 1.0 and len(splits) > 1:
+            # APPROXIMATE sampled scan: keep an evenly strided subset
+            # of splits — deterministic per split layout, so repeated
+            # refreshes of one subscription sample consistently. The
+            # run is flagged used_approx (QueryInfo.approximate): a
+            # sampled result is never presented as exact.
+            n_all = len(splits)
+            keep = max(1, int(round(n_all * f)))
+            if keep < n_all:
+                step = n_all / keep
+                splits = [splits[min(int(i * step), n_all - 1)]
+                          for i in range(keep)]
+                self.used_approx = True
+                from presto_tpu.runtime.metrics import REGISTRY
+
+                REGISTRY.counter("scan.splits_sampled_out").add(
+                    n_all - keep)
         cap = batch_capacity(max(s.row_hint for s in splits))
         fslots = self._scan_filters.get(id(node), ())
 

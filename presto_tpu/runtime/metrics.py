@@ -357,6 +357,33 @@ METRIC_HELP: dict[str, str] = {
     "spill.host_rejected": (
         "host-spill reservations refused by spill_host_budget_bytes "
         "(typed SPILL_BUDGET_EXCEEDED failures)"),
+    "stream.appends": (
+        "micro-batch appends landed on streaming tables (each bumps "
+        "the table's version epoch)"),
+    "stream.rows": "rows ingested by micro-batch appends",
+    "stream.dict_rebuilds": (
+        "VARCHAR dictionary merges forced by appends introducing "
+        "unseen values (old codes remapped in place)"),
+    "stream.append_s": (
+        "append latency: encode + incremental stats merge + publish"),
+    "subscription.fired": (
+        "continuous-query refreshes delivered (initial, epoch-driven, "
+        "and interval ticks — see subscription.trigger.*)"),
+    "subscription.refresh_failed": (
+        "continuous-query refreshes that failed (typed failures "
+        "re-arm the fire; untyped ones fail the subscription)"),
+    "subscription.stale_blocked": (
+        "refresh results DROPPED because the executing session read a "
+        "table version older than the fire-time epoch floor"),
+    "subscription.drain_blocked": (
+        "refreshes dropped because the server was draining "
+        "(subscriptions stay active for a restarted server)"),
+    "subscription.refresh_s": (
+        "continuous-query refresh latency: fire decision -> result "
+        "delivered to the subscription's ring"),
+    "scan.splits_sampled_out": (
+        "table-scan splits skipped by approx-mode sampled scans "
+        "(approx_scan_fraction < 1; results flagged approximate)"),
 }
 
 

@@ -395,6 +395,19 @@ SESSION_PROPERTIES: dict[str, PropertyDef] = {
             "the exact/approximate boundary.",
         ),
         PropertyDef(
+            "approx_scan_fraction", float, 1.0,
+            "APPROXIMATE scans: execute only this deterministic "
+            "fraction of each table's splits (evenly strided, so the "
+            "sample is stable per split layout). 1.0 scans "
+            "everything; below 1.0 the query is flagged "
+            "QueryInfo.approximate — the dashboard tier of "
+            "presto_tpu/stream/ subscriptions. Changes results: the "
+            "plan fingerprint folds this property, so sampled and "
+            "exact runs never share cached results.",
+            check=lambda v: (None if 0.0 < v <= 1.0
+                             else f"must be in (0, 1], got {v}"),
+        ),
+        PropertyDef(
             "pallas_strings", bool, None,
             "Force the Pallas string-predicate kernels on or off "
             "(process-wide; default: on when running on TPU). Mirrors "

@@ -276,6 +276,7 @@ class Session:
                 runtime_join_filters=self.prop("runtime_join_filters"),
                 pallas_join_enabled=self.prop("pallas_join"),
                 approx_join=self.prop("approx_join"),
+                scan_sample_fraction=self.prop("approx_scan_fraction"),
                 spill_host_budget=self.prop("spill_host_budget_bytes"),
             )
         from presto_tpu.exec.distributed import DistributedExecutor

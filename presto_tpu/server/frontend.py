@@ -59,11 +59,13 @@ class QueryServer:
                  *, session=None, tenants=None,
                  total_slots: Optional[int] = None,
                  properties: Optional[dict] = None,
+                 approx_properties: Optional[dict] = None,
                  default_tenant: str = "default",
                  query_record_limit: int = 256,
                  submit_limit: int = 128,
                  submit_timeout_s: float = 300.0):
         from presto_tpu.runtime.session import Session
+        from presto_tpu.stream.subscriptions import SubscriptionManager
 
         if session is None:
             props = {"batched_dispatch": True}
@@ -96,6 +98,17 @@ class QueryServer:
         self._accepting = True
         self._inflight = 0
         self._drain_cv = threading.Condition()
+        #: continuous-query subscriptions (presto_tpu/stream/): the
+        #: manager's notifier thread starts on first subscribe, never
+        #: for a server that serves only ad-hoc statements
+        self.subscriptions = SubscriptionManager(self)
+        #: extra session properties for the APPROXIMATE sibling
+        #: session (mode="approx" subscriptions) — e.g. a tiny
+        #: join_build_budget_bytes to force the sketch path, or
+        #: approx_scan_fraction for sampled scans
+        self._approx_properties = dict(approx_properties or {})
+        self._approx_session = None
+        self._approx_lock = threading.Lock()
 
     # ---- lifecycle accounting -------------------------------------------
     def _enter(self, tenant: str):
@@ -288,6 +301,54 @@ class QueryServer:
             raise UserError(f"query {qid} failed: {rec['error']}")
         return rec["df"]
 
+    # ---- continuous queries (presto_tpu/stream/) ------------------------
+    def approx_session(self):
+        """The APPROXIMATE sibling session (built lazily): same
+        connectors and memory pool as the main session, but with
+        ``approx_join`` on (Bloom-sketch semi joins) plus any
+        ``approx_properties`` overrides. Its plan fingerprints fold
+        the approx knobs, so exact and approximate executions never
+        share cached results — and its own catalog hooks the shared
+        memory connector's DDL listeners, so appends invalidate both
+        sessions' caches scoped per table."""
+        with self._approx_lock:
+            if self._approx_session is None:
+                from presto_tpu.runtime.session import Session
+
+                conns = {n: c for n, c in
+                         self.session.catalog.connectors.items()
+                         if n != "system"}
+                props = {"batched_dispatch": True, "approx_join": True}
+                props.update(self._approx_properties)
+                self._approx_session = Session(
+                    conns, memory_pool=self.session.pool(),
+                    properties=props)
+            return self._approx_session
+
+    def subscribe(self, sql: str, tenant: Optional[str] = None,
+                  mode: str = "exact",
+                  interval_s: Optional[float] = None, keep: int = 8):
+        """Register a continuous query: ``sql`` is prepared into a
+        plan template and re-executed (through the fair scheduler and
+        the batch gate) whenever a referenced table's version epoch
+        advances, or every ``interval_s`` seconds. Returns the
+        :class:`~presto_tpu.stream.subscriptions.ContinuousQuery`
+        handle; ``mode="approx"`` serves the dashboard tier through
+        the approx sibling session, flagged ``approximate``."""
+        with self._drain_cv:
+            if not self._accepting:
+                raise UserError("server is draining: not accepting "
+                                "subscriptions")
+        return self.subscriptions.subscribe(
+            sql, tenant or self.default_tenant, mode=mode,
+            interval_s=interval_s, keep=keep)
+
+    def unsubscribe(self, sub_id: str) -> None:
+        self.subscriptions.unsubscribe(sub_id)
+
+    def subscription_page(self, sub_id: str) -> dict:
+        return self.subscriptions.get(sub_id).page()
+
     # ---- observability / shutdown ---------------------------------------
     def metrics_text(self) -> str:
         return self.session.export_metrics()
@@ -300,8 +361,11 @@ class QueryServer:
         """Graceful drain: stop accepting, wait for in-flight queries,
         then report pool state (reservations release on every terminal
         state, so a clean drain leaves the pool empty) and optionally
-        flush the flight-recorder ring to ``flight_path``."""
+        flush the flight-recorder ring to ``flight_path``. Continuous
+        queries cancel FIRST — their in-flight refreshes hold ordinary
+        in-flight accounting, so the drain wait below covers them."""
         deadline = time.monotonic() + drain_timeout_s
+        self.subscriptions.close()
         with self._drain_cv:
             self._accepting = False
             while self._inflight > 0:
@@ -349,6 +413,12 @@ class HttpFrontend:
                                      {columns, data})
         POST /v1/prepared            JSON {action: prepare|execute|
                                      deallocate, name, sql?, params?}
+        POST /v1/subscribe           JSON {sql, mode?, intervalS?};
+                                     201 -> {id, tables, mode,
+                                     nextUri} (continuous query)
+        GET  /v1/subscription/<id>   latest delivered page (epochs,
+                                     seq, approximate, columns, data)
+        POST /v1/subscription/<id>/cancel
         GET  /metrics                OpenMetrics text exposition
         GET  /v1/tenants             scheduler snapshot JSON
 
@@ -398,6 +468,10 @@ class HttpFrontend:
                     if self.path.startswith("/v1/statement/"):
                         qid = self.path.rsplit("/", 1)[1]
                         self._send(200, qserver.poll(qid))
+                        return
+                    if self.path.startswith("/v1/subscription/"):
+                        sid = self.path.rsplit("/", 1)[1]
+                        self._send(200, qserver.subscription_page(sid))
                         return
                     self._send(404, {"error": f"no route {self.path}"})
                 except UserError as e:
@@ -449,6 +523,30 @@ class HttpFrontend:
                             return
                         self._send(400, {"error": "action must be "
                                          "prepare|execute|deallocate"})
+                        return
+                    if self.path == "/v1/subscribe":
+                        try:
+                            req = json.loads(self._body().decode("utf-8"))
+                            sql = req["sql"]
+                        except (ValueError, KeyError) as e:
+                            self._send(400, {"error": "bad request: "
+                                             f"{type(e).__name__}: {e}"})
+                            return
+                        sub = qserver.subscribe(
+                            sql, self._tenant(),
+                            mode=req.get("mode", "exact"),
+                            interval_s=req.get("intervalS"))
+                        self._send(201, {
+                            "id": sub.id, "mode": sub.mode,
+                            "tables": list(sub.tables),
+                            "nextUri": f"/v1/subscription/{sub.id}",
+                        })
+                        return
+                    if (self.path.startswith("/v1/subscription/")
+                            and self.path.endswith("/cancel")):
+                        sid = self.path.split("/")[3]
+                        qserver.unsubscribe(sid)
+                        self._send(200, {"cancelled": sid})
                         return
                     self._send(404, {"error": f"no route {self.path}"})
                 except UserError as e:

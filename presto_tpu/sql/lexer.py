@@ -10,6 +10,8 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 
+from presto_tpu.runtime.errors import UserError
+
 
 @dataclass(frozen=True)
 class Token:
@@ -36,8 +38,11 @@ _TWO_CHAR_OPS = {"<=", ">=", "<>", "!=", "||"}
 _ONE_CHAR_OPS = set("+-*/%(),.;=<>?")
 
 
-class LexError(ValueError):
-    pass
+class LexError(UserError):
+    """Tokenizer rejection. A ``UserError`` (which is also a
+    ``ValueError``): malformed SQL must surface through the TYPED
+    error contract like every parse/analysis rejection, not as a bare
+    built-in exception."""
 
 
 def tokenize(sql: str) -> list[Token]:

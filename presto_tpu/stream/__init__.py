@@ -1,0 +1,34 @@
+"""Streaming ingestion + continuous queries.
+
+The serving layer's fresh-data tier (ROADMAP item 5): micro-batch
+appends land on the memory connector through :class:`StreamWriter`
+with INCREMENTAL stats maintenance and per-append version epochs
+(connectors/memory.py), and :class:`ContinuousQuery` subscriptions
+registered through the server re-execute a prepared plan template
+whenever a referenced table's epoch advances (or on an interval
+tick). Continuous queries are exactly same-template re-executions, so
+they ride the existing template + batched-dispatch path: N dashboards
+on one template stack into ONE vmapped dispatch at the
+``TemplateBatchGate``, under the ``FairScheduler``'s tenant quotas.
+
+Freshness contract: a delivered result always reflects AT LEAST the
+epoch snapshot taken when its refresh fired — structurally guaranteed
+because plan fingerprints fold live table versions (a fire at epoch N
+can neither coalesce onto nor cache-hit an epoch<N execution), and
+asserted at delivery time (``subscription.stale_blocked`` stays 0).
+"""
+
+from presto_tpu.stream.subscriptions import (
+    ContinuousQuery,
+    SubscriptionManager,
+    SubscriptionResult,
+)
+from presto_tpu.stream.writer import AppendResult, StreamWriter
+
+__all__ = [
+    "AppendResult",
+    "ContinuousQuery",
+    "StreamWriter",
+    "SubscriptionManager",
+    "SubscriptionResult",
+]

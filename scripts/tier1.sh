@@ -66,13 +66,20 @@
 #      the memory pool and the host-spill budget drain to zero
 #      (ISSUE-16 acceptance; the static gate below keeps the spill
 #      code PT-lint green).
-#  13. Static-analysis gate (scripts/lint.sh): the engine-invariant
+#  13. Streaming smoke: micro-batch appends through StreamWriter bump
+#      the table epoch and re-fire continuous subscriptions with FRESH
+#      rows (fire-time epochs delivered with every result), a
+#      synchronized same-template refresh burst fuses at the batch
+#      gate (deterministic hold, as in gate 11), and warm refreshes
+#      re-trace ZERO jitted steps — the epoch bump invalidates
+#      results, never executables (ISSUE-17 acceptance).
+#  14. Static-analysis gate (scripts/lint.sh): the engine-invariant
 #      linter (`python -m presto_tpu.analysis` — trace hygiene,
 #      cache-key completeness, lock discipline, global-state hygiene)
 #      must exit 0 on the repo, AND each rule family must flag its
 #      seeded known-bad fixture — proving the gate can actually fail
 #      (ISSUE-15 acceptance).
-#  14. The tier-1 pytest suite on the CPU backend (virtual-device
+#  15. The tier-1 pytest suite on the CPU backend (virtual-device
 #      distributed tests included; `slow` marks excluded), with the
 #      same flags and timeout the driver uses.
 #
@@ -714,6 +721,99 @@ print("spill smoke: %d hybrid decisions, %d partitions streamed, "
       % (int(delta("spill.planned_hybrid")),
          int(delta("spill.partitions_streamed")),
          int(delta("spill.transfer_bytes"))))
+PY
+
+timeout -k 10 240 env JAX_PLATFORMS=cpu python - <<'PY' || exit $?
+# Gate 13: streaming ingestion + continuous queries — micro-batch
+# appends bump the table epoch, subscriptions re-fire with fresh rows
+# carrying their fire-time epochs, a synchronized same-template
+# refresh burst fuses at the batch gate (deterministic hold, the gate
+# 11 idiom), and warm refreshes re-trace ZERO jitted steps: the epoch
+# bump invalidates RESULTS, never executables.
+import threading
+import time as _time
+
+import numpy as np
+import pandas as pd
+
+from presto_tpu.connectors.memory import MemoryConnector
+from presto_tpu.runtime.lifecycle import QueryManager
+from presto_tpu.runtime.metrics import REGISTRY
+from presto_tpu.runtime.session import Session
+from presto_tpu.server.frontend import QueryServer
+from presto_tpu.stream import StreamWriter
+
+conn = MemoryConnector()
+s = Session({"memory": conn}, properties={"batched_dispatch": True,
+                                          "result_cache_enabled": True})
+server = QueryServer(session=s)
+w = StreamWriter(s)
+
+
+def ticks(n, lo=0):
+    k = np.arange(lo, lo + n, dtype=np.int64)
+    return pd.DataFrame({"k": k, "v": (k * 3) % 100})
+
+
+r0 = w.append("ticks", ticks(50_000))
+assert r0.created and r0.epoch == 1, r0
+# every literal sits above the value range (v in 0..99), so each
+# refresh returns ALL rows: row count vs the append ledger is a direct
+# zero-stale oracle
+fmt = "select k, v from ticks where v < {} order by k limit 1000000"
+subs = [server.subscribe(fmt.format(lit), f"dash-{i}")
+        for i, lit in enumerate((150, 175, 200, 225))]
+for sub in subs:
+    res = sub.wait_for_seq(1, timeout_s=120)
+    assert len(res.df) == 50_000 and res.epochs["ticks"] == 1
+
+# deterministic fuse: hold the FIRST refresh inside run_plan until the
+# other dashboards queue at the gate, then the next leader provably
+# drains a multi-binding batch
+gate = s.query_manager.batch_gate
+release, first = threading.Event(), threading.Event()
+orig_run_plan = QueryManager.run_plan
+
+
+def gated(self, executor, plan, info, recorder):
+    if not first.is_set():
+        first.set()
+        release.wait(60)
+    return orig_run_plan(self, executor, plan, info, recorder)
+
+
+t0 = REGISTRY.snapshot().get("exec.traces", 0)
+d0 = REGISTRY.snapshot().get("batch.dispatched", 0)
+QueryManager.run_plan = gated
+try:
+    r1 = w.append("ticks", ticks(4000, lo=1_000_000))
+    assert first.wait(60), "no refresh reached run_plan after the append"
+    deadline = _time.monotonic() + 60
+    while _time.monotonic() < deadline:
+        if sum(gate.queue_depth(fp) for fp in list(gate._templates)) >= 2:
+            break
+        _time.sleep(0.01)
+    release.set()
+    got = [sub.wait_for_epoch("ticks", r1.epoch, timeout_s=120)
+           for sub in subs]
+finally:
+    QueryManager.run_plan = orig_run_plan
+snap = REGISTRY.snapshot()
+for res in got:
+    assert len(res.df) == 54_000, "STALE refresh after append"
+    assert res.epochs["ticks"] >= r1.epoch
+fused = snap.get("batch.dispatched", 0) - d0
+assert fused >= 1, "synchronized refresh burst never fused at the gate"
+assert snap.get("exec.traces", 0) == t0, "warm refresh re-traced"
+assert snap.get("stream.appends", 0) >= 2, "stream.appends not counted"
+assert snap.get("subscription.fired", 0) >= 8, "subscription.fired low"
+summary = server.shutdown(drain_timeout_s=15)
+assert summary["drained"] and summary["pool_reserved_bytes"] == 0
+print("streaming smoke: %d appends -> epoch %d, %d refreshes "
+      "(%d fused dispatches), fresh rows 54000/54000, 0 warm re-traces, "
+      "pool 0"
+      % (int(snap.get("stream.appends", 0)), int(r1.epoch),
+         int(snap.get("subscription.fired", 0)), int(fused)))
 PY
 
 timeout -k 10 180 env JAX_PLATFORMS=cpu bash scripts/lint.sh || exit $?

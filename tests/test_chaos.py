@@ -426,6 +426,184 @@ def test_chaos_distributed_rounds(conn):
     assert len(outcomes) == 12
 
 
+def test_chaos_mixed_ingest_subscriptions(conn, oracle):
+    """ISSUE-17 acceptance: concurrent micro-batch appends + continuous
+    subscriptions + ad-hoc queries under injected faults. The gates:
+    zero stale deliveries (every result >= its fire-epoch row floor),
+    same-template subscriptions demonstrably batch (mean gate batch
+    size > 1), an approx-mode subscription returns a flagged
+    superset-of-exact semi join, per-tenant fairness admits everyone,
+    p99 refresh stays bounded, and pool + host-spill budgets drain."""
+    import pandas as pd
+
+    from presto_tpu.connectors.memory import MemoryConnector
+    from presto_tpu.runtime.memory import global_host_spill_budget
+    from presto_tpu.server.frontend import QueryServer
+    from presto_tpu.stream import StreamWriter
+
+    mconn = MemoryConnector()
+    s = Session(
+        {"memory": mconn, "tpch": conn},
+        properties={
+            "batched_dispatch": True,
+            "result_cache_enabled": True,
+            "retry_count": 2,
+            "retry_backoff_s": 0.0,
+        },
+    )
+    server = QueryServer(session=s)
+    w = StreamWriter(s)
+    rng0 = np.random.default_rng(1717)
+
+    def ticks(n, lo=0):
+        return pd.DataFrame({
+            "k": np.arange(lo, lo + n, dtype=np.int64),
+            "v": (np.arange(lo, lo + n, dtype=np.int64) * 3) % 100,
+        })
+
+    rows_at_epoch = {}
+    # big enough that a warm refresh does real work (scan + sort over
+    # ~100k rows): concurrent same-template refreshes OVERLAP, so they
+    # actually meet at the gate instead of finishing between thread
+    # spawns — the dashboard load shape the batcher exists for
+    r0 = w.append("ticks", ticks(100_000))
+    rows_at_epoch[r0.epoch] = r0.total_rows
+
+    # the approx tier's semi-join shape: build keys over ~1e12, so the
+    # exact exists-bitmap can't admit the domain and the Bloom sketch
+    # carries the probe (superset-of-exact, flagged)
+    ckeys = rng0.integers(0, 1_000_000_000_000, 400).astype(np.int64)
+    w.append("orders", pd.DataFrame({
+        "okey": np.arange(3000, dtype=np.int64),
+        "ckey": np.concatenate([
+            rng0.choice(ckeys, 2200),
+            rng0.integers(0, 1_000_000_000_000, 800),
+        ]).astype(np.int64),
+    }))
+    w.append("cust", pd.DataFrame({
+        "ckey": ckeys, "grp": rng0.integers(0, 5, 400).astype(np.int64),
+    }))
+    semi_sql = ("select count(*) n from orders where ckey in "
+                "(select ckey from cust where grp = 2)")
+    semi_exact = int(server.execute(semi_sql, "adhoc")["n"][0])
+
+    #: one template, distinct literals, every literal ABOVE the value
+    #: range — each refresh returns ALL rows, so len(df) is directly
+    #: comparable to the fire-epoch row floor (zero-stale oracle)
+    fmt = "select k, v from ticks where v < {} order by k limit 1000000"
+    lits = (150, 175, 200, 225, 250)
+    subs = [server.subscribe(fmt.format(lit), f"dash-{i % 3}")
+            for i, lit in enumerate(lits)]
+    approx_sub = server.subscribe(semi_sql, "dash-approx", mode="approx")
+
+    d0 = _counter("batch.dispatched")
+    q0 = _counter("batch.queries")
+    stale0 = _counter("subscription.stale_blocked")
+    inj = faults.FaultInjector(seed=1717)
+    # bounded schedules: the round must eventually run clean so every
+    # waiter converges — unbounded scan failure would FAIL the subs
+    inj.inject("scan", error=TransientFailure, times=8, probability=0.5)
+    inj.inject_oom("step.agg", times=2)
+    inj.inject_oom("step.join_build", times=2)
+
+    untyped, wrong = [], []
+    t0 = time.monotonic()
+
+    def adhoc(wid):
+        rng = random.Random(500 + wid)
+        for _ in range(4):
+            qname = rng.choice(sorted(CHAOS_QUERIES))
+            try:
+                df = server.execute(CHAOS_QUERIES[qname], "adhoc")
+            except Exception as e:  # noqa: BLE001 — the contract under test
+                if not isinstance(e, PrestoError):
+                    untyped.append(f"adhoc{wid}: {type(e).__name__}: {e}")
+            else:
+                if not frames_equal(df, oracle[qname]):
+                    wrong.append(f"adhoc{wid}: {qname}")
+
+    def writer():
+        for i in range(8):
+            r = w.append("ticks", ticks(4000, lo=1_000_000 * (i + 1)))
+            rows_at_epoch[r.epoch] = r.total_rows
+            time.sleep(0.12)
+
+    threads = [threading.Thread(target=writer, daemon=True)] + [
+        threading.Thread(target=adhoc, args=(i,), daemon=True)
+        for i in range(2)
+    ]
+    with faults.injected(inj):
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=HANG_BUDGET_S)
+            assert not t.is_alive(), "mixed-load worker hung"
+        # let every sub converge on the chaotic phase's last epoch
+        mid_epoch = mconn.table_epoch("ticks")
+        for sub in subs:
+            sub.wait_for_epoch("ticks", mid_epoch, timeout_s=HANG_BUDGET_S)
+    # land ONE more append with all five subs idle AND the injector
+    # uninstalled — a synchronized burst, the load shape where
+    # same-template refreshes meet at the gate. (An active injector
+    # disables coalescing/batching by design — the same admission rule
+    # as the result cache, lifecycle.InflightCoalescer — so fused
+    # dispatch can only be demonstrated outside the faulted window.)
+    rf = w.append("ticks", ticks(4000, lo=9_000_000))
+    rows_at_epoch[rf.epoch] = rf.total_rows
+    final_epoch = rf.epoch
+    got_final = [sub.wait_for_epoch("ticks", final_epoch,
+                                    timeout_s=HANG_BUDGET_S)
+                 for sub in subs]
+    # bump the approx sub's build side for one CLEAN refresh: a fire
+    # that ate an injected join-build OOM mid-round correctly degrades
+    # to the exact spill join (flagged exact, the conservative answer),
+    # so the sketch contract is asserted on a post-fault fire
+    ra = w.append("orders", pd.DataFrame({
+        "okey": np.arange(3000, 3050, dtype=np.int64),
+        "ckey": rng0.choice(ckeys, 50).astype(np.int64),
+    }))
+    approx_res = approx_sub.wait_for_epoch("orders", ra.epoch,
+                                           timeout_s=HANG_BUDGET_S)
+    semi_exact = int(server.execute(semi_sql, "adhoc")["n"][0])
+    try:
+        assert untyped == [] and wrong == []
+        # zero stale: every delivered frame carries at least the rows
+        # that existed at its fire epoch (appends only grow the table)
+        for sub in subs:
+            assert sub.state == "ACTIVE", sub.last_error
+            for res in sub.results():
+                floor = rows_at_epoch.get(res.epochs.get("ticks"))
+                assert floor is not None
+                assert len(res.df) >= floor, (
+                    f"STALE: {len(res.df)} rows delivered at epoch "
+                    f"{res.epochs['ticks']} (floor {floor})")
+        for res in got_final:  # the converged view is exactly current
+            assert len(res.df) == rows_at_epoch[final_epoch]
+        assert _counter("subscription.stale_blocked") == stale0
+        # same-template refreshes met at the gate and fused
+        dd = _counter("batch.dispatched") - d0
+        qd = _counter("batch.queries") - q0
+        assert dd >= 1, "no batched dispatch under mixed load"
+        assert qd / dd > 1.0, f"mean gate batch size {qd}/{dd} <= 1"
+        # the approx tier: flagged, superset of exact, never silent
+        assert approx_res.approximate
+        assert int(approx_res.df["n"][0]) >= semi_exact
+        # fairness: every tenant class was admitted during the round
+        # (metric suffixes are OpenMetrics-sanitized: "-" becomes "_")
+        for tname in ("dash-0", "dash-1", "dash-2", "dash-approx", "adhoc"):
+            mname = tname.replace("-", "_")
+            assert _counter(f"tenant.admitted.{mname}") > 0, tname
+        # bounded refresh latency (trips only on genuine hangs)
+        p99 = REGISTRY.histogram("subscription.refresh_s").quantile(0.99)
+        assert 0 < p99 < HANG_BUDGET_S
+        assert time.monotonic() - t0 < HANG_BUDGET_S
+    finally:
+        server.shutdown()
+    # budgets drained: no reservation outlives the round
+    assert s.pool().reserved_bytes == 0 and s.pool().queued_count == 0
+    assert global_host_spill_budget().reserved_bytes == 0
+
+
 @pytest.mark.slow
 def test_chaos_concurrent_sessions_shared_pool(conn, oracle):
     """Concurrent sessions + a pool sized for roughly one query at a
