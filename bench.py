@@ -1218,6 +1218,12 @@ def run_multitenant_load(duration_s: float = 6.0, seed: int = 0,
     after = REGISTRY.snapshot()
     if hung:
         untyped.append("worker hung past join timeout")
+    # the bench never tears this server down (the session outlives it
+    # for the report below), so the watchdog must be closed by hand or
+    # its sampler thread keeps firing against the idle session
+    if server.health is not None:
+        server.health.close()
+    slo_rows = session.slo.snapshot()
 
     def delta(name):
         return after.get(name, 0.0) - before.get(name, 0.0)
@@ -1253,6 +1259,12 @@ def run_multitenant_load(duration_s: float = 6.0, seed: int = 0,
             and after.get(k, 0) != before.get(k, 0)
         },
         "tenant_queue_timeouts": int(delta("tenant.queue_timeouts")),
+        "slo": {r["tenant"]: {
+            "latency_objective_s": r["latency_objective_s"],
+            "latency_good": r["latency_good"],
+            "latency_breach": r["latency_breach"],
+            "latency_burn_rate": round(r["latency_burn_rate"], 4),
+        } for r in slo_rows},
         "duration_s": round(wall, 2),
         "pool_drained": pool.reserved_bytes == 0 and not hung,
         "untyped_failures": untyped,
@@ -1339,6 +1351,7 @@ def run_ingest_load(duration_s: float = 6.0, seed: int = 0,
             floor = rows_at_epoch.get(res.epochs.get("ticks"), None)
             if floor is None or len(res.df) < floor:
                 stale += 1
+    slo_rows = session.slo.snapshot()
     summary = server.shutdown(drain_timeout_s=15)
 
     def delta(name):
@@ -1365,6 +1378,12 @@ def run_ingest_load(duration_s: float = 6.0, seed: int = 0,
         "batch_mean_size": (round(fused / dispatched, 2)
                             if dispatched else None),
         "dict_rebuilds": int(delta("stream.dict_rebuilds")),
+        "slo": {r["tenant"]: {
+            "freshness_objective_s": r["freshness_objective_s"],
+            "freshness_good": r["freshness_good"],
+            "freshness_breach": r["freshness_breach"],
+            "freshness_burn_rate": round(r["freshness_burn_rate"], 4),
+        } for r in slo_rows},
         "duration_s": round(wall, 2),
         "pool_drained": bool(summary["drained"]
                              and summary["pool_reserved_bytes"] == 0),

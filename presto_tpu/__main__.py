@@ -160,8 +160,8 @@ def repl(session, max_rows: int):
 
 def load_tenants(path):
     """Tenant config JSON -> (specs, total_slots). Accepts a bare list
-    of {name, weight?, max_concurrent?, max_bytes?} objects or
-    {"total_slots": N, "tenants": [...]}."""
+    of {name, weight?, max_concurrent?, max_bytes?, slo_latency_s?,
+    slo_freshness_s?} objects or {"total_slots": N, "tenants": [...]}."""
     import json
 
     from presto_tpu.server.scheduler import TenantSpec
@@ -175,10 +175,73 @@ def load_tenants(path):
         rows = cfg.get("tenants", [])
     specs = [
         TenantSpec(r["name"], float(r.get("weight", 1.0)),
-                   r.get("max_concurrent"), r.get("max_bytes"))
+                   r.get("max_concurrent"), r.get("max_bytes"),
+                   r.get("slo_latency_s"), r.get("slo_freshness_s"))
         for r in rows
     ]
     return specs, total
+
+
+def health_report(session) -> str:
+    """``python -m presto_tpu health``: a top-style plain-text snapshot
+    of serving health — device telemetry, the watchdog's latest vitals
+    and breach ledger, per-tenant SLO burn rates, and the heaviest
+    recent queries. Works on a bare session too (device and query
+    sections always render; watchdog/SLO sections say when absent)."""
+    from presto_tpu.runtime.devices import sample_devices
+
+    lines = ["== devices =="]
+    for d in sample_devices():
+        lines.append(
+            f"  device {d['device_id']} ({d['platform']}): "
+            f"in_use={d['bytes_in_use']} peak={d['peak_bytes']} "
+            f"limit={d['bytes_limit']} "
+            f"dispatch_wall={d['dispatch_wall_s']:.3f}s "
+            f"dispatches={d['dispatches']}")
+    lines.append("== health ==")
+    mon = getattr(session, "health", None)
+    if mon is None:
+        lines.append("  (no watchdog: attach a QueryServer, or "
+                     "health_monitor=false)")
+    else:
+        samples = mon.snapshot()
+        if samples:
+            last = samples[-1]
+            lines.append(
+                f"  qps={last['qps']:.2f} p50={last['p50_s']:.4f}s "
+                f"p99={last['p99_s']:.4f}s queue={last['queue_depth']} "
+                f"pool={last['pool_occupancy']:.0%} "
+                f"cache_hit={last['cache_hit_rate']:.0%} "
+                f"lag={last['freshness_lag_s']:.1f}s "
+                f"burn={last['slo_burn']:.2f}")
+        for b in mon.breaches():
+            lines.append(f"  BREACH [{b['reason']}] "
+                         f"p99={b['p99_s']:.4f}s "
+                         f"query={b.get('query_id', '-')}")
+    lines.append("== slo ==")
+    slo = getattr(session, "slo", None)
+    rows = slo.snapshot() if slo is not None else []
+    if not rows:
+        lines.append("  (no observations)")
+    for r in rows:
+        lines.append(
+            f"  {r['tenant']}: latency {r['latency_good']}/"
+            f"{r['latency_good'] + r['latency_breach']} good "
+            f"(burn={r['latency_burn_rate']:.2f}, "
+            f"objective={r['latency_objective_s']}s), freshness "
+            f"burn={r['freshness_burn_rate']:.2f}")
+    lines.append("== top queries (by execution_s) ==")
+    infos = sorted(session.history.infos(),
+                   key=lambda i: i.execution_s, reverse=True)[:10]
+    if not infos:
+        lines.append("  (no completed queries)")
+    for i in infos:
+        lines.append(
+            f"  {i.query_id} {i.state:>8} {i.execution_s:8.4f}s "
+            f"tenant={i.tenant or '-'} "
+            f"device_peak={i.device_peak_bytes} "
+            f"{' '.join(i.sql.split())[:60]}")
+    return "\n".join(lines)
 
 
 def serve(session, args) -> None:
@@ -248,7 +311,10 @@ def main(argv=None):
                          "captures and dumps any failure the statement "
                          "hits); 'serve' starts the multi-tenant HTTP "
                          "front-end (presto_tpu.server) on --port with "
-                         "graceful SIGINT drain")
+                         "graceful SIGINT drain; 'health' prints a "
+                         "top-style serving-health snapshot (devices, "
+                         "watchdog vitals, SLO burn, heaviest queries) "
+                         "after any -e/-f statements run")
     ap.add_argument("--catalog", default="tpch",
                     help="tpch | tpcds | ssb (default tpch)")
     ap.add_argument("--sf", type=float, default=0.01,
@@ -290,10 +356,11 @@ def main(argv=None):
     conn = make_connector(args.catalog, args.sf)
     session = Session({args.catalog: conn}, properties=props, mesh=mesh)
 
-    if args.command not in (None, "metrics", "flightrec", "serve"):
+    if args.command not in (None, "metrics", "flightrec", "serve",
+                            "health"):
         raise SystemExit(
             f"unknown command {args.command!r} "
-            "('metrics', 'flightrec', 'serve')")
+            "('metrics', 'flightrec', 'serve', 'health')")
     if args.command == "serve":
         return serve(session, args)
     ran = False
@@ -317,6 +384,11 @@ def main(argv=None):
         # (the REPL loop keeps the session alive through failures),
         # then every captured post-mortem dumps as JSON
         print(session.export_flight_record())
+        return
+    if args.command == "health":
+        # -e/-f statements run first, so the report reflects the
+        # workload just driven through this process
+        print(health_report(session))
         return
     if ran:
         return

@@ -60,6 +60,13 @@ SCHEMAS: dict[str, dict[str, DataType]] = {
         # stacked with concurrent same-template bindings into one
         # vmapped device program
         "batched": BIGINT,
+        # lanes in the vmapped dispatch this query rode (0 unbatched):
+        # the "who shared my device program" census per query
+        "batch_size": BIGINT,
+        # the continuous query that fired this execution ("" for ad-hoc
+        # statements) — joins refresh history back to system
+        # subscriptions by id
+        "subscription_id": fixed_bytes(32),
         # serving-layer tenant attribution ("" outside the front-end).
         # 48 bytes of UTF-8; names longer than that DO truncate in the
         # system tables (the scheduler and metric suffixes keep full
@@ -115,6 +122,9 @@ SCHEMAS: dict[str, dict[str, DataType]] = {
         "fragment_retries": BIGINT,
         "degraded": BIGINT,
         "spans": BIGINT,
+        # whether a TraceRecorder was live at capture: distinguishes
+        # "traced, zero spans" from "tracing off" (flight.py)
+        "trace_enabled": BIGINT,
         "metric_deltas": BIGINT,
         "hot_partitions": fixed_bytes(48),
         "execution_s": DOUBLE,
@@ -164,6 +174,51 @@ SCHEMAS: dict[str, dict[str, DataType]] = {
         "free_bytes": BIGINT,
         "active_queries": BIGINT,
         "queued_queries": BIGINT,
+    },
+    # live per-device telemetry (runtime/devices.py): allocator
+    # watermarks from jax Device.memory_stats() plus the process
+    # dispatch wall-clock ledger; rows appear on every backend (zeros
+    # where the platform reports no allocator stats, e.g. CPU)
+    "device_stats": {
+        "device_id": fixed_bytes(16),
+        "platform": fixed_bytes(16),
+        "bytes_in_use": BIGINT,
+        "peak_bytes": BIGINT,
+        "bytes_limit": BIGINT,
+        "dispatch_wall_s": DOUBLE,
+        "dispatches": BIGINT,
+    },
+    # per-tenant SLO objectives and rolling burn rates
+    # (runtime/health.py SloTracker, attached by a fronting
+    # QueryServer); empty outside the serving layer
+    "slo": {
+        "tenant": fixed_bytes(48),
+        "latency_objective_s": DOUBLE,
+        "freshness_objective_s": DOUBLE,
+        "latency_good": BIGINT,
+        "latency_breach": BIGINT,
+        "freshness_good": BIGINT,
+        "freshness_breach": BIGINT,
+        "latency_burn_rate": DOUBLE,
+        "freshness_burn_rate": DOUBLE,
+    },
+    # the health watchdog's vital-sign ring (runtime/health.py
+    # HealthMonitor), oldest first; breach rows carry reason codes
+    # ("p99,queue" etc.) and arm the flight recorder
+    "health": {
+        "ts": DOUBLE,
+        "qps": DOUBLE,
+        "p50_s": DOUBLE,
+        "p99_s": DOUBLE,
+        "queue_depth": BIGINT,
+        "pool_occupancy": DOUBLE,
+        "cache_hit_rate": DOUBLE,
+        "freshness_lag_s": DOUBLE,
+        "slo_burn": DOUBLE,
+        "breach": BIGINT,
+        # comma-joined reason codes; 24 bytes fits the full worst case
+        # ("p99,queue,burn,stale")
+        "reason": fixed_bytes(24),
     },
     # flattened span traces of recent queries (runtime/trace.py);
     # start_s is relative to the query's first span
@@ -256,6 +311,8 @@ class SystemConnector:
                 [int(i.template_hit) for i in infos],
                 [int(i.coalesced) for i in infos],
                 [int(i.batched) for i in infos],
+                [i.batch_size for i in infos],
+                [i.subscription_id for i in infos],
                 [i.tenant for i in infos],
                 [int(i.approximate) for i in infos],
                 [int(i.degraded) for i in infos],
@@ -308,6 +365,7 @@ class SystemConnector:
                 [r.fragment_retries for r in recs],
                 [int(r.degraded_to_local) for r in recs],
                 [len(r.spans) for r in recs],
+                [int(r.trace_enabled) for r in recs],
                 [len(r.metrics) for r in recs],
                 [",".join(str(p) for p in r.hot_partitions)
                  for r in recs],
@@ -376,6 +434,30 @@ class SystemConnector:
                 [str(d.id) for d in devs],
                 [d.platform for d in devs],
             )
+        if table == "device_stats":
+            from presto_tpu.runtime.devices import sample_devices
+
+            devs = sample_devices()
+            keys = ("device_id", "platform", "bytes_in_use",
+                    "peak_bytes", "bytes_limit", "dispatch_wall_s",
+                    "dispatches")
+            return tuple([d[k] for d in devs] for k in keys)
+        if table == "slo":
+            slo = getattr(self._session, "slo", None)
+            rows = slo.snapshot() if slo is not None else []
+            keys = ("tenant", "latency_objective_s",
+                    "freshness_objective_s", "latency_good",
+                    "latency_breach", "freshness_good",
+                    "freshness_breach", "latency_burn_rate",
+                    "freshness_burn_rate")
+            return tuple([r[k] for r in rows] for k in keys)
+        if table == "health":
+            mon = getattr(self._session, "health", None)
+            rows = mon.snapshot() if mon is not None else []
+            keys = ("ts", "qps", "p50_s", "p99_s", "queue_depth",
+                    "pool_occupancy", "cache_hit_rate",
+                    "freshness_lag_s", "slo_burn", "breach", "reason")
+            return tuple([r[k] for r in rows] for k in keys)
         raise KeyError(table)
 
     def scan_numpy(self, split: Split, columns=None) -> Mapping[str, np.ndarray]:
@@ -405,7 +487,8 @@ class SystemConnector:
             }
         elif table == "query_history":
             (qid, state, sql, tok, queued, planning, execution, elapsed,
-             outrows, retries, hits, tmpl, coal, batched, tenant, approx,
+             outrows, retries, hits, tmpl, coal, batched, bsize, subid,
+             tenant, approx,
              degraded, oomr, memq, ecode, rung, jstrat, fsel) = rows
             arrays = {
                 "query_id": _bytes_col(qid, 24),
@@ -422,6 +505,8 @@ class SystemConnector:
                 "template_hit": np.asarray(tmpl, np.int64),
                 "coalesced": np.asarray(coal, np.int64),
                 "batched": np.asarray(batched, np.int64),
+                "batch_size": np.asarray(bsize, np.int64),
+                "subscription_id": _bytes_col(subid, 32),
                 "tenant": _bytes_col(tenant, 48),
                 "approximate": np.asarray(approx, np.int64),
                 "degraded": np.asarray(degraded, np.int64),
@@ -451,7 +536,7 @@ class SystemConnector:
         elif table == "flight_recorder":
             (qid, state, sql, trig, ecode, rung, rungs, rungs_total,
              first_err, retries, degr,
-             spans, mdeltas, hot, execs, cap, poolb) = rows
+             spans, tron, mdeltas, hot, execs, cap, poolb) = rows
             arrays = {
                 "query_id": _bytes_col(qid, 24),
                 "state": STATE_DICT.encode(state).astype(np.int32),
@@ -465,6 +550,7 @@ class SystemConnector:
                 "fragment_retries": np.asarray(retries, np.int64),
                 "degraded": np.asarray(degr, np.int64),
                 "spans": np.asarray(spans, np.int64),
+                "trace_enabled": np.asarray(tron, np.int64),
                 "metric_deltas": np.asarray(mdeltas, np.int64),
                 "hot_partitions": _bytes_col(hot, 48),
                 "execution_s": np.asarray(execs, np.float64),
@@ -511,6 +597,47 @@ class SystemConnector:
                 "free_bytes": np.asarray(free, np.int64),
                 "active_queries": np.asarray(active, np.int64),
                 "queued_queries": np.asarray(queued, np.int64),
+            }
+        elif table == "device_stats":
+            did, plat, inuse, peak, limit, wall, disp = rows
+            arrays = {
+                "device_id": _bytes_col(did, 16),
+                "platform": _bytes_col(plat, 16),
+                "bytes_in_use": np.asarray(inuse, np.int64),
+                "peak_bytes": np.asarray(peak, np.int64),
+                "bytes_limit": np.asarray(limit, np.int64),
+                "dispatch_wall_s": np.asarray(wall, np.float64),
+                "dispatches": np.asarray(disp, np.int64),
+            }
+        elif table == "slo":
+            (tname, lobj, fobj, lgood, lbreach, fgood, fbreach, lburn,
+             fburn) = rows
+            arrays = {
+                "tenant": _bytes_col(tname, 48),
+                "latency_objective_s": np.asarray(lobj, np.float64),
+                "freshness_objective_s": np.asarray(fobj, np.float64),
+                "latency_good": np.asarray(lgood, np.int64),
+                "latency_breach": np.asarray(lbreach, np.int64),
+                "freshness_good": np.asarray(fgood, np.int64),
+                "freshness_breach": np.asarray(fbreach, np.int64),
+                "latency_burn_rate": np.asarray(lburn, np.float64),
+                "freshness_burn_rate": np.asarray(fburn, np.float64),
+            }
+        elif table == "health":
+            (ts, qps, p50, p99, depth, occ, hitr, lag, burn, breach,
+             reason) = rows
+            arrays = {
+                "ts": np.asarray(ts, np.float64),
+                "qps": np.asarray(qps, np.float64),
+                "p50_s": np.asarray(p50, np.float64),
+                "p99_s": np.asarray(p99, np.float64),
+                "queue_depth": np.asarray(depth, np.int64),
+                "pool_occupancy": np.asarray(occ, np.float64),
+                "cache_hit_rate": np.asarray(hitr, np.float64),
+                "freshness_lag_s": np.asarray(lag, np.float64),
+                "slo_burn": np.asarray(burn, np.float64),
+                "breach": np.asarray(breach, np.int64),
+                "reason": _bytes_col(reason, 24),
             }
         elif table == "trace_spans":
             (qid, sid, pid, name, cat, start, dur, nid, tok) = rows

@@ -112,6 +112,12 @@ class OomLadderMixin:
                 pass
         events = getattr(self, "spill_events", None)
         if events is not None:
+            from presto_tpu.runtime.devices import headroom_bytes
+
+            try:
+                headroom = headroom_bytes()
+            except Exception:  # noqa: BLE001 — telemetry never raises
+                headroom = None
             events.append({
                 "node": type(node).__name__,
                 "mode": decision.mode,
@@ -122,4 +128,10 @@ class OomLadderMixin:
                 "budget_bytes": int(decision.budget),
                 "host_bytes": int(host_bytes),
                 "oom_rung": int(self.oom_rung),
+                # live HBM headroom at decision time (-1 where the
+                # backend reports no allocator stats): whether the
+                # spill fired under real device-memory pressure rides
+                # into the flight record with the decision itself
+                "device_headroom_bytes": (-1 if headroom is None
+                                          else int(headroom)),
             })

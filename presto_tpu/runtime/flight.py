@@ -118,6 +118,10 @@ class FlightRecord:
     #: memory pool state at terminal time (reservation released —
     #: recording a post-mortem never holds pool capacity)
     pool: dict = field(default_factory=dict)
+    #: whether tracing was on for this query — distinguishes "traced
+    #: nothing" (enabled, zero spans) from "tracing off" (empty spans
+    #: carry no signal)
+    trace_enabled: bool = False
 
     def to_dict(self) -> dict:
         return {
@@ -145,6 +149,7 @@ class FlightRecord:
             "hotPartitions": _json_safe(self.hot_partitions),
             "spill": _json_safe(self.spill),
             "pool": _json_safe(self.pool),
+            "traceEnabled": self.trace_enabled,
         }
 
     def to_json(self) -> str:
@@ -183,11 +188,15 @@ class FlightRecorder:
 
     # ---- capture ---------------------------------------------------------
     def capture(self, info, plan, session, executor=None,
-                err=None, triggers=("requested",)) -> FlightRecord:
+                err=None, triggers=("requested",),
+                tracer=None) -> FlightRecord:
         """Build and retain one post-mortem. Called from run_plan's
         finally (runtime/lifecycle.py) with the metric delta already
         attributed onto ``info``; ``err`` is the in-flight exception on
-        the failure path (info.error is stamped later, upstream)."""
+        the failure path (info.error is stamped later, upstream).
+        ``tracer`` overrides the context-local recorder — the health
+        watchdog captures a query from OUTSIDE its driver thread, where
+        ``trace.current()`` would read the watchdog's (empty) context."""
         from presto_tpu.runtime import trace
         from presto_tpu.runtime.errors import error_code as _code
         from presto_tpu.runtime.errors import is_retryable
@@ -206,7 +215,9 @@ class FlightRecorder:
             )
         except Exception:  # noqa: BLE001 — a render bug must not eat
             render = "<plan render failed>"  # the rest of the record
-        spans, dropped = _flatten_spans(trace.current())
+        if tracer is None:
+            tracer = trace.current()
+        spans, dropped = _flatten_spans(tracer)
         pool = {}
         try:
             p = session.pool()
@@ -243,6 +254,7 @@ class FlightRecorder:
                 getattr(executor, "hot_partitions", ()) or ()),
             spill=list(getattr(executor, "spill_events", ()) or ()),
             pool=pool,
+            trace_enabled=tracer is not None,
         )
         with self._lock:
             self._ring.append(rec)

@@ -58,7 +58,9 @@ from presto_tpu.runtime.errors import (
     is_backend_oom,
     is_retryable,
 )
+from presto_tpu.runtime.devices import timed_dispatch
 from presto_tpu.runtime.metrics import REGISTRY
+from presto_tpu.runtime.trace import current as trace_current
 from presto_tpu.runtime.trace import span as trace_span
 
 #: cap on one exponential-backoff sleep (a retry loop must never turn
@@ -149,7 +151,9 @@ def run_fragment(label: str, fn: Callable[[], object]):
     if ctx is None:
         with trace_span(label, "fragment"):
             try:
-                return fn()
+                # the dispatch ledger (runtime/devices.py) attributes
+                # wall time to devices from this choke point
+                return timed_dispatch(fn)
             except Exception as e:
                 oom = _map_backend_oom(e, label)
                 if oom is not None:
@@ -164,7 +168,7 @@ def run_fragment(label: str, fn: Callable[[], object]):
                 label, "fragment",
                 {"attempt": attempt} if attempt else None,
             ), dispatch_h.time():
-                return fn()
+                return timed_dispatch(fn)
         except Exception as e:
             oom = _map_backend_oom(e, label)
             if oom is not None:
@@ -330,6 +334,13 @@ class QueryManager:
         from presto_tpu.server.batcher import TemplateBatchGate
 
         self.batch_gate = TemplateBatchGate()
+        #: live executions, query_id -> {info, executor, plan, tracer}
+        #: — the health watchdog's view of what is running RIGHT NOW
+        #: (it flight-records the worst entry on a breach; the tracer
+        #: is carried because trace.current() is context-local and the
+        #: watchdog samples from its own thread)
+        self._inflight_lock = threading.Lock()
+        self._inflight_queries: dict = {}
 
     # -- admission ------------------------------------------------------
     def admission_limit(self) -> int:
@@ -458,6 +469,11 @@ class QueryManager:
         pool = self.session.pool()
         delta = QueryMetricsDelta()
         delta_token = install_delta(delta)
+        with self._inflight_lock:
+            self._inflight_queries[info.query_id] = {
+                "info": info, "executor": executor, "plan": plan,
+                "tracer": trace_current(),
+            }
         err = None
         try:
             return self._run_admitted(executor, plan, info, recorder, pool)
@@ -465,8 +481,12 @@ class QueryManager:
             err = e
             raise
         finally:
+            with self._inflight_lock:
+                self._inflight_queries.pop(info.query_id, None)
             uninstall_delta(delta_token)
             info.attribute_metrics(delta.snapshot())
+            self._stamp_device_peak(info)
+            self._observe_slo(info, err)
             # flight recorder (runtime/flight.py): this is the ONE
             # choke point every executed query passes with its full
             # evidence in hand — attributed metrics, rung/retry
@@ -474,6 +494,38 @@ class QueryManager:
             # reservation already released (_run_admitted's finally),
             # so a post-mortem can never hold memory capacity
             self._maybe_flight_record(executor, plan, info, err)
+
+    def inflight_snapshot(self) -> "list[dict]":
+        """Shallow copies of the live execution entries (watchdog +
+        ``system.health`` consumers read outside the lock)."""
+        with self._inflight_lock:
+            return [dict(e) for e in self._inflight_queries.values()]
+
+    def _stamp_device_peak(self, info) -> None:
+        """Record the device HBM watermark on the finished query
+        (``device_telemetry`` property; zeros on CPU backends)."""
+        if not self.session.prop("device_telemetry"):
+            return
+        try:
+            from presto_tpu.runtime.devices import peak_bytes
+
+            info.device_peak_bytes = peak_bytes()
+        except Exception:  # noqa: BLE001 — telemetry never fails a query
+            pass
+
+    def _observe_slo(self, info, err) -> None:
+        """Feed the tenant SLO tracker (attached by the serving layer;
+        plain sessions have none). Failures count as latency breaches —
+        an erroring tenant is not meeting its objective."""
+        slo = getattr(self.session, "slo", None)
+        if slo is None:
+            return
+        try:
+            latency = (float("inf") if err is not None
+                       else info.execution_s)
+            slo.observe_latency(info.tenant or "default", latency)
+        except Exception:  # noqa: BLE001 — observability never fails a query
+            pass
 
     def _maybe_flight_record(self, executor, plan, info, err) -> None:
         """Capture a post-mortem when the run FAILED, DEGRADED (OOM

@@ -313,19 +313,184 @@ def _fmt(v: float) -> str:
     return str(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
 
 
-#: ``# HELP`` text per exposition family (post-prefix engine names) —
-#: emitted when known; families without an entry stay HELP-less
-#: (OpenMetrics allows it). Kept to the families whose meaning is not
-#: recoverable from the name alone.
+#: ``# HELP`` text per exposition family (post-prefix engine names).
+#: EVERY literal family the engine fires has an entry — enforced by
+#: tests/test_health.py's completeness check, which greps the source
+#: for literal ``REGISTRY.counter/timer/histogram("...")`` names.
+#: Dynamically-suffixed families (f-string names: per-tenant, per-
+#: trigger, per-reason, per-device) stay HELP-less (OpenMetrics
+#: allows it) — their prefix documents them here via the base family.
 METRIC_HELP: dict[str, str] = {
+    # ---- aggregation strategy picks
+    "agg.strategy.bypass": (
+        "aggregations answered straight from incremental table stats "
+        "(no scan dispatched)"),
+    "agg.strategy.fused": "aggregations fused into the scan kernel",
+    "agg.strategy.partial": (
+        "aggregations executed partial-per-fragment then merged"),
+    "agg.strategy.single": (
+        "aggregations executed single-stage on gathered rows"),
+    # ---- cross-query batched dispatch (server/batcher.py)
+    "batch.dispatched": "vmapped cross-query batch dispatches",
+    "batch.fallback": (
+        "batch members served by per-query fallback instead of the "
+        "vmapped program (reasons: batch.fallback.*)"),
+    "batch.fallback.distributed": (
+        "batch fallbacks because the template planned distributed"),
+    "batch.fallback.error": (
+        "batch fallbacks because the vmapped dispatch raised"),
+    "batch.gate_timeout": (
+        "batch-gate waits that timed out and ran solo"),
+    "batch.queries": "queries that entered the template batch gate",
+    "batch.served": (
+        "queries served a result from a cross-query batched dispatch"),
+    "batch.size": "lanes per dispatched cross-query batch",
+    "batch.trimmed": (
+        "batch members trimmed because the gate filled past the "
+        "vmap width"),
+    # ---- caches
+    "cache.result_lookup_s": "result-cache lookup latency",
+    "exec_cache.evicted": "compiled-executable cache evictions",
+    "exec_cache.hit": "compiled-executable cache hits",
+    "exec_cache.miss": "compiled-executable cache misses",
+    "exec_cache.uncacheable": (
+        "executables not cached (non-hashable or oversized keys)"),
+    "result_cache.evicted": "result-cache evictions",
+    "result_cache.hit": "result-cache hits (no execution dispatched)",
+    "result_cache.invalidated": (
+        "result-cache entries dropped by DDL/version invalidation"),
+    "result_cache.miss": "result-cache misses",
+    "result_cache.populated": "result-cache entries populated",
+    "result_cache.skipped": (
+        "result-cache lookups skipped (volatile scans or caching off)"),
+    "result_cache.uncacheable": (
+        "results not cached (oversized or non-deterministic)"),
+    "stats_cache.hit": "incremental table-stats cache hits",
+    "stats_cache.miss": "incremental table-stats cache misses",
+    "joinkeys.minmax_memo_hits": (
+        "join-key min/max pruning memo hits (plan_stats-backed)"),
+    # ---- events / listeners
+    "events.listener_errors": (
+        "query-event listener callbacks that raised (isolated; the "
+        "query is unaffected)"),
+    # ---- exchange
+    "exchange.bytes": "bytes moved through partitioned exchanges",
+    "exchange.dispatch_s": "partitioned-exchange dispatch latency",
+    "exchange.dispatches": "partitioned-exchange dispatches",
+    "exchange.rounds": "exchange rounds executed",
     "exchange.skew": (
         "max/mean delivered-rows-per-destination ratio of each "
         "partitioned exchange (1 = balanced)"),
     "exchange.quota_overflow": (
         "exchanges whose receive capacity overflowed (the hot "
         "partition id rides the trace span and flight record)"),
+    # ---- executor routes
+    "exec.leaf_fused_route": (
+        "leaf fragments routed through the fused scan kernel"),
+    "exec.leaf_route_fallback": (
+        "leaf fused-route bailouts to the general path (reasons: "
+        "exec.leaf_route_fallback.*)"),
+    "exec.pallas_join_route": "joins routed through the Pallas kernel",
+    "exec.q1_fused_route": (
+        "aggregation queries routed through the fused Q1-shape kernel"),
+    "exec.q1_route_fallback": (
+        "Q1-shape route bailouts to the general aggregation path"),
     "exec.traces": "actual jit traces executed (the no-retrace probe)",
+    "exec.trace_errors": (
+        "best-effort trace/observability plumbing failures (the "
+        "query is unaffected)"),
+    # ---- flight recorder
     "flight.captured": "flight-recorder post-mortems captured",
+    "flight.capture_errors": (
+        "flight-recorder captures that failed (capture is best-effort; "
+        "the query is unaffected)"),
+    # ---- fragments / lifecycle
+    "fragment.dispatch_s": "per-fragment dispatch latency",
+    "fragment.retried": "fragment dispatches retried after failure",
+    "query.admission_rejected": (
+        "queries rejected at memory-pool admission"),
+    "query.backend_oom": "backend out-of-memory errors observed",
+    "query.completed": "queries reaching a terminal state",
+    "query.deadline_exceeded": (
+        "queries killed by query_max_run_time"),
+    "query.degraded_to_local": (
+        "distributed plans degraded to local execution"),
+    "query.execution_s": "query execution latency (admitted -> done)",
+    "query.failed": "queries reaching FAILED",
+    "query.oom_degraded": (
+        "queries that finished only after OOM-ladder degradation"),
+    "query.retried": "whole-query retries",
+    "query.started": "queries admitted to execution",
+    # ---- health watchdog / SLOs (runtime/health.py)
+    "health.breach": (
+        "health-watchdog breaches fired (each arms the flight "
+        "recorder; reasons: health.breach.*)"),
+    "health.breach_no_inflight": (
+        "health breaches with no in-flight query to capture"),
+    "health.sample_errors": (
+        "health-watchdog sampling passes that raised (isolated)"),
+    "slo.good": "SLO observations within objective (all tenants)",
+    "slo.breach": "SLO observations over objective (all tenants)",
+    # ---- join strategy
+    "join.filter_rows_in": (
+        "probe rows entering join-pushdown filters"),
+    "join.filter_rows_pruned": (
+        "probe rows pruned by join-pushdown filters"),
+    "join.filter_selectivity": (
+        "observed selectivity of join-pushdown filters"),
+    "join.pallas_fallback": (
+        "Pallas join routes that fell back to the general kernel"),
+    # ---- memory pool
+    "memory.queue_timeouts": (
+        "pool admissions that timed out waiting for capacity"),
+    "memory.queued": "pool admissions that had to queue",
+    "memory.queued_s": "time spent queued for pool capacity",
+    "memory.rejected": "pool reservations rejected outright",
+    "memory.released": "pool reservations released",
+    "memory.reserved": "pool reservations granted",
+    # ---- plan stats
+    "plan_stats.evicted": "plan-stats fingerprints evicted",
+    "plan_stats.invalidated": (
+        "plan-stats fingerprints dropped by DDL/version invalidation"),
+    "plan_stats.record_errors": (
+        "plan-stats recording failures (isolated)"),
+    "plan_stats.recorded": "plan-stats runs recorded",
+    # ---- prepared statements / templates
+    "prepare.coalesced": (
+        "executions coalesced onto an identical in-flight run"),
+    "prepare.slot_ineligible": (
+        "literals not auto-templated into binding slots (reasons: "
+        "prepare.slot_ineligible.*)"),
+    "prepare.slots_bound": "template binding slots bound per execution",
+    "prepare.template_hit": (
+        "executions whose plan template was already compiled-warm"),
+    "prepare.template_queued": (
+        "executions that waited at the template batch gate"),
+    # ---- scan
+    "scan.splits_sampled_out": (
+        "table-scan splits skipped by approx-mode sampled scans "
+        "(approx_scan_fraction < 1; results flagged approximate)"),
+    # ---- serving front-end
+    "server.failed": "submitted statements reaching FAILED",
+    "server.shutdowns": "server shutdown/drain sequences run",
+    "server.started": "HTTP front-ends started",
+    "server.submit_rejected": (
+        "statement submissions rejected by the submit_limit "
+        "backpressure bound"),
+    "server.submitted": "statements accepted via submit()",
+    "tenant.admitted": "fair-scheduler slot admissions (all tenants)",
+    "tenant.over_quota_blocked": (
+        "admissions blocked on a tenant byte/concurrency quota"),
+    "tenant.overflow": (
+        "walk-in tenant names pooled into the __overflow__ lane "
+        "(max_tenants cardinality bound)"),
+    "tenant.queue_timeouts": "fair-queue waits that timed out",
+    "tenant.queued": "admissions that had to queue (all tenants)",
+    "tenant.queued_s": "time spent queued in the fair scheduler",
+    # ---- trace
+    "trace.spans_dropped": (
+        "spans dropped by per-query recorder ring bounds"),
+    # ---- live gauges (exported via Session.export_metrics)
     "memory_pool_reserved_bytes": (
         "bytes currently reserved from the session's memory pool"),
     "memory_pool_capacity_bytes": "capacity of the session's memory pool",
@@ -337,6 +502,14 @@ METRIC_HELP: dict[str, str] = {
     "flight_recorder_depth": (
         "post-mortem records currently retained in the session's "
         "flight-recorder ring"),
+    "health.ring_depth": "samples in the health watchdog's vitals ring",
+    "health.breaches": "breach events retained by the health watchdog",
+    "health.qps": "last-sampled completed-queries-per-second",
+    "health.p99_s": "last-sampled p99 execution latency",
+    "health.queue_depth": "last-sampled admission-queue depth",
+    "health.freshness_lag_s": (
+        "last-sampled worst subscription delivery lag"),
+    "health.slo_burn": "last-sampled worst tenant SLO burn rate",
     "spill.planned_hybrid": (
         "joins/aggregations planned as hybrid spill (hot partitions "
         "device-resident, cold ones streamed from host)"),
@@ -366,6 +539,7 @@ METRIC_HELP: dict[str, str] = {
         "unseen values (old codes remapped in place)"),
     "stream.append_s": (
         "append latency: encode + incremental stats merge + publish"),
+    "stream.tables_created": "streaming tables created",
     "subscription.fired": (
         "continuous-query refreshes delivered (initial, epoch-driven, "
         "and interval ticks — see subscription.trigger.*)"),
@@ -381,9 +555,8 @@ METRIC_HELP: dict[str, str] = {
     "subscription.refresh_s": (
         "continuous-query refresh latency: fire decision -> result "
         "delivered to the subscription's ring"),
-    "scan.splits_sampled_out": (
-        "table-scan splits skipped by approx-mode sampled scans "
-        "(approx_scan_fraction < 1; results flagged approximate)"),
+    "subscription.created": "continuous queries registered",
+    "subscription.cancelled": "continuous queries cancelled",
 }
 
 

@@ -413,6 +413,99 @@ SESSION_PROPERTIES: dict[str, PropertyDef] = {
             "(process-wide; default: on when running on TPU). Mirrors "
             "the PRESTO_TPU_PALLAS environment variable.",
         ),
+        PropertyDef(
+            "device_telemetry", bool, True,
+            "Sample per-device allocator stats (runtime/devices.py) at "
+            "query completion: stamps QueryInfo.device_peak_bytes and "
+            "feeds the system.device_stats table and device.* gauges. "
+            "Backends without memory_stats() (CPU) report zeros.",
+        ),
+        PropertyDef(
+            "slo_latency_objective_s", float, 1.0,
+            "Default per-tenant latency objective (seconds): a query "
+            "finishing slower counts against the tenant's SLO burn "
+            "rate (system.slo). Per-tenant overrides ride "
+            "TenantSpec.slo_latency_s.",
+            _positive,
+        ),
+        PropertyDef(
+            "slo_freshness_objective_s", float, 10.0,
+            "Default per-tenant subscription freshness objective "
+            "(seconds): a continuous-query refresh delivering staler "
+            "than this counts against the tenant's freshness burn "
+            "rate. Per-tenant overrides ride TenantSpec.slo_freshness_s.",
+            _positive,
+        ),
+        PropertyDef(
+            "slo_window", int, 256,
+            "Rolling observation window (per tenant, per objective "
+            "kind) over which SLO burn rates are computed.",
+            _positive,
+        ),
+        PropertyDef(
+            "health_monitor", bool, True,
+            "Arm the serving-tier anomaly watchdog "
+            "(runtime/health.py) when a QueryServer starts: a "
+            "background thread samples qps/p99/queue/pool/cache/"
+            "freshness into system.health and fires health_breach "
+            "events (plus a flight-recorder capture of the worst "
+            "in-flight query) on regressions.",
+        ),
+        PropertyDef(
+            "health_interval_s", float, 0.25,
+            "Watchdog sampling cadence (seconds).",
+            _positive,
+        ),
+        PropertyDef(
+            "health_ring", int, 128,
+            "Bounded ring of health snapshots retained (the "
+            "system.health table depth).",
+            _positive,
+        ),
+        PropertyDef(
+            "health_baseline_window", int, 8,
+            "Trailing samples forming the watchdog's baseline (median "
+            "p99 over this window is the regression reference).",
+            _positive,
+        ),
+        PropertyDef(
+            "health_min_samples", int, 3,
+            "Baseline samples (with observed latencies) required "
+            "before the p99 regression detector may fire — a cold "
+            "start must not breach on its first slow query.",
+            _positive,
+        ),
+        PropertyDef(
+            "health_p99_factor", float, 3.0,
+            "Breach when the current p99 exceeds this multiple of the "
+            "trailing-baseline p99.",
+            _positive,
+        ),
+        PropertyDef(
+            "health_queue_limit", int, 64,
+            "Breach when the admission queue holds more waiters than "
+            "this.",
+            _positive,
+        ),
+        PropertyDef(
+            "health_burn_limit", float, 0.5,
+            "Breach when any tenant's rolling SLO burn rate (breach "
+            "fraction) exceeds this.",
+            _positive,
+        ),
+        PropertyDef(
+            "health_stale_lag_s", float, 30.0,
+            "Breach when the worst subscription freshness lag exceeds "
+            "this many seconds.",
+            _positive,
+        ),
+        PropertyDef(
+            "health_cooldown_s", float, 5.0,
+            "Minimum seconds between health_breach firings (with the "
+            "clean-sample re-arm latch, one sustained incident fires "
+            "once, not once per sample).",
+            _non_negative,
+        ),
     ]
 }
 

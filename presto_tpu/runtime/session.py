@@ -53,6 +53,21 @@ CURRENT_TENANT: ContextVar[Optional[str]] = ContextVar(
     "presto_tpu_current_tenant", default=None
 )
 
+#: request-scoped trace context, set by the serving front-end around a
+#: submitted query's execution and by the subscription manager around
+#: each refresh fire. A mutable dict: {"token": trace token for the
+#: query's TraceRecorder (client-supplied X-Presto-Trace / W3C
+#: traceparent trace-id, or a subscription-scoped token),
+#: "subscription_id": continuous-query id ("" for ad-hoc),
+#: "force_trace": record spans even when the session-level
+#: trace_enabled property is off (a client that sent a traceparent
+#: asked to be traced), "query_id": written BACK by _run_tracked so
+#: the front-end can stitch its submit/poll spans onto the query's
+#: recorder after the fact}.
+REQUEST_TRACE: ContextVar[Optional[dict]] = ContextVar(
+    "presto_tpu_request_trace", default=None
+)
+
 
 def _ast_literal_value(node):
     """EXECUTE ... USING argument -> logical Python value (literals
@@ -154,6 +169,14 @@ class Session:
         #: when a QueryServer fronts this session) — the backing store
         #: of system.tenants; None outside the serving layer
         self.tenants = None
+        #: tenant SLO tracker (runtime/health.SloTracker, attached by
+        #: the serving layer) — the backing store of system.slo; None
+        #: outside the serving layer
+        self.slo = None
+        #: anomaly watchdog (runtime/health.HealthMonitor, armed by the
+        #: serving layer) — the backing store of system.health; None
+        #: outside the serving layer
+        self.health = None
         #: prepared statements (PREPARE name FROM ... / Session.prepare)
         self._prepared: dict[str, object] = {}
         #: plan templates this session has executed at least once —
@@ -604,6 +627,12 @@ class Session:
         (when ``trace_enabled``), result-cache lookup, events.
         ``bound`` is the plan template's slot-ordered (dtype, value)
         literal binding (empty for unparameterized plans)."""
+        # request-scoped trace context (serving front-end / subscription
+        # manager): the client's trace token overrides the session's,
+        # the subscription id rides into history attribution, and the
+        # query id flows BACK so the caller can stitch frontend spans
+        # onto this query's recorder post-hoc
+        rctx = REQUEST_TRACE.get()
         info = QueryInfo(
             query_id=f"q_{next(_query_seq)}_{uuid.uuid4().hex[:8]}",
             sql=sql,
@@ -611,17 +640,23 @@ class Session:
             created_at=time.time(),
             created_mono=time.monotonic(),
             planning_s=planning_s,
-            trace_token=self.trace_token,
+            trace_token=(rctx.get("token") if rctx else None)
+            or self.trace_token,
             # serving-layer attribution: request-scoped tenant first
             # (the front-end sets it around each client's execution),
             # then the session-level default property
             tenant=(CURRENT_TENANT.get() or self.prop("tenant") or ""),
+            subscription_id=(rctx.get("subscription_id", "")
+                             if rctx else ""),
         )
+        if rctx is not None:
+            rctx["query_id"] = info.query_id
         tracer = None
         token = None
-        if self.prop("trace_enabled"):
+        if self.prop("trace_enabled") or (rctx is not None
+                                          and rctx.get("force_trace")):
             tracer = TraceRecorder(
-                info.query_id, self.trace_token,
+                info.query_id, info.trace_token,
                 max_spans=self.prop("trace_max_spans"),
                 annotate=bool(self.prop("profile_annotations")),
             )
@@ -899,19 +934,31 @@ class Session:
                   or self.prop("admission_queue_timeout_s"))
         max_batch = int(self.prop("batch_max_size"))
         member = gate.enqueue(base_fp, bound)
+        # lane provenance: the leader's fused dispatch stamps one
+        # batch:lane span per member, carrying this origin — linking
+        # every vmapped lane back to the submission that enqueued it
+        member.origin = info.trace_token or info.query_id
         deadline = (None if wait_s is None
                     else time.monotonic() + float(wait_s))
+        gate_t0 = time.perf_counter()
         while True:
             remaining = (None if deadline is None
                          else max(0.0, deadline - time.monotonic()))
             role, payload = gate.lead_or_wait(base_fp, member, remaining,
                                               max_batch=max_batch)
+            if role != "retry":
+                # the batch-gate wait, visible in the trace between
+                # submit and dispatch (the serving-tier span chain)
+                trace.add_complete(
+                    "batch:gate_wait", "driver", gate_t0,
+                    time.perf_counter() - gate_t0, {"verdict": role})
             if role == "serve":
                 # a leader's batched dispatch computed this binding —
                 # same skip-the-lifecycle shape as a coalesced follower
                 # (the caller's FINISHED path still populates the
                 # result cache under THIS binding's fingerprint)
                 info.batched = True
+                info.batch_size = int(getattr(member, "batch_size", 0))
                 REGISTRY.counter("batch.served").add()
                 return payload
             if role == "timeout":
@@ -948,6 +995,9 @@ class Session:
                 if runner is not executor:
                     info.batched = bool(
                         getattr(runner, "dispatched_batch", False))
+                    if info.batched:
+                        info.batch_size = int(
+                            getattr(runner, "batch_size", 0))
                 return df
             finally:
                 gate.finish_lead(base_fp, member, members)
@@ -1048,6 +1098,22 @@ class Session:
             "exec_cache_entries": len(EXEC_CACHE),
             "flight_recorder_depth": len(self.flight),
         }
+        # serving-tier health gauges (ISSUE 18): per-device allocator
+        # state, tenant SLO burn rates, and the watchdog's latest
+        # sample — each best-effort, none may fail the scrape
+        if self.prop("device_telemetry"):
+            try:
+                from presto_tpu.runtime import devices
+
+                gauges.update(devices.gauges())
+            except Exception:  # noqa: BLE001
+                pass
+        for layer in (self.slo, self.health):
+            if layer is not None:
+                try:
+                    gauges.update(layer.gauges())
+                except Exception:  # noqa: BLE001
+                    pass
         text = to_openmetrics(gauges=gauges)
         if path is not None:
             with open(path, "w") as f:

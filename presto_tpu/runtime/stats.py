@@ -494,6 +494,16 @@ class QueryInfo:
     #: final OOM-ladder rung the successful attempt ran at, derived
     #: from the query's own ``query.oom_degraded`` delta (0 = no OOM)
     oom_rung: int = 0
+    #: max device HBM watermark observed at query completion
+    #: (runtime/devices.py; 0 on backends without allocator stats)
+    device_peak_bytes: int = 0
+    #: continuous-query id when this run was a subscription refresh
+    #: fire ("" for ad-hoc queries) — makes refreshes distinguishable
+    #: in system.query_history
+    subscription_id: str = ""
+    #: lanes in the vmapped batch this query rode (leader or served
+    #: member; 0 = not batched)
+    batch_size: int = 0
 
     def attribute_metrics(self, deltas: dict) -> None:
         """Fold a per-query metric-delta snapshot into this record:
@@ -579,6 +589,9 @@ class QueryInfo:
                 "joinStrategy": self.join_strategy,
                 "filterSelectivity": round(self.filter_selectivity, 6),
                 "oomRung": self.oom_rung,
+                "devicePeakBytes": self.device_peak_bytes,
+                "subscriptionId": self.subscription_id,
+                "batchSize": self.batch_size,
             }
         )
 

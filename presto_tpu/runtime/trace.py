@@ -53,6 +53,8 @@ CATEGORIES = (
     "lifecycle",  # admission / degradation
     "driver",     # the local driver push loop
     "stats",      # estimate snapshot / plan-stats history recording
+    "frontend",   # HTTP serving-tier spans (submit / poll round-trips)
+    "subscription",  # a continuous-query refresh fire (child of its sub)
 )
 
 _TRACE: ContextVar[Optional["TraceRecorder"]] = ContextVar(

@@ -41,12 +41,14 @@ Two pieces:
 from __future__ import annotations
 
 import threading
+import time
 from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 
 from presto_tpu.plan import nodes as N
+from presto_tpu.runtime import trace
 from presto_tpu.runtime.metrics import REGISTRY
 
 _UNSET = object()
@@ -238,7 +240,8 @@ def run_batched(catalog, plan: N.Output, bounds: Sequence[tuple],
 class _BatchMember:
     """One query waiting at a template's batch gate."""
 
-    __slots__ = ("bound", "event", "df", "served", "abandoned")
+    __slots__ = ("bound", "event", "df", "served", "abandoned",
+                 "origin", "batch_size")
 
     def __init__(self, bound: tuple):
         self.bound = bound
@@ -246,6 +249,15 @@ class _BatchMember:
         self.df = None
         self.served = False
         self.abandoned = False
+        #: trace provenance of the enqueuing submission (its trace
+        #: token or query id, stamped by the session) — the leader's
+        #: batch:lane spans carry it so every vmapped lane links back
+        #: to the query that enqueued it
+        self.origin = ""
+        #: lanes in the dispatch that served this member (stamped by
+        #: the leader; 0 until served) — QueryInfo.batch_size's source
+        #: for served members
+        self.batch_size = 0
 
 
 class TemplateBatchGate:
@@ -437,6 +449,8 @@ class BatchRunner:
         self._template_key = template_key
         self._attempted = False
         self.dispatched_batch = False
+        #: lanes in the dispatched batch (0 until a batch dispatches)
+        self.batch_size = 0
         #: admission-control multiplier (runtime/lifecycle.admit): the
         #: leader's pool reservation must cover every fused lane's
         #: state, not just its own binding's — conservative (lanes
@@ -459,6 +473,7 @@ class BatchRunner:
         if granted is not None and granted < len(batch):
             REGISTRY.counter("batch.trimmed").add()
             batch = batch[: max(1, int(granted))]
+        t0 = time.perf_counter()
         try:
             dfs = run_batched(self._executor.catalog, plan,
                               [m.bound for m in batch],
@@ -467,15 +482,26 @@ class BatchRunner:
             REGISTRY.counter("batch.fallback").add()
             REGISTRY.counter("batch.fallback.error").add()
             return self._executor.run(plan)
+        dur = time.perf_counter() - t0
         self.dispatched_batch = True
+        self.batch_size = len(batch)
         REGISTRY.counter("batch.dispatched").add()
         REGISTRY.counter("batch.queries").add(len(batch))
         REGISTRY.histogram("batch.size").add(len(batch))
         out = None
-        for m, df in zip(batch, dfs):
+        for i, (m, df) in enumerate(zip(batch, dfs)):
+            # lane provenance on the leader's trace: the fused dispatch
+            # covered the full batch window, and each lane names the
+            # submission (trace token / query id) whose binding it
+            # computed — the end-to-end linkage from a vmapped lane
+            # back to its originating HTTP submit or subscription fire
+            trace.add_complete(
+                "batch:lane", "driver", t0, dur,
+                {"lane": i, "origin": m.origin, "batch_size": len(batch)})
             if m is self._me:
                 out = df
             else:
+                m.batch_size = len(batch)
                 self._gate.serve(m, df)
         return out
 

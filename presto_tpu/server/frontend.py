@@ -27,6 +27,7 @@ import itertools
 import json
 import threading
 import time
+import uuid
 from typing import Mapping, Optional
 
 from presto_tpu.runtime.errors import PrestoError, UserError, error_code
@@ -34,6 +35,8 @@ from presto_tpu.runtime.metrics import REGISTRY
 from presto_tpu.server.scheduler import FairScheduler, TenantSpec
 
 _submit_seq = itertools.count(1)
+
+_HEX = frozenset("0123456789abcdef")
 
 
 def _df_payload(df) -> dict:
@@ -43,6 +46,48 @@ def _df_payload(df) -> dict:
         "data": json.loads(
             df.to_json(orient="values", date_format="iso")),
     }
+
+
+def _parse_traceparent(header: Optional[str]) -> Optional[str]:
+    """W3C ``traceparent`` -> its 32-hex trace-id, or None when the
+    header is absent or malformed. A bad header degrades to a
+    server-generated trace — it never rejects the statement (trace
+    plumbing must not be able to 400 a query)."""
+    if not header:
+        return None
+    parts = header.strip().lower().split("-")
+    if len(parts) < 4:
+        return None
+    version, trace_id, span_id = parts[0], parts[1], parts[2]
+    if (len(version) == 2 and set(version) <= _HEX
+            and len(trace_id) == 32 and set(trace_id) <= _HEX
+            and len(span_id) == 16 and set(span_id) <= _HEX
+            and trace_id != "0" * 32):
+        return trace_id
+    return None
+
+
+def _trace_context(token: Optional[str] = None,
+                   traceparent_id: Optional[str] = None,
+                   subscription_id: str = "",
+                   force: bool = False) -> dict:
+    """Build one REQUEST_TRACE context dict (runtime/session.py).
+
+    Token precedence: an explicit ``X-Presto-Trace`` token, then the
+    client traceparent's trace-id, then a fresh server-side id — a
+    client that supplied EITHER header gets its identifier honored end
+    to end. ``trace_id`` is what outgoing ``traceparent`` headers
+    carry: the client's trace-id when one arrived, else the token
+    itself when it happens to be 32-hex, else a new id."""
+    tok = token or traceparent_id or uuid.uuid4().hex
+    trace_id = traceparent_id
+    if trace_id is None:
+        low = tok.lower()
+        trace_id = (low if len(low) == 32 and set(low) <= _HEX
+                    else uuid.uuid4().hex)
+    return {"token": tok, "trace_id": trace_id,
+            "subscription_id": subscription_id,
+            "force_trace": bool(force)}
 
 
 class QueryServer:
@@ -64,6 +109,7 @@ class QueryServer:
                  query_record_limit: int = 256,
                  submit_limit: int = 128,
                  submit_timeout_s: float = 300.0):
+        from presto_tpu.runtime.health import HealthMonitor, SloTracker
         from presto_tpu.runtime.session import Session
         from presto_tpu.stream.subscriptions import SubscriptionManager
 
@@ -109,6 +155,41 @@ class QueryServer:
         self._approx_properties = dict(approx_properties or {})
         self._approx_session = None
         self._approx_lock = threading.Lock()
+        #: per-tenant SLO burn-rate tracking (runtime/health.py):
+        #: defaults come from the slo_* session properties, per-tenant
+        #: objectives from TenantSpec.slo_latency_s/slo_freshness_s;
+        #: run_plan observes latency, subscription delivery observes
+        #: freshness — both through ``session.slo``
+        session.slo = SloTracker(
+            latency_objective_s=float(
+                session.prop("slo_latency_objective_s")),
+            freshness_objective_s=float(
+                session.prop("slo_freshness_objective_s")),
+            window=int(session.prop("slo_window")),
+            overrides=self.scheduler.slo_overrides())
+        #: the anomaly watchdog (runtime/health.py): samples serving
+        #: vitals on its own thread, and on a breach arms the flight
+        #: recorder against the worst in-flight query. Built LAST so
+        #: every structure it samples (scheduler, subscriptions, slo)
+        #: already exists; ``health_monitor=False`` serves without it
+        self.health = None
+        if session.prop("health_monitor"):
+            self.health = HealthMonitor(
+                session, scheduler=self.scheduler,
+                subscriptions=self.subscriptions,
+                interval_s=float(session.prop("health_interval_s")),
+                ring=int(session.prop("health_ring")),
+                baseline_window=int(
+                    session.prop("health_baseline_window")),
+                min_samples=int(session.prop("health_min_samples")),
+                p99_factor=float(session.prop("health_p99_factor")),
+                queue_limit=int(session.prop("health_queue_limit")),
+                burn_limit=float(session.prop("health_burn_limit")),
+                stale_lag_s=float(session.prop("health_stale_lag_s")),
+                cooldown_s=float(session.prop("health_cooldown_s")))
+            self.health.start()
+        #: the registry behind system.health (connectors/system.py)
+        session.health = self.health
 
     # ---- lifecycle accounting -------------------------------------------
     def _enter(self, tenant: str):
@@ -209,14 +290,21 @@ class QueryServer:
                     if r["state"] in ("FINISHED", "FAILED")][:over]:
             del self._queries[qid]
 
-    def submit(self, sql: str, tenant: Optional[str] = None) -> str:
+    def submit(self, sql: str, tenant: Optional[str] = None,
+               trace: Optional[dict] = None) -> str:
         """Asynchronous submission; returns a server query id to poll.
         In-flight accounting happens HERE (not on the worker thread):
         an accepted query is part of the drain set immediately, so a
         shutdown between the accept and the worker's first instruction
         still waits for it. Submission is bounded by ``submit_limit``
         pending queries — beyond it, reject loudly instead of growing
-        one blocked thread per request."""
+        one blocked thread per request.
+
+        ``trace`` is a REQUEST_TRACE context dict (a client-supplied
+        ``traceparent``/``X-Presto-Trace``, parsed by the HTTP layer);
+        every submission gets one — a server-generated context when the
+        client sent none — so the engine-side trace token always links
+        back to the submission that caused it."""
         tenant = tenant or self.default_tenant
         with self._qlock:
             pending = sum(1 for r in self._queries.values()
@@ -227,24 +315,36 @@ class QueryServer:
                 f"server busy: {pending} submitted queries pending "
                 f"(submit_limit={self.submit_limit})")
         self._enter(tenant)  # raises while draining; worker leaves
+        if trace is None:
+            trace = _trace_context()
+        trace["t0"] = time.perf_counter()
         qid = f"srv_{next(_submit_seq)}"
         rec = {"id": qid, "tenant": tenant, "sql": sql, "state": "QUEUED",
                "df": None, "error": None, "error_code": None,
-               "submitted_at": time.time(), "done": threading.Event()}
+               "submitted_at": time.time(), "done": threading.Event(),
+               "trace": trace}
         with self._qlock:
             self._queries[qid] = rec
             self._retire_records_locked()
         REGISTRY.counter("server.submitted").add()
 
+        def on_start():
+            # QUEUED until the fair slot is actually held: scheduler
+            # starvation must be observable as QUEUED, not mislabeled
+            # RUNNING; the stamp also bounds the frontend:submit span
+            # (submit accept -> slot held = admission wait)
+            trace["started_pc"] = time.perf_counter()
+            rec["state"] = "RUNNING"
+
         def work():
+            from presto_tpu.runtime.session import REQUEST_TRACE
+
+            token = REQUEST_TRACE.set(trace)
             try:
                 rec["df"] = self._execute_admitted(
                     lambda: self.session.sql(sql), tenant,
                     timeout_s=self.submit_timeout_s,
-                    # QUEUED until the fair slot is actually held:
-                    # scheduler starvation must be observable as
-                    # QUEUED, not mislabeled RUNNING
-                    on_start=lambda: rec.__setitem__("state", "RUNNING"))
+                    on_start=on_start)
                 rec["state"] = "FINISHED"
             except Exception as e:  # noqa: BLE001 — reported to the client
                 rec["state"] = "FAILED"
@@ -254,6 +354,7 @@ class QueryServer:
                                      else "INTERNAL")
                 REGISTRY.counter("server.failed").add()
             finally:
+                REQUEST_TRACE.reset(token)
                 rec["done"].set()
                 self._leave()
 
@@ -269,7 +370,12 @@ class QueryServer:
 
     def poll(self, qid: str) -> dict:
         """Current state page for a submitted query (terminal pages
-        carry results or the typed error)."""
+        carry results or the typed error). The first terminal poll
+        stitches the frontend spans (submit wait, this poll) onto the
+        query's own trace recorder — the end-to-end export then reads
+        submit -> admission -> gate wait -> dispatch -> poll as one
+        linked trace."""
+        poll_t0 = time.perf_counter()
         with self._qlock:
             rec = self._queries.get(qid)
         if rec is None:
@@ -286,7 +392,58 @@ class QueryServer:
         elif rec["state"] == "FAILED":
             page["error"] = rec["error"]
             page["errorCode"] = rec["error_code"]
+        if rec["state"] in ("FINISHED", "FAILED"):
+            self._stitch_frontend_spans(rec, poll_t0)
         return page
+
+    def _stitch_frontend_spans(self, rec: dict, poll_t0: float) -> None:
+        """Append the frontend-side spans to the query's trace recorder
+        (once, on the first terminal poll). Post-hoc by design: the
+        engine-side recorder exists only after the worker ran, and the
+        submit wait is only known once the slot was held. Best-effort —
+        trace plumbing must never fail a poll."""
+        trace_ctx = rec.get("trace")
+        if not trace_ctx or trace_ctx.get("frontend_spans_done"):
+            return
+        engine_qid = trace_ctx.get("query_id")
+        if not engine_qid:  # worker never reached the session
+            return
+        try:
+            tracer = self.session.traces.for_query(engine_qid)
+        except Exception:  # noqa: BLE001 — observability-only path
+            tracer = None
+        if tracer is None:  # tracing off for this query
+            return
+        trace_ctx["frontend_spans_done"] = True
+        try:
+            t0 = trace_ctx["t0"]
+            started = trace_ctx.get("started_pc", t0)
+            tracer.add_complete(
+                "frontend:submit", "frontend", t0,
+                max(0.0, started - t0),
+                {"queryId": rec["id"], "tenant": rec["tenant"],
+                 "traceToken": trace_ctx["token"]})
+            tracer.add_complete(
+                "frontend:poll", "frontend", poll_t0,
+                time.perf_counter() - poll_t0,
+                {"queryId": rec["id"], "state": rec["state"]})
+        except Exception:  # noqa: BLE001 — observability-only path
+            REGISTRY.counter("exec.trace_errors").add()
+
+    def trace_info(self, qid: str) -> dict:
+        """Outgoing trace headers for a submitted query: the honored
+        (or server-assigned) ``X-Presto-Trace`` token plus a W3C
+        ``traceparent`` carrying the query's trace-id under a fresh
+        server span-id — what the HTTP layer echoes on the 201 and on
+        every poll page."""
+        with self._qlock:
+            rec = self._queries.get(qid)
+        trace_ctx = (rec or {}).get("trace")
+        if not trace_ctx:
+            return {}
+        span_id = uuid.uuid4().hex[:16]
+        return {"X-Presto-Trace": trace_ctx["token"],
+                "traceparent": f"00-{trace_ctx['trace_id']}-{span_id}-01"}
 
     def result(self, qid: str, timeout_s: Optional[float] = None):
         """Block until a submitted query finishes; returns the frame
@@ -363,8 +520,12 @@ class QueryServer:
         state, so a clean drain leaves the pool empty) and optionally
         flush the flight-recorder ring to ``flight_path``. Continuous
         queries cancel FIRST — their in-flight refreshes hold ordinary
-        in-flight accounting, so the drain wait below covers them."""
+        in-flight accounting, so the drain wait below covers them. The
+        health watchdog stops before anything it samples is torn
+        down."""
         deadline = time.monotonic() + drain_timeout_s
+        if self.health is not None:
+            self.health.close()
         self.subscriptions.close()
         with self._drain_cv:
             self._accepting = False
@@ -408,9 +569,14 @@ class HttpFrontend:
     Routes::
 
         POST /v1/statement           body = SQL text; 200 -> {id, state,
-                                     nextUri}; tenant via X-Presto-Tenant
+                                     nextUri}; tenant via X-Presto-Tenant;
+                                     a client ``traceparent`` (W3C) or
+                                     ``X-Presto-Trace`` token is honored
+                                     end to end and echoed back on the
+                                     response headers
         GET  /v1/statement/<id>      poll page (FINISHED pages carry
-                                     {columns, data})
+                                     {columns, data}); echoes the trace
+                                     headers of the submission
         POST /v1/prepared            JSON {action: prepare|execute|
                                      deallocate, name, sql?, params?}
         POST /v1/subscribe           JSON {sql, mode?, intervalS?};
@@ -437,12 +603,15 @@ class HttpFrontend:
             def log_message(self, fmt, *args):  # quiet by default
                 pass
 
-            def _send(self, code: int, payload, ctype="application/json"):
+            def _send(self, code: int, payload, ctype="application/json",
+                      headers=None):
                 body = (payload if isinstance(payload, bytes)
                         else json.dumps(payload, default=str).encode())
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
+                for name, value in (headers or {}).items():
+                    self.send_header(name, value)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -450,6 +619,19 @@ class HttpFrontend:
                 return (self.headers.get("X-Presto-Tenant")
                         or self.headers.get("X-Presto-User")
                         or qserver.default_tenant)
+
+            def _trace_ctx(self):
+                """REQUEST_TRACE context from the client's trace
+                headers, or None when it sent none. A client that
+                supplied either header opted into tracing — the query
+                runs with a recorder even when the session-wide
+                ``trace_enabled`` property is off."""
+                token = self.headers.get("X-Presto-Trace")
+                tp_id = _parse_traceparent(self.headers.get("traceparent"))
+                if token is None and tp_id is None:
+                    return None
+                return _trace_context(token=token, traceparent_id=tp_id,
+                                      force=True)
 
             def _body(self) -> bytes:
                 n = int(self.headers.get("Content-Length") or 0)
@@ -467,7 +649,9 @@ class HttpFrontend:
                         return
                     if self.path.startswith("/v1/statement/"):
                         qid = self.path.rsplit("/", 1)[1]
-                        self._send(200, qserver.poll(qid))
+                        page = qserver.poll(qid)
+                        self._send(200, page,
+                                   headers=qserver.trace_info(qid))
                         return
                     if self.path.startswith("/v1/subscription/"):
                         sid = self.path.rsplit("/", 1)[1]
@@ -483,11 +667,12 @@ class HttpFrontend:
                 try:
                     if self.path == "/v1/statement":
                         sql = self._body().decode("utf-8")
-                        qid = qserver.submit(sql, self._tenant())
+                        qid = qserver.submit(sql, self._tenant(),
+                                             trace=self._trace_ctx())
                         self._send(201, {
                             "id": qid, "state": "QUEUED",
                             "nextUri": f"/v1/statement/{qid}",
-                        })
+                        }, headers=qserver.trace_info(qid))
                         return
                     if self.path == "/v1/prepared":
                         try:

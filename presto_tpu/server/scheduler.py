@@ -73,10 +73,19 @@ class TenantSpec:
     weight: float = 1.0
     max_concurrent: Optional[int] = None
     max_bytes: Optional[int] = None
+    #: per-tenant SLO objectives consumed by ``runtime/health.py``'s
+    #: SloTracker; ``None`` falls through to the session-wide
+    #: ``slo_latency_objective_s`` / ``slo_freshness_objective_s``
+    slo_latency_s: Optional[float] = None
+    slo_freshness_s: Optional[float] = None
 
     def __post_init__(self):
         if self.weight <= 0:
             raise ValueError(f"tenant {self.name!r}: weight must be > 0")
+        for f in ("slo_latency_s", "slo_freshness_s"):
+            v = getattr(self, f)
+            if v is not None and v <= 0:
+                raise ValueError(f"tenant {self.name!r}: {f} must be > 0")
 
 
 class _TenantState:
@@ -173,7 +182,9 @@ class FairScheduler:
                 return tenant
         s = TenantSpec(tenant, self.default_spec.weight,
                        self.default_spec.max_concurrent,
-                       self.default_spec.max_bytes)
+                       self.default_spec.max_bytes,
+                       self.default_spec.slo_latency_s,
+                       self.default_spec.slo_freshness_s)
         self._specs[tenant] = s
         self._states.setdefault(tenant, _TenantState())
         return tenant
@@ -329,6 +340,22 @@ class FairScheduler:
         self._pool_listener = None
 
     # ---- observability ---------------------------------------------------
+    def queue_depth(self) -> int:
+        """Waiters currently queued for a slot — the admission-queue
+        growth signal the health watchdog samples."""
+        with self._cv:
+            return len(self._waiters)
+
+    def slo_overrides(self) -> "dict[str, tuple]":
+        """Per-tenant SLO objective overrides for the SloTracker:
+        ``{tenant: (latency_s | None, freshness_s | None)}`` for every
+        registered tenant that declares at least one objective."""
+        with self._cv:
+            return {name: (spec.slo_latency_s, spec.slo_freshness_s)
+                    for name, spec in self._specs.items()
+                    if spec.slo_latency_s is not None
+                    or spec.slo_freshness_s is not None}
+
     def describe(self) -> str:
         with self._cv:
             return (f"{self._running_total} running, "

@@ -266,6 +266,9 @@ class SubscriptionManager:
                 "fired": {}, "pending": True, "inflight": False,
                 "next_tick": (time.monotonic() + interval_s
                               if interval_s else None),
+                # freshness baseline: creation counts as "delivered" so
+                # lag measures refresh progress, not subscription age
+                "delivered_mono": time.monotonic(),
             }
             for conn in sources.values():
                 if id(conn) not in self._hooked:
@@ -305,6 +308,25 @@ class SubscriptionManager:
         with self._lock:
             subs = list(self._subs.values())
         return [s.page() for s in subs]
+
+    def max_lag_s(self) -> float:
+        """Worst delivery lag across ACTIVE subscriptions: seconds
+        since the last delivery for any subscription with
+        due-but-undelivered work (a pending or in-flight refresh).
+        Idle subscriptions carry no lag — an unchanged table is not
+        stale. 0.0 with no subscriptions. This is the freshness signal
+        the health watchdog samples (runtime/health.py)."""
+        now = time.monotonic()
+        worst = 0.0
+        with self._lock:
+            for sid, sub in self._subs.items():
+                sched = self._sched[sid]
+                if sub.state != "ACTIVE":
+                    continue
+                if not (sched["pending"] or sched["inflight"]):
+                    continue
+                worst = max(worst, now - sched.get("delivered_mono", now))
+        return worst
 
     def close(self) -> None:
         """Stop the notifier and cancel every subscription (the
@@ -395,7 +417,15 @@ class SubscriptionManager:
     # ---- refresh execution -----------------------------------------------
     def _fire(self, sub: ContinuousQuery, sched: dict,
               epochs: "dict[str, int]", trigger: str) -> None:
+        from presto_tpu.runtime.session import REQUEST_TRACE
+
         server = self._server
+        #: links the refresh execution back to its subscription: the
+        #: query runs with trace token ``sub:<id>`` and a stamped
+        #: subscription_id (-> system.query_history), and writes its
+        #: engine query id back for the post-hoc fire span below
+        trace_ctx = {"token": f"sub:{sub.id}", "trace_id": "",
+                     "subscription_id": sub.id, "force_trace": False}
         try:
             t0 = time.perf_counter()
             try:
@@ -407,10 +437,14 @@ class SubscriptionManager:
                 return
             try:
                 try:
-                    df, info = server._execute_admitted(
-                        lambda: sched["session"].execute_prepared(
-                            sched["key"], []),
-                        sub.tenant, timeout_s=server.submit_timeout_s)
+                    rt_token = REQUEST_TRACE.set(trace_ctx)
+                    try:
+                        df, info = server._execute_admitted(
+                            lambda: sched["session"].execute_prepared(
+                                sched["key"], []),
+                            sub.tenant, timeout_s=server.submit_timeout_s)
+                    finally:
+                        REQUEST_TRACE.reset(rt_token)
                 finally:
                     server._leave()
             except PrestoError as e:
@@ -433,6 +467,29 @@ class SubscriptionManager:
             sub._deliver(df=df, epochs=epochs, trigger=trigger,
                          approximate=bool(info.approximate),
                          batched=bool(info.batched), refresh_s=dt)
+            with self._lock:
+                if sub.id in self._sched:
+                    sched["delivered_mono"] = time.monotonic()
+            try:
+                # child span on the refresh query's own recorder: the
+                # fire (enter -> admitted -> delivered) wraps the
+                # engine-side spans, so a trace export reads the
+                # subscription wake as the parent of the execution
+                if trace_ctx.get("query_id"):
+                    tracer = sched["session"].traces.for_query(
+                        trace_ctx["query_id"])
+                    if tracer is not None:
+                        tracer.add_complete(
+                            "subscription:fire", "subscription", t0, dt,
+                            {"subscriptionId": sub.id, "trigger": trigger,
+                             "tenant": sub.tenant})
+                slo = getattr(server.session, "slo", None)
+                if slo is not None:
+                    # the delivered refresh IS the freshness sample:
+                    # fire-to-delivery wall time vs the objective
+                    slo.observe_freshness(sub.tenant, dt)
+            except Exception:  # noqa: BLE001 — observability-only path
+                REGISTRY.counter("exec.trace_errors").add()
             REGISTRY.counter("subscription.fired").add()
             REGISTRY.counter(f"subscription.trigger.{trigger}").add()
             REGISTRY.histogram("subscription.refresh_s").add(dt)

@@ -96,3 +96,24 @@ def _global_state_guard(request):
         raise AssertionError(
             "process-global state leak (declare deliberate wipes with "
             "@pytest.mark.resets_global_state): " + "; ".join(leaks))
+
+
+@pytest.fixture(autouse=True)
+def _health_watchdog_leak_check():
+    """Watchdog-thread invariant, enforced suite-wide: every
+    HealthMonitor started during a test must be closed before the test
+    ends (QueryServer.shutdown closes its own; a hand-built monitor
+    owns its close()). A leaked sampler thread keeps firing against
+    torn-down sessions and bleeds metrics into later tests. Leaked
+    monitors are closed here before failing, so one offender does not
+    cascade."""
+    yield
+    from presto_tpu.runtime.health import live_monitors
+
+    leaked = live_monitors()
+    for mon in leaked:
+        mon.close()
+    assert not leaked, (
+        f"{len(leaked)} health watchdog thread(s) leaked — close the "
+        "HealthMonitor (or shut down its QueryServer) before the test "
+        "ends")

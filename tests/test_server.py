@@ -349,7 +349,8 @@ def test_tenant_attribution_and_system_tables():
     qs = QueryServer({"tpch": CONN},
                      tenants=[TenantSpec("ana", weight=2.0),
                               TenantSpec("bot", max_concurrent=2)],
-                     properties={"result_cache_enabled": False})
+                     properties={"result_cache_enabled": False,
+                                 "health_monitor": False})
     qs.execute("select count(*) c from orders", tenant="ana")
     qs.execute("select count(*) c from lineitem", tenant="bot")
     hist = qs.session.sql(
@@ -370,7 +371,8 @@ def test_server_prepared_surface_and_submit_poll():
     from presto_tpu.runtime.errors import UserError
 
     qs = QueryServer({"tpch": CONN},
-                     properties={"result_cache_enabled": False})
+                     properties={"result_cache_enabled": False,
+                                 "health_monitor": False})
     name = qs.prepare("select count(*) c from orders where o_orderkey < ?",
                       tenant="ana")
     a = qs.execute_prepared(name, [512], tenant="ana")
@@ -397,7 +399,8 @@ def test_server_shutdown_drains_and_refuses_new_work():
     from presto_tpu.runtime.errors import UserError
 
     qs = QueryServer({"tpch": CONN},
-                     properties={"result_cache_enabled": False})
+                     properties={"result_cache_enabled": False,
+                                 "health_monitor": False})
     qs.execute("select count(*) c from orders")
     summary = qs.shutdown(drain_timeout_s=10)
     assert summary["drained"]
@@ -411,7 +414,8 @@ def test_server_shutdown_drains_and_refuses_new_work():
 def test_http_round_trip():
     qs = QueryServer({"tpch": CONN},
                      tenants=[TenantSpec("web", weight=2.0)],
-                     properties={"result_cache_enabled": False})
+                     properties={"result_cache_enabled": False,
+                                 "health_monitor": False})
     http = HttpFrontend(qs, port=0).start_background()
     base = f"http://127.0.0.1:{http.port}"
     try:
@@ -494,7 +498,8 @@ def test_server_submit_limit_rejects_floods():
     from presto_tpu.runtime.errors import UserError
 
     qs = QueryServer({"tpch": CONN}, submit_limit=1,
-                     properties={"result_cache_enabled": False})
+                     properties={"result_cache_enabled": False,
+                                 "health_monitor": False})
     # saturate the single pending slot with a record stuck QUEUED
     qs._queries["stuck"] = {"state": "QUEUED"}
     with pytest.raises(UserError):
@@ -525,7 +530,8 @@ def test_submitted_query_polls_queued_while_scheduler_starved():
     QUEUED (not RUNNING) until the fair slot is actually held."""
     qs = QueryServer({"tpch": CONN},
                      tenants=[TenantSpec("t", max_concurrent=1)],
-                     properties={"result_cache_enabled": False})
+                     properties={"result_cache_enabled": False,
+                                 "health_monitor": False})
     token = qs.scheduler.acquire("t")  # hold the tenant's only slot
     try:
         qid = qs.submit("select count(*) c from orders", tenant="t")
@@ -551,5 +557,6 @@ def test_batched_dispatch_off_by_default_for_embedded_sessions():
     opt-in) turns it on."""
     s = make_session()
     assert s.prop("batched_dispatch") is False
-    qs = QueryServer({"tpch": CONN})
+    qs = QueryServer({"tpch": CONN},
+                     properties={"health_monitor": False})
     assert qs.session.prop("batched_dispatch") is True

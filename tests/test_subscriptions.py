@@ -42,7 +42,9 @@ def counter(name: str) -> float:
 
 def make_server(**kwargs):
     conn = MemoryConnector()
-    s = Session({"memory": conn}, properties={"batched_dispatch": True})
+    s = Session({"memory": conn},
+                properties={"batched_dispatch": True,
+                            "health_monitor": False})
     return conn, s, QueryServer(session=s, **kwargs)
 
 
@@ -289,7 +291,9 @@ def test_approx_subscription_sampled_scan_flagged():
     """``approx_scan_fraction`` < 1 in the approx tier: refreshes scan
     a strided subset of splits and are flagged approximate."""
     conn = MemoryConnector(units_per_split=64)
-    s = Session({"memory": conn}, properties={"batched_dispatch": True})
+    s = Session({"memory": conn},
+                properties={"batched_dispatch": True,
+                            "health_monitor": False})
     server = QueryServer(session=s,
                          approx_properties={"approx_scan_fraction": 0.25})
     w = StreamWriter(s)
@@ -312,7 +316,9 @@ def test_exact_and_approx_subscriptions_never_share_cache():
     """Fingerprints fold the approx knobs: the same SQL subscribed in
     both modes never serves one tier's frame to the other."""
     conn = MemoryConnector(units_per_split=64)
-    s = Session({"memory": conn}, properties={"batched_dispatch": True})
+    s = Session({"memory": conn},
+                properties={"batched_dispatch": True,
+                            "health_monitor": False})
     server = QueryServer(session=s,
                          approx_properties={"approx_scan_fraction": 0.25})
     w = StreamWriter(s)
