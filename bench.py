@@ -1390,6 +1390,119 @@ def run_ingest_load(duration_s: float = 6.0, seed: int = 0,
     }
 
 
+def run_overload_ab(duration_s: float = 5.0, seed: int = 0,
+                    sf: float = 0.002, clients: int = 6,
+                    deadline_s: float = 2.0) -> dict:
+    """Overload A/B (ISSUE 19): the same ~4x-over-capacity submit storm
+    against one serving slot with load shedding ON (queue ceilings +
+    the EWMA drain rule) vs OFF. ``clients`` threads submit varied-
+    literal statements carrying a ``deadline_s`` request deadline as
+    fast as the server accepts them — several times what one slot
+    drains. Goodput counts only queries that FINISHED within their
+    deadline; everything else must be typed (a shed 429, a deadline
+    expiry, never an untyped failure). The shedding server refuses the
+    backlog it cannot drain, so its admitted queries keep their
+    deadlines — goodput and tail latency at least hold, and the
+    refusals are honest retryable hints instead of queued death."""
+    import random
+    import threading as _th
+    import time as _t
+
+    from presto_tpu.connectors.tpch import TpchConnector
+    from presto_tpu.runtime.errors import ServerOverloaded
+    from presto_tpu.server.frontend import QueryServer
+
+    fmt = ("select count(*) c, sum(l_quantity) q from lineitem "
+           "where l_extendedprice < {}")
+
+    def arm(shed_on: bool) -> dict:
+        # ceilings sized to the drainable backlog: with one slot and a
+        # ``deadline_s`` budget, a queue deeper than a few entries is
+        # already un-drainable — cap it there, and let the EWMA drain
+        # rule tighten further as measured per-query cost rises
+        srv = QueryServer(
+            {"tpch": TpchConnector(sf=sf)}, total_slots=1,
+            shed_queue_limit=(max(2, clients // 2) if shed_on else None),
+            shed_tenant_queue_limit=(max(1, clients // 3)
+                                     if shed_on else None),
+            shed_drain_limit_s=(deadline_s if shed_on else None),
+            properties={"health_monitor": False,
+                        "result_cache_enabled": False,
+                        "retry_backoff_s": 0.0})
+        srv.execute(fmt.format(1000))  # warm the template executable
+        lat: list = []
+        shed = [0]
+        expired = [0]
+        untyped: list = []
+        stop = _t.monotonic() + duration_s
+
+        def client(cid: int):
+            rng = random.Random(seed * 1000 + cid)
+            while _t.monotonic() < stop:
+                sql = fmt.format(rng.randint(900, 90000))
+                t0 = _t.perf_counter()
+                try:
+                    qid = srv.submit(sql, tenant=f"c{cid % 3}",
+                                     deadline_s=deadline_s)
+                except ServerOverloaded as e:
+                    shed[0] += 1
+                    _t.sleep(min(e.retry_after_s, 0.25))
+                    continue
+                except Exception as e:  # noqa: BLE001 — contract probe
+                    untyped.append(f"{type(e).__name__}: {e}")
+                    continue
+                srv._queries[qid]["done"].wait(120)
+                page = srv.poll(qid)
+                took = _t.perf_counter() - t0
+                if page["state"] == "FINISHED" and took <= deadline_s:
+                    lat.append(took)
+                elif page["state"] == "FAILED":
+                    code = page.get("errorCode")
+                    if not code or code == "INTERNAL":
+                        untyped.append(str(page.get("error")))
+                    elif code == "EXCEEDED_TIME_LIMIT":
+                        expired[0] += 1
+
+        threads = [_th.Thread(target=client, args=(i,), daemon=True)
+                   for i in range(clients)]
+        t_start = _t.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        wall = _t.perf_counter() - t_start
+        summary = srv.shutdown(drain_timeout_s=30)
+        ls = sorted(lat)
+        return {
+            "goodput_queries_per_sec": (round(len(ls) / wall, 2)
+                                        if wall > 0 else 0.0),
+            "completed_in_deadline": len(ls),
+            "shed": shed[0],
+            "deadline_expired": expired[0],
+            "untyped_failures": untyped,
+            "latency_p50_ms": round(_pctl(ls, 0.50) * 1e3, 2),
+            "latency_p99_ms": round(_pctl(ls, 0.99) * 1e3, 2),
+            "duration_s": round(wall, 2),
+            "pool_drained": bool(summary["drained"]
+                                 and summary["pool_reserved_bytes"] == 0),
+        }
+
+    return {"off": arm(False), "on": arm(True),
+            "clients": clients, "deadline_s": deadline_s}
+
+
+def bench_overload_ab(extra: dict) -> None:
+    """The overload-control A/B record beside the sustained-load
+    numbers: shed-on vs shed-off goodput and p99 under the same 4x
+    storm, regression-gated like the rest."""
+    ab = run_overload_ab(duration_s=5.0, seed=5, sf=0.002)
+    for side in ("off", "on"):
+        assert not ab[side]["untyped_failures"], ab[side]
+        assert ab[side]["pool_drained"], f"overload {side} leaked pool"
+    assert ab["on"]["shed"] > 0, "storm never tripped the shed ceilings"
+    extra["overload_ab"] = ab
+
+
 def bench_sustained_load(extra: dict) -> None:
     """The sustained-load observability record (first-class ``metrics``
     entries beside the kernel rates): fair-weather queries/sec + tail
@@ -1857,6 +1970,11 @@ def _run(sf: float, stream_mode: bool) -> None:
                     # previously-unmeasured number
                     _phase("extras: sustained concurrent load")
                     bench_sustained_load(extra)
+                if _remaining() > 30:
+                    # overload A/B (ISSUE 19): shed on/off goodput +
+                    # p99 under the same 4x submit storm
+                    _phase("extras: overload shed A/B")
+                    bench_overload_ab(extra)
                 _phase("extras done")
             except _ExtrasTimeout:
                 extra["note"] = "remaining extras skipped: wall-clock budget exhausted"
@@ -1960,6 +2078,27 @@ def _run(sf: float, stream_mode: bool) -> None:
             "interactive_p99_ratio": (
                 round(loaded_p99 / max(solo_p99, 1e-9), 2)
                 if solo_p99 else None),
+        })
+    if "overload_ab" in extra:
+        on = extra["overload_ab"]["on"]
+        off = extra["overload_ab"]["off"]
+        metrics.append({
+            "metric": "overload_storm_goodput_queries_per_sec",
+            "value": on["goodput_queries_per_sec"],
+            "unit": "q/s",
+            # the no-shed server under the SAME 4x storm is the
+            # baseline: the ratio is what admission-time load shedding
+            # buys in completed-within-deadline throughput (ISSUE-19
+            # acceptance: >= 1x — shedding never costs goodput)
+            "vs_baseline": round(
+                on["goodput_queries_per_sec"]
+                / max(off["goodput_queries_per_sec"], 1e-9), 3),
+            "baseline_queries_per_sec": off["goodput_queries_per_sec"],
+            "latency_p99_ms": on["latency_p99_ms"],
+            "baseline_latency_p99_ms": off["latency_p99_ms"],
+            "shed": on["shed"],
+            "deadline_expired_on": on["deadline_expired"],
+            "deadline_expired_off": off["deadline_expired"],
         })
     if "ingest_load" in extra:
         ing = extra["ingest_load"]

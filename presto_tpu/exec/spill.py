@@ -160,15 +160,20 @@ def transfer_iter(load, items: Sequence, label: str = "spill:transfer"):
     The ``step.spill_transfer`` fault site fires on the driver thread
     before each submit — a mid-spill backend OOM propagates exactly
     like a compute-site OOM (typed, ladder-eligible), with no worker
-    thread holding a half-transferred bucket.
+    thread holding a half-transferred bucket. Each submit slot is also
+    a cancel/deadline checkpoint (``runtime/overload.CancelScope``): a
+    cancelled spilling query stops transferring within one bucket and
+    its host-spill reservation releases through the ordinary unwind.
     """
     from presto_tpu.exec.pipeline import prefetch_enabled
     from presto_tpu.runtime import trace
     from presto_tpu.runtime.faults import fault_point
+    from presto_tpu.runtime.lifecycle import check_deadline
 
     items = list(items)
     if len(items) <= 1 or not prefetch_enabled():
         for it in items:
+            check_deadline("spill-transfer")
             fault_point("step.spill_transfer")
             t0 = time.perf_counter()
             out = load(it)
@@ -189,6 +194,7 @@ def transfer_iter(load, items: Sequence, label: str = "spill:transfer"):
         pending: deque = deque()
         idx = 0
         while idx < len(items) and len(pending) < 2:
+            check_deadline("spill-transfer")
             fault_point("step.spill_transfer")
             pending.append((items[idx], ex.submit(timed, items[idx])))
             idx += 1
@@ -197,6 +203,7 @@ def transfer_iter(load, items: Sequence, label: str = "spill:transfer"):
             t0, dur, out = fut.result()
             trace.add_complete(label, "step", t0, dur, {"slot": "worker"})
             if idx < len(items):
+                check_deadline("spill-transfer")
                 fault_point("step.spill_transfer")
                 pending.append((items[idx], ex.submit(timed, items[idx])))
                 idx += 1

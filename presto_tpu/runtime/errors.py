@@ -110,6 +110,37 @@ class ExceededTimeLimit(PrestoError, RuntimeError):
     retryable = False
 
 
+class ServerOverloaded(ResourceExhausted):
+    """The serving tier shed this submission at admission: a queue
+    ceiling or the EWMA-cost admission controller decided accepting it
+    would push the backlog past what the engine can drain within SLO.
+    Retryable — unlike the other resource walls, the demand is a
+    property of the MOMENT, not the query: the same statement succeeds
+    once the storm passes. Carries ``retry_after_s``, a monotone
+    function of queue depth, surfaced as HTTP 429 + ``Retry-After``."""
+
+    error_code = "SERVER_OVERLOADED"
+    retryable = True
+
+    def __init__(self, message: str, *, retry_after_s: float = 1.0,
+                 retryable: bool | None = None):
+        super().__init__(message, retryable=retryable)
+        self.retry_after_s = float(retry_after_s)
+
+
+class QueryCancelled(PrestoError, RuntimeError):
+    """The query's ``CancelScope`` was flipped — an operator ``DELETE
+    /v1/statement/<id>``, ``Session.cancel(query_id)``, or the overload
+    controller — and a cooperative checkpoint observed it. Not
+    retryable: cancellation is a decision, not a failure, and a retry
+    would resurrect work someone explicitly killed. Reservations are
+    released by the same ``finally`` paths as any other typed failure,
+    so a cancel drains the pool within one checkpoint."""
+
+    error_code = "QUERY_CANCELLED"
+    retryable = False
+
+
 class TransientFailure(PrestoError, RuntimeError):
     """A plausibly-transient fault: an injected fault, a lost device,
     a flaky interconnect step. Retryable — the fragment retry loop and

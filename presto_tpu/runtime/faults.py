@@ -44,6 +44,7 @@ SITES = (
     "step.agg",  # grouped-aggregation jitted-step dispatch
     "step.spill_transfer",  # host->device cold-partition transfer submits
     "step.spill_partition",  # recursive re-partition of an oversized bucket
+    "step.cancel_checkpoint",  # cooperative cancel/deadline checkpoints
 )
 
 

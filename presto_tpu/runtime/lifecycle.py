@@ -60,6 +60,7 @@ from presto_tpu.runtime.errors import (
 )
 from presto_tpu.runtime.devices import timed_dispatch
 from presto_tpu.runtime.metrics import REGISTRY
+from presto_tpu.runtime.overload import CancelScope, RetryBudget
 from presto_tpu.runtime.trace import current as trace_current
 from presto_tpu.runtime.trace import span as trace_span
 
@@ -69,6 +70,13 @@ MAX_BACKOFF_S = 5.0
 
 _CURRENT: ContextVar[Optional["QueryContext"]] = ContextVar(
     "presto_tpu_query_context", default=None
+)
+
+#: absolute ``time.monotonic()`` deadline the CURRENT REQUEST carries
+#: (the serving layer's ``X-Presto-Deadline`` header); ``_context``
+#: folds it into the query deadline — the TIGHTER of the two wins
+REQUEST_DEADLINE: ContextVar[Optional[float]] = ContextVar(
+    "presto_tpu_request_deadline", default=None
 )
 
 
@@ -86,6 +94,8 @@ class QueryContext:
         deadline_s: float | None = None,
         retry: RetryPolicy = RetryPolicy(),
         on_retry: Callable[[str, BaseException], None] | None = None,
+        cancel_scope: "CancelScope | None" = None,
+        retry_budget: "RetryBudget | None" = None,
     ):
         self.deadline = (
             None if deadline_s is None else time.monotonic() + deadline_s
@@ -94,8 +104,18 @@ class QueryContext:
         self.retry = retry
         self.on_retry = on_retry
         self.fragment_retries = 0
+        #: cooperative cancellation flag (runtime/overload.py); every
+        #: deadline checkpoint doubles as a cancel checkpoint, so the
+        #: existing choke points (fragment entry, morsel loop, scan
+        #: loops) observe a cancel within one boundary
+        self.cancel_scope = cancel_scope
+        #: session-wide retry token bucket + circuit breaker; None in
+        #: bare contexts (tests constructing QueryContext directly)
+        self.retry_budget = retry_budget
 
     def check_deadline(self, where: str = "driver") -> None:
+        if self.cancel_scope is not None:
+            self.cancel_scope.check(where)
         if self.deadline is not None and time.monotonic() > self.deadline:
             REGISTRY.counter("query.deadline_exceeded").add()
             raise ExceededTimeLimit(
@@ -162,14 +182,22 @@ def run_fragment(label: str, fn: Callable[[], object]):
     ctx.check_deadline(label)
     attempts = max(0, ctx.retry.count)
     dispatch_h = REGISTRY.histogram("fragment.dispatch_s")
+    budget = ctx.retry_budget
     for attempt in range(attempts + 1):
         try:
             with trace_span(
                 label, "fragment",
                 {"attempt": attempt} if attempt else None,
             ), dispatch_h.time():
-                return timed_dispatch(fn)
+                result = timed_dispatch(fn)
+            if attempt > 0 and budget is not None:
+                # a spent retry paid off — a half-open probe's success
+                # closes the breaker and refills the bucket
+                budget.record_success()
+            return result
         except Exception as e:
+            if attempt > 0 and budget is not None:
+                budget.record_failure()
             oom = _map_backend_oom(e, label)
             if oom is not None:
                 raise oom from e
@@ -177,6 +205,12 @@ def run_fragment(label: str, fn: Callable[[], object]):
             if not is_retryable(e) or exhausted or attempt == attempts:
                 if is_retryable(e):
                     e._presto_retries_exhausted = True
+                raise
+            if budget is not None and not budget.try_spend(label):
+                # budget drained / breaker open: correlated failures
+                # degrade to fail-fast with the ORIGINAL error instead
+                # of a retry storm that multiplies offered load
+                e._presto_retries_exhausted = True
                 raise
             ctx.record_retry(label, e)
             sleep_s = min(ctx.retry.backoff_s * (2**attempt), MAX_BACKOFF_S)
@@ -341,6 +375,62 @@ class QueryManager:
         #: watchdog samples from its own thread)
         self._inflight_lock = threading.Lock()
         self._inflight_queries: dict = {}
+        #: query_id -> CancelScope for the WHOLE tracked execution —
+        #: registered by Session._run_tracked before the batch-gate /
+        #: coalescer waits, so a cancel reaches a query that has not
+        #: entered run_plan yet
+        self._scopes: dict = {}
+        #: lazily-built per-session retry token bucket (overload
+        #: control rung 3); lazy because session properties are not
+        #: validated yet when the Session constructs its manager
+        self._retry_budget: RetryBudget | None = None
+
+    def retry_budget(self) -> RetryBudget:
+        """The session's shared :class:`RetryBudget` (fragment retries
+        AND OOM-ladder rungs draw from one bucket — correlated
+        failures are correlated across both)."""
+        with self._inflight_lock:
+            if self._retry_budget is None:
+                self._retry_budget = RetryBudget(
+                    capacity=self.session.prop("retry_budget_tokens"),
+                    refill_per_s=self.session.prop(
+                        "retry_budget_refill_per_s"),
+                    probe_cooldown_s=self.session.prop(
+                        "retry_breaker_cooldown_s"),
+                )
+            return self._retry_budget
+
+    def open_scope(self, query_id: str) -> "CancelScope":
+        """Register the query's CancelScope for the whole tracked
+        execution (Session._run_tracked pairs this with
+        :meth:`close_scope` in a finally)."""
+        scope = CancelScope(query_id)
+        with self._inflight_lock:
+            self._scopes[query_id] = scope
+        return scope
+
+    def close_scope(self, query_id: str) -> None:
+        with self._inflight_lock:
+            self._scopes.pop(query_id, None)
+
+    def scope_of(self, query_id: str) -> "CancelScope | None":
+        with self._inflight_lock:
+            return self._scopes.get(query_id)
+
+    def cancel(self, query_id: str, reason: str = "cancelled") -> bool:
+        """Flip a live query's :class:`CancelScope`; its next
+        cooperative checkpoint raises ``QueryCancelled`` and the
+        ordinary ``finally`` paths release every reservation. Returns
+        False when the query is not in flight (already terminal) or
+        was already cancelled."""
+        with self._inflight_lock:
+            scope = self._scopes.get(query_id)
+            if scope is None:
+                entry = self._inflight_queries.get(query_id)
+                scope = None if entry is None else entry.get("cancel")
+        if scope is None:
+            return False
+        return scope.cancel(reason)
 
     # -- admission ------------------------------------------------------
     def admission_limit(self) -> int:
@@ -420,14 +510,26 @@ class QueryManager:
         return scale
 
     # -- execution scope ------------------------------------------------
-    def _context(self, info) -> QueryContext:
+    def _context(self, info, scope: "CancelScope | None" = None
+                 ) -> QueryContext:
         events = self.session.events
+        deadline_s = self.session.prop("query_max_run_time")
+        request_deadline = REQUEST_DEADLINE.get()
+        if request_deadline is not None:
+            # the serving layer's X-Presto-Deadline (absolute
+            # monotonic) propagates into the query scope; the TIGHTER
+            # of the request and session deadlines wins
+            remaining = max(0.0, request_deadline - time.monotonic())
+            deadline_s = (remaining if deadline_s is None
+                          else min(deadline_s, remaining))
         ctx = QueryContext(
-            deadline_s=self.session.prop("query_max_run_time"),
+            deadline_s=deadline_s,
             retry=RetryPolicy(
                 count=self.session.prop("retry_count"),
                 backoff_s=self.session.prop("retry_backoff_s"),
             ),
+            cancel_scope=scope,
+            retry_budget=self.retry_budget(),
         )
 
         def on_retry(site: str, exc: BaseException):
@@ -469,14 +571,19 @@ class QueryManager:
         pool = self.session.pool()
         delta = QueryMetricsDelta()
         delta_token = install_delta(delta)
+        # reuse the scope _run_tracked registered (a cancel issued
+        # during the gate wait must stay flipped here); direct callers
+        # (batch leaders, subscriptions) get a fresh one
+        scope = self.scope_of(info.query_id) or CancelScope(info.query_id)
         with self._inflight_lock:
             self._inflight_queries[info.query_id] = {
                 "info": info, "executor": executor, "plan": plan,
-                "tracer": trace_current(),
+                "tracer": trace_current(), "cancel": scope,
             }
         err = None
         try:
-            return self._run_admitted(executor, plan, info, recorder, pool)
+            return self._run_admitted(executor, plan, info, recorder, pool,
+                                      scope)
         except BaseException as e:
             err = e
             raise
@@ -553,7 +660,8 @@ class QueryManager:
         except Exception:  # noqa: BLE001 — see docstring
             REGISTRY.counter("flight.capture_errors").add()
 
-    def _run_admitted(self, executor, plan, info, recorder, pool):
+    def _run_admitted(self, executor, plan, info, recorder, pool,
+                      scope: "CancelScope | None" = None):
         try:
             with trace_span("admission", "lifecycle"):
                 granted = self.admit(
@@ -574,7 +682,7 @@ class QueryManager:
             info.started_at = time.time()
             info.started_mono = time.monotonic()
         try:
-            ctx = self._context(info)
+            ctx = self._context(info, scope)
             token = _CURRENT.set(ctx)
             try:
                 # timed post-admission, so the execution histogram
@@ -604,10 +712,20 @@ class QueryManager:
         blind replay: each rung strictly shrinks per-step residency, so
         wrong estimates degrade throughput, never correctness."""
         ladder_max = self.session.prop("oom_ladder_max")
+        budget = ctx.retry_budget
         rung = 0
         while True:
             try:
+                if rung > 0:
+                    # between-rung cancel/deadline checkpoint, INSIDE
+                    # the try: the cancel scope doubles as the
+                    # step.cancel_checkpoint fault site, and an
+                    # injected OOM here must consume a rung like any
+                    # step OOM, not escape the ladder
+                    ctx.check_deadline("oom_ladder")
                 result = executor.run(plan)
+                if rung > 0 and budget is not None:
+                    budget.record_success()
                 # approximate-join visibility: the executor records
                 # whether this run published a sketch (Bloom) probe —
                 # QueryInfo must flag possibly-approximate results so
@@ -617,8 +735,15 @@ class QueryManager:
                 self._note_planned_spills(executor, info)
                 return result
             except DeviceOutOfMemory as e:
+                if rung > 0 and budget is not None:
+                    budget.record_failure()
                 degrade = getattr(executor, "degrade_for_oom", None)
                 if rung >= ladder_max or degrade is None or not degrade():
+                    raise
+                if budget is not None and not budget.try_spend("oom_ladder"):
+                    # ladder rungs draw from the SAME bucket as
+                    # fragment retries: an OOM storm fails fast once
+                    # the breaker opens instead of re-planning forever
                     raise
                 rung += 1
                 # additive: a degraded-to-local run's ladder continues
@@ -630,17 +755,17 @@ class QueryManager:
                 info.rung_history.append(
                     {"kind": "ladder", "rung": info.oom_retries,
                      "error": str(e)[:200]})
-                REGISTRY.counter("query.oom_degraded").add()
-                self.session.events.query_degraded(info)
-                if recorder is not None:
-                    # stats from the OOMed attempt must not leak into
-                    # (or double-count in) the re-run's QueryInfo
-                    recorder.nodes.clear()
                 with trace_span(
                     "oom_degrade", "lifecycle",
                     {"rung": rung, "error": str(e)[:120]},
                 ):
-                    ctx.check_deadline("oom_ladder")
+                    REGISTRY.counter("query.oom_degraded").add()
+                    self.session.events.query_degraded(info)
+                    if recorder is not None:
+                        # stats from the OOMed attempt must not leak
+                        # into (or double-count in) the re-run's
+                        # QueryInfo
+                        recorder.nodes.clear()
             except Exception as e:
                 if (
                     is_retryable(e)

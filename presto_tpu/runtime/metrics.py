@@ -557,6 +557,48 @@ METRIC_HELP: dict[str, str] = {
         "delivered to the subscription's ring"),
     "subscription.created": "continuous queries registered",
     "subscription.cancelled": "continuous queries cancelled",
+    # ---- overload control (runtime/overload.py) ----
+    "overload.shed": (
+        "submissions refused at admission with the retryable "
+        "SERVER_OVERLOADED (queue ceilings, EWMA drain estimate, or "
+        "brown-out shed policy; per-cause split in overload."
+        "shed_reason.*, per-tenant in overload.shed_tenant.*)"),
+    "overload.shed_reason.brownout": (
+        "submissions shed because the brown-out latch was engaged and "
+        "the tenant's brownout policy is 'shed'"),
+    "overload.retry_budget_exhausted": (
+        "retries denied by the per-session retry token bucket / open "
+        "circuit breaker (the caller fails fast with its original "
+        "error instead of retrying)"),
+    "overload.breaker_open": (
+        "retry circuit breaker OPEN transitions (the token bucket "
+        "drained — correlated failures outpaced the refill)"),
+    "overload.breaker_probe": (
+        "half-open probe retries granted after the breaker cooldown "
+        "(exactly one in-flight probe at a time)"),
+    "overload.breaker_rearm": (
+        "breaker CLOSED transitions: a half-open probe succeeded, the "
+        "token bucket refilled"),
+    "cancel.requested": (
+        "CancelScope flips (DELETE /v1/statement, Session.cancel, or "
+        "the overload controller) — first flip per query only"),
+    "cancel.observed": (
+        "cancelled queries that reached a cooperative checkpoint and "
+        "raised the typed QUERY_CANCELLED (first observation per "
+        "query)"),
+    "server.cancel_requests": (
+        "cancel requests accepted by the serving layer for non-"
+        "terminal submitted queries"),
+    "brownout.engaged": (
+        "brown-out latch engagements (health breach or operator "
+        "force): eligible tenants' NEW traffic degrades per their "
+        "TenantSpec.brownout policy"),
+    "brownout.recovered": (
+        "brown-out latch releases after a breach-free cooldown (or "
+        "the operator clearing brownout_force)"),
+    "brownout.approx_routed": (
+        "submissions routed to the approximate tier by an engaged "
+        "brown-out (flagged approximate on every poll page)"),
 }
 
 

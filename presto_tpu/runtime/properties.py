@@ -506,6 +506,44 @@ SESSION_PROPERTIES: dict[str, PropertyDef] = {
             "once, not once per sample).",
             _non_negative,
         ),
+        PropertyDef(
+            "retry_budget_tokens", float, 16.0,
+            "Capacity of the per-session retry token bucket "
+            "(runtime/overload.RetryBudget): fragment retries and "
+            "OOM-ladder rungs each spend one token; a drained bucket "
+            "opens the circuit breaker and failures fail fast instead "
+            "of retry-storming.",
+            _positive,
+        ),
+        PropertyDef(
+            "retry_budget_refill_per_s", float, 2.0,
+            "Retry tokens refilled per second — the sustainable "
+            "independent-failure rate; correlated failures outpace it "
+            "and trip the breaker. 0 disables refill (tokens only "
+            "return via the half-open probe's success).",
+            _non_negative,
+        ),
+        PropertyDef(
+            "retry_breaker_cooldown_s", float, 1.0,
+            "Seconds an OPEN retry circuit breaker waits before going "
+            "half-open and granting exactly one probe retry; the "
+            "probe's success re-closes the breaker and refills the "
+            "bucket.",
+            _non_negative,
+        ),
+        PropertyDef(
+            "brownout_cooldown_s", float, 5.0,
+            "Breach-free seconds after which an engaged brown-out "
+            "(runtime/overload.OverloadController) disengages and "
+            "eligible tenants' traffic returns to the exact tier.",
+            _non_negative,
+        ),
+        PropertyDef(
+            "brownout_force", bool, False,
+            "Operator override: pin the brown-out latch ON (eligible "
+            "tenants degrade per TenantSpec.brownout regardless of "
+            "health). Setting it back to false disengages immediately.",
+        ),
     ]
 }
 

@@ -462,6 +462,18 @@ class Session:
         )
         return df
 
+    def cancel(self, query_id: str, reason: str = "cancelled") -> bool:
+        """Cooperatively cancel a live query by ENGINE query id
+        (``QueryInfo.query_id``): flips its CancelScope so the next
+        checkpoint — fragment entry, morsel push, spill transfer slot,
+        batch-gate wake — raises the typed ``QueryCancelled`` and the
+        ordinary ``finally`` paths release its pool and host-spill
+        reservations. Returns False for unknown/terminal/already-
+        cancelled ids; there is nothing to interrupt preemptively — a
+        compiled XLA step runs to completion, like every other
+        lifecycle control here."""
+        return self.query_manager.cancel(query_id, reason)
+
     # ---- prepared statements / plan templates ------------------------
     def _plan_binding(self, stmt, parameterize: bool = True):
         """Analyze + prune + (when ``plan_templates`` is on)
@@ -661,11 +673,17 @@ class Session:
                 annotate=bool(self.prop("profile_annotations")),
             )
             token = trace.install(tracer)
+        # the cancel scope covers the WHOLE tracked execution — cache
+        # lookup, coalescer and batch-gate waits included — so
+        # Session.cancel reaches a query before run_plan installs it
+        # in the in-flight registry
+        self.query_manager.open_scope(info.query_id)
         try:
             with trace.span("query", "query", {"query_id": info.query_id}):
                 return self._run_tracked_inner(sql, plan, recorder, info,
                                                bound=bound)
         finally:
+            self.query_manager.close_scope(info.query_id)
             if tracer is not None:
                 trace.uninstall(token)
                 self.traces.add(tracer)
@@ -941,7 +959,17 @@ class Session:
         deadline = (None if wait_s is None
                     else time.monotonic() + float(wait_s))
         gate_t0 = time.perf_counter()
+        scope = self.query_manager.scope_of(info.query_id)
         while True:
+            if scope is not None:
+                # batch-gate cancel checkpoint: a cancelled waiter must
+                # abandon its lane (dequeue + deref) on the way out, or
+                # a later leader would burn a lane on a departed thread
+                try:
+                    scope.check("batch-gate-wait")
+                except BaseException:
+                    gate.abandon(base_fp, member)
+                    raise
             remaining = (None if deadline is None
                          else max(0.0, deadline - time.monotonic()))
             role, payload = gate.lead_or_wait(base_fp, member, remaining,

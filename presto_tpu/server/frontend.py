@@ -30,8 +30,15 @@ import time
 import uuid
 from typing import Mapping, Optional
 
-from presto_tpu.runtime.errors import PrestoError, UserError, error_code
+from presto_tpu.runtime.errors import (
+    PrestoError,
+    QueryCancelled,
+    ServerOverloaded,
+    UserError,
+    error_code,
+)
 from presto_tpu.runtime.metrics import REGISTRY
+from presto_tpu.runtime.overload import OverloadController, shed_retry_after
 from presto_tpu.server.scheduler import FairScheduler, TenantSpec
 
 _submit_seq = itertools.count(1)
@@ -108,7 +115,10 @@ class QueryServer:
                  default_tenant: str = "default",
                  query_record_limit: int = 256,
                  submit_limit: int = 128,
-                 submit_timeout_s: float = 300.0):
+                 submit_timeout_s: float = 300.0,
+                 shed_queue_limit: Optional[int] = None,
+                 shed_tenant_queue_limit: Optional[int] = None,
+                 shed_drain_limit_s: Optional[float] = None):
         from presto_tpu.runtime.health import HealthMonitor, SloTracker
         from presto_tpu.runtime.session import Session
         from presto_tpu.stream.subscriptions import SubscriptionManager
@@ -119,8 +129,17 @@ class QueryServer:
             session = Session(dict(connectors or {}), properties=props)
         self.session = session
         self.default_tenant = default_tenant
-        self.scheduler = FairScheduler(tenants, total_slots=total_slots,
-                                       pool=session.pool())
+        self.scheduler = FairScheduler(
+            tenants, total_slots=total_slots, pool=session.pool(),
+            global_queue_limit=shed_queue_limit,
+            tenant_queue_limit=shed_tenant_queue_limit,
+            shed_drain_limit_s=shed_drain_limit_s)
+        #: the brown-out latch (overload rung 4): health breaches
+        #: engage it, a breach-free cooldown disengages it, and
+        #: eligible tenants' NEW traffic degrades per TenantSpec
+        #: .brownout while it is engaged
+        self.overload = OverloadController(
+            cooldown_s=float(session.prop("brownout_cooldown_s")))
         #: the registry behind system.tenants (connectors/system.py)
         session.tenants = self.scheduler
         #: submit/poll records, RING-bounded: terminal records beyond
@@ -186,7 +205,8 @@ class QueryServer:
                 queue_limit=int(session.prop("health_queue_limit")),
                 burn_limit=float(session.prop("health_burn_limit")),
                 stale_lag_s=float(session.prop("health_stale_lag_s")),
-                cooldown_s=float(session.prop("health_cooldown_s")))
+                cooldown_s=float(session.prop("health_cooldown_s")),
+                on_breach=self.overload.on_breach)
             self.health.start()
         #: the registry behind system.health (connectors/system.py)
         session.health = self.health
@@ -226,16 +246,54 @@ class QueryServer:
             finally:
                 CURRENT_TENANT.reset(token)
 
+    def _brownout_mode(self, tenant: str) -> Optional[str]:
+        """Routing verdict for one NEW submission: None (serve
+        normally), "approx" (serve through the approx sibling
+        session), or "shed" (refuse with ServerOverloaded). The
+        ``brownout_force`` session property is the operator override —
+        it pins the latch on regardless of health."""
+        forced = bool(self.session.prop("brownout_force"))
+        if forced != self.overload.forced:
+            self.overload.force(forced)
+        return self.overload.mode_for(self.scheduler.spec(tenant))
+
+    def _route_session(self, tenant: str):
+        """The session one NEW statement from ``tenant`` runs against,
+        after the brown-out verdict. Raises ServerOverloaded for
+        ``brownout="shed"`` tenants while the latch is engaged."""
+        mode = self._brownout_mode(tenant)
+        if mode == "shed":
+            REGISTRY.counter("overload.shed").add()
+            REGISTRY.counter("overload.shed_reason.brownout").add()
+            raise ServerOverloaded(
+                f"tenant {tenant!r} shed: brown-out engaged and the "
+                f"tenant's brownout policy is 'shed'",
+                retry_after_s=shed_retry_after(self.scheduler.queue_depth()))
+        if mode == "approx":
+            REGISTRY.counter("brownout.approx_routed").add()
+            return self.approx_session(), True
+        return self.session, False
+
     def execute(self, sql: str, tenant: Optional[str] = None,
-                timeout_s: Optional[float] = None):
+                timeout_s: Optional[float] = None,
+                deadline_s: Optional[float] = None):
         """Run one statement as ``tenant`` (fair slot + attribution);
-        returns the DataFrame."""
+        returns the DataFrame. ``deadline_s`` bounds the WHOLE request
+        — queue time included — and propagates into the query's
+        cancel/deadline scope."""
+        from presto_tpu.runtime.lifecycle import REQUEST_DEADLINE
+
         tenant = tenant or self.default_tenant
+        sess, _ = self._route_session(tenant)
         self._enter(tenant)
+        dl_token = (None if deadline_s is None else
+                    REQUEST_DEADLINE.set(time.monotonic() + deadline_s))
         try:
-            return self._execute_admitted(lambda: self.session.sql(sql),
+            return self._execute_admitted(lambda: sess.sql(sql),
                                           tenant, timeout_s)
         finally:
+            if dl_token is not None:
+                REQUEST_DEADLINE.reset(dl_token)
             self._leave()
 
     def _prepared_key(self, tenant: str, name: str) -> str:
@@ -291,7 +349,8 @@ class QueryServer:
             del self._queries[qid]
 
     def submit(self, sql: str, tenant: Optional[str] = None,
-               trace: Optional[dict] = None) -> str:
+               trace: Optional[dict] = None,
+               deadline_s: Optional[float] = None) -> str:
         """Asynchronous submission; returns a server query id to poll.
         In-flight accounting happens HERE (not on the worker thread):
         an accepted query is part of the drain set immediately, so a
@@ -311,9 +370,16 @@ class QueryServer:
                           if r["state"] in ("QUEUED", "RUNNING"))
         if pending >= self.submit_limit:
             REGISTRY.counter("server.submit_rejected").add()
-            raise UserError(
+            raise ServerOverloaded(
                 f"server busy: {pending} submitted queries pending "
-                f"(submit_limit={self.submit_limit})")
+                f"(submit_limit={self.submit_limit})",
+                retry_after_s=shed_retry_after(pending))
+        # the scheduler's shed verdict, taken SYNCHRONOUSLY at accept
+        # time: an over-ceiling submission must 429 on /v1/statement
+        # itself, never spend a worker thread to fail on the poll page
+        # — and a shed submission leaves no submit record behind
+        self.scheduler.check_shed(tenant)
+        sess, approximate = self._route_session(tenant)
         self._enter(tenant)  # raises while draining; worker leaves
         if trace is None:
             trace = _trace_context()
@@ -322,7 +388,10 @@ class QueryServer:
         rec = {"id": qid, "tenant": tenant, "sql": sql, "state": "QUEUED",
                "df": None, "error": None, "error_code": None,
                "submitted_at": time.time(), "done": threading.Event(),
-               "trace": trace}
+               "trace": trace, "cancel_requested": False,
+               "approximate": approximate,
+               "deadline_mono": (None if deadline_s is None
+                                 else time.monotonic() + deadline_s)}
         with self._qlock:
             self._queries[qid] = rec
             self._retire_records_locked()
@@ -334,15 +403,24 @@ class QueryServer:
             # RUNNING; the stamp also bounds the frontend:submit span
             # (submit accept -> slot held = admission wait)
             trace["started_pc"] = time.perf_counter()
+            if rec["cancel_requested"]:
+                # cancelled while QUEUED: observe it at the slot
+                # boundary — the slot releases on the way out and no
+                # engine-side state was ever created
+                raise QueryCancelled(
+                    f"query {qid} cancelled while queued")
             rec["state"] = "RUNNING"
 
         def work():
+            from presto_tpu.runtime.lifecycle import REQUEST_DEADLINE
             from presto_tpu.runtime.session import REQUEST_TRACE
 
             token = REQUEST_TRACE.set(trace)
+            dl_token = (None if rec["deadline_mono"] is None else
+                        REQUEST_DEADLINE.set(rec["deadline_mono"]))
             try:
                 rec["df"] = self._execute_admitted(
-                    lambda: self.session.sql(sql), tenant,
+                    lambda: sess.sql(sql), tenant,
                     timeout_s=self.submit_timeout_s,
                     on_start=on_start)
                 rec["state"] = "FINISHED"
@@ -352,8 +430,12 @@ class QueryServer:
                 rec["error_code"] = (error_code(e)
                                      if isinstance(e, PrestoError)
                                      else "INTERNAL")
+                if isinstance(e, ServerOverloaded):
+                    rec["retry_after_s"] = e.retry_after_s
                 REGISTRY.counter("server.failed").add()
             finally:
+                if dl_token is not None:
+                    REQUEST_DEADLINE.reset(dl_token)
                 REQUEST_TRACE.reset(token)
                 rec["done"].set()
                 self._leave()
@@ -381,6 +463,10 @@ class QueryServer:
         if rec is None:
             raise UserError(f"unknown query id: {qid}")
         page = {"id": qid, "tenant": rec["tenant"], "state": rec["state"]}
+        if rec.get("approximate"):
+            # brown-out honesty: a query served through the approx
+            # tier is flagged on every page, not just the result
+            page["approximate"] = True
         if rec["state"] == "FINISHED":
             payload = rec.get("payload")
             if payload is None:
@@ -392,6 +478,8 @@ class QueryServer:
         elif rec["state"] == "FAILED":
             page["error"] = rec["error"]
             page["errorCode"] = rec["error_code"]
+            if rec.get("retry_after_s") is not None:
+                page["retryAfterS"] = rec["retry_after_s"]
         if rec["state"] in ("FINISHED", "FAILED"):
             self._stitch_frontend_spans(rec, poll_t0)
         return page
@@ -444,6 +532,34 @@ class QueryServer:
         span_id = uuid.uuid4().hex[:16]
         return {"X-Presto-Trace": trace_ctx["token"],
                 "traceparent": f"00-{trace_ctx['trace_id']}-{span_id}-01"}
+
+    def cancel(self, qid: str, reason: str = "cancelled by client") -> dict:
+        """Cooperatively cancel a submitted query (the ``DELETE
+        /v1/statement/<id>`` verb). RUNNING queries get their engine
+        CancelScope flipped — the next checkpoint raises the typed
+        ``QueryCancelled`` and releases every pool/host-spill
+        reservation; QUEUED queries are marked and observed at the
+        slot boundary (a waiter blocked in the fair queue drains at
+        its next wake). Terminal queries are left untouched."""
+        with self._qlock:
+            rec = self._queries.get(qid)
+        if rec is None:
+            raise UserError(f"unknown query id: {qid}")
+        if rec["state"] in ("FINISHED", "FAILED"):
+            return {"id": qid, "state": rec["state"], "cancelled": False}
+        REGISTRY.counter("server.cancel_requests").add()
+        rec["cancel_requested"] = True
+        flipped = False
+        engine_qid = (rec.get("trace") or {}).get("query_id")
+        if engine_qid:
+            flipped = self.session.cancel(engine_qid, reason)
+            if not flipped and self._approx_session is not None:
+                flipped = self._approx_session.cancel(engine_qid, reason)
+        # wake fair-queue waiters so a QUEUED cancel is observed at
+        # the next scheduling pass instead of the admission timeout
+        self.scheduler.kick()
+        return {"id": qid, "state": rec["state"], "cancelled": True,
+                "observed_running": flipped}
 
     def result(self, qid: str, timeout_s: Optional[float] = None):
         """Block until a submitted query finishes; returns the frame
@@ -573,7 +689,14 @@ class HttpFrontend:
                                      a client ``traceparent`` (W3C) or
                                      ``X-Presto-Trace`` token is honored
                                      end to end and echoed back on the
-                                     response headers
+                                     response headers; an
+                                     ``X-Presto-Deadline`` header (epoch
+                                     seconds, or relative seconds)
+                                     propagates into the query's cancel/
+                                     deadline scope; a shed submission
+                                     gets 429 + ``Retry-After``
+        DELETE /v1/statement/<id>    cooperative cancel; 200 -> {id,
+                                     state, cancelled}
         GET  /v1/statement/<id>      poll page (FINISHED pages carry
                                      {columns, data}); echoes the trace
                                      headers of the submission
@@ -637,6 +760,38 @@ class HttpFrontend:
                 n = int(self.headers.get("Content-Length") or 0)
                 return self.rfile.read(n)
 
+            def _deadline_s(self):
+                """``X-Presto-Deadline`` -> relative seconds remaining,
+                or None. Values past 1e9 are absolute unix-epoch
+                deadlines (the cross-service propagation shape); small
+                values are relative budgets. Malformed or already-
+                expired deadlines are the CLIENT's fault: UserError ->
+                400, never a silent drop of a semantic header."""
+                hdr = self.headers.get("X-Presto-Deadline")
+                if hdr is None:
+                    return None
+                try:
+                    v = float(hdr)
+                except ValueError:
+                    raise UserError(
+                        f"X-Presto-Deadline: cannot parse {hdr!r} as "
+                        "seconds") from None
+                remaining = v - time.time() if v > 1e9 else v
+                if remaining <= 0:
+                    raise UserError(
+                        f"X-Presto-Deadline already expired "
+                        f"({remaining:.3f}s remaining)")
+                return remaining
+
+            def _overloaded(self, e: "ServerOverloaded"):
+                """429 + Retry-After (integer seconds, ceil'd so a
+                sub-second hint never rounds to 'retry now')."""
+                after = max(1, int(e.retry_after_s + 0.999))
+                self._send(429, {"error": str(e),
+                                 "errorCode": e.error_code,
+                                 "retryAfterS": e.retry_after_s},
+                           headers={"Retry-After": str(after)})
+
             def do_GET(self):
                 try:
                     if self.path == "/metrics":
@@ -658,6 +813,20 @@ class HttpFrontend:
                         self._send(200, qserver.subscription_page(sid))
                         return
                     self._send(404, {"error": f"no route {self.path}"})
+                except ServerOverloaded as e:
+                    self._overloaded(e)
+                except UserError as e:
+                    self._send(400, {"error": str(e)})
+                except Exception as e:  # noqa: BLE001 — HTTP boundary
+                    self._send(500, {"error": f"{type(e).__name__}: {e}"})
+
+            def do_DELETE(self):
+                try:
+                    if self.path.startswith("/v1/statement/"):
+                        qid = self.path.rsplit("/", 1)[1]
+                        self._send(200, qserver.cancel(qid))
+                        return
+                    self._send(404, {"error": f"no route {self.path}"})
                 except UserError as e:
                     self._send(400, {"error": str(e)})
                 except Exception as e:  # noqa: BLE001 — HTTP boundary
@@ -668,7 +837,8 @@ class HttpFrontend:
                     if self.path == "/v1/statement":
                         sql = self._body().decode("utf-8")
                         qid = qserver.submit(sql, self._tenant(),
-                                             trace=self._trace_ctx())
+                                             trace=self._trace_ctx(),
+                                             deadline_s=self._deadline_s())
                         self._send(201, {
                             "id": qid, "state": "QUEUED",
                             "nextUri": f"/v1/statement/{qid}",
@@ -734,6 +904,8 @@ class HttpFrontend:
                         self._send(200, {"cancelled": sid})
                         return
                     self._send(404, {"error": f"no route {self.path}"})
+                except ServerOverloaded as e:
+                    self._overloaded(e)
                 except UserError as e:
                     self._send(400, {"error": str(e)})
                 except Exception as e:  # noqa: BLE001 — HTTP boundary

@@ -51,8 +51,9 @@ from contextlib import contextmanager
 from dataclasses import dataclass
 from typing import Iterable, Mapping, Optional
 
-from presto_tpu.runtime.errors import ResourceExhausted
+from presto_tpu.runtime.errors import ResourceExhausted, ServerOverloaded
 from presto_tpu.runtime.metrics import REGISTRY
+from presto_tpu.runtime.overload import CostEwma, shed_retry_after
 
 _NAME_RE = re.compile(r"[^A-Za-z0-9_]")
 
@@ -78,6 +79,12 @@ class TenantSpec:
     #: ``slo_latency_objective_s`` / ``slo_freshness_objective_s``
     slo_latency_s: Optional[float] = None
     slo_freshness_s: Optional[float] = None
+    #: brown-out policy (runtime/overload.OverloadController): while a
+    #: health breach has the brown-out engaged, this tenant's NEW
+    #: traffic is routed to the approx tier (``"approx"``, flagged via
+    #: QueryInfo.approximate) or refused with ServerOverloaded
+    #: (``"shed"``); ``None`` (the default) opts out of degradation
+    brownout: Optional[str] = None
 
     def __post_init__(self):
         if self.weight <= 0:
@@ -86,6 +93,10 @@ class TenantSpec:
             v = getattr(self, f)
             if v is not None and v <= 0:
                 raise ValueError(f"tenant {self.name!r}: {f} must be > 0")
+        if self.brownout not in (None, "approx", "shed"):
+            raise ValueError(
+                f"tenant {self.name!r}: brownout must be approx|shed|None, "
+                f"got {self.brownout!r}")
 
 
 class _TenantState:
@@ -126,8 +137,23 @@ class FairScheduler:
     def __init__(self, tenants: "Iterable[TenantSpec] | Mapping | None" = None,
                  total_slots: Optional[int] = None,
                  default_spec: Optional[TenantSpec] = None,
-                 pool=None, max_tenants: int = 256):
+                 pool=None, max_tenants: int = 256,
+                 global_queue_limit: Optional[int] = None,
+                 tenant_queue_limit: Optional[int] = None,
+                 shed_drain_limit_s: Optional[float] = None):
         self._cv = threading.Condition()
+        #: load-shedding ceilings (overload rung 1; None = disabled).
+        #: Over-ceiling acquires fail FAST with the retryable
+        #: ServerOverloaded (HTTP 429 upstream) BEFORE a waiter is
+        #: enqueued or vtime is burned — a shed leaves no ghost state.
+        self.global_queue_limit = global_queue_limit
+        self.tenant_queue_limit = tenant_queue_limit
+        #: EWMA-cost admission: shed when the estimated backlog drain
+        #: time ``(queued+1) * ewma_cost / slots`` exceeds this
+        self.shed_drain_limit_s = shed_drain_limit_s
+        #: per-query slot-occupancy EWMA (updated by ``slot()``) — the
+        #: drain-time estimator; also exported via snapshot rows
+        self.cost_ewma = CostEwma()
         self._specs: dict[str, TenantSpec] = {}
         self._states: dict[str, _TenantState] = {}
         self._waiters: list[_Waiter] = []
@@ -184,7 +210,8 @@ class FairScheduler:
                        self.default_spec.max_concurrent,
                        self.default_spec.max_bytes,
                        self.default_spec.slo_latency_s,
-                       self.default_spec.slo_freshness_s)
+                       self.default_spec.slo_freshness_s,
+                       self.default_spec.brownout)
         self._specs[tenant] = s
         self._states.setdefault(tenant, _TenantState())
         return tenant
@@ -235,6 +262,56 @@ class FairScheduler:
                 return "turn"
         return None
 
+    # ---- load shedding ---------------------------------------------------
+    def _check_shed_locked(self, tenant: str, mname: str) -> None:
+        """Overload rung 1, decided BEFORE any queue state exists for
+        this submission: raise the retryable ``ServerOverloaded`` when
+        a queue ceiling or the EWMA drain estimate says accepting it
+        would grow the backlog past what the engine can drain. The
+        Retry-After hint is monotone in queue depth. Fairness note:
+        the GLOBAL ceiling only sheds tenants that already hold queue
+        share — a light tenant with no backlog always gets one spot in
+        line, so an aggressor's storm can never shed it first."""
+        queued_total = len(self._waiters)
+        queued_tenant = sum(1 for w in self._waiters if w.tenant == tenant)
+        why = None
+        if (self.tenant_queue_limit is not None
+                and queued_tenant >= self.tenant_queue_limit):
+            why = "queue_tenant"
+        elif (self.global_queue_limit is not None
+                and queued_total >= self.global_queue_limit
+                and queued_tenant > 0):
+            why = "queue_global"
+        elif (self.shed_drain_limit_s is not None
+                and self.cost_ewma.samples > 0
+                and queued_tenant > 0):
+            slots = self.total_slots or max(1, self._running_total)
+            drain_s = (queued_total + 1) * self.cost_ewma.value / slots
+            if drain_s > self.shed_drain_limit_s:
+                why = "cost"
+        if why is None:
+            return
+        retry_after = shed_retry_after(queued_total)
+        REGISTRY.counter("overload.shed").add()
+        REGISTRY.counter(f"overload.shed_reason.{why}").add()
+        REGISTRY.counter(f"overload.shed_tenant.{mname}").add()
+        raise ServerOverloaded(
+            f"tenant {tenant!r} shed at admission ({why}): "
+            f"{queued_tenant} queued for this tenant, {queued_total} "
+            f"queued globally, {self._running_total} running "
+            f"(ewma cost {self.cost_ewma.value:.3f}s; retry after "
+            f"{retry_after:.2f}s)",
+            retry_after_s=retry_after,
+        )
+
+    def check_shed(self, tenant: str) -> None:
+        """Synchronous shed verdict for ``tenant`` (the front-end's
+        accept-time gate): raises ``ServerOverloaded`` exactly as
+        ``acquire`` would, without enqueuing anything."""
+        with self._cv:
+            tenant = self._resolve_locked(tenant)
+            self._check_shed_locked(tenant, _metric_name(tenant))
+
     # ---- acquire / release ----------------------------------------------
     def acquire(self, tenant: str, timeout_s: Optional[float] = None) -> str:
         """Block until ``tenant`` may start one query; returns the
@@ -251,6 +328,7 @@ class FairScheduler:
             mname = _metric_name(tenant)
             spec = self._specs[tenant]
             st = self._states[tenant]
+            self._check_shed_locked(tenant, mname)
             stamp = max(st.vtime, self._vclock) + 1.0 / spec.weight
             # advance the tenant's virtual time at ENQUEUE, not
             # admission: a burst of N waiters from one tenant must
@@ -319,10 +397,14 @@ class FairScheduler:
     @contextmanager
     def slot(self, tenant: str, timeout_s: Optional[float] = None):
         token = self.acquire(tenant, timeout_s)
+        t0 = time.monotonic()
         try:
             yield
         finally:
             self.release(token)
+            # slot occupancy feeds the EWMA drain estimator (failed
+            # queries included: they occupied the slot all the same)
+            self.cost_ewma.update(time.monotonic() - t0)
 
     def kick(self) -> None:
         """Re-check blocked waiters (wired to memory-pool releases so

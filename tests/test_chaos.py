@@ -69,6 +69,7 @@ FAULT_SITES = (
     "step.grouped_join",
     "step.spill_transfer",
     "step.spill_partition",
+    "step.cancel_checkpoint",
 )
 
 #: generous wall bound per round — trips only on genuine hangs (cold
@@ -657,3 +658,99 @@ def test_chaos_concurrent_sessions_shared_pool(conn, oracle):
             assert not t.is_alive(), "worker hung"
     assert failures == []
     assert pool.reserved_bytes == 0 and pool.queued_count == 0
+
+
+def test_chaos_overload_storm_seeded(conn, oracle):
+    """ISSUE-19 storm round: a burst 4x over slot capacity against the
+    serving tier, mid-run cancels, and seeded faults that include the
+    new ``step.cancel_checkpoint`` site. The closed-loop contract:
+    zero untyped failures anywhere — every submission either FINISHES
+    with the oracle answer, FAILS with a typed code, or is shed at
+    accept time with the typed retryable ``ServerOverloaded`` (each
+    shed counted under ``overload.shed``) — and every budget (memory
+    pool, host-spill, scheduler queue) drains to zero."""
+    from presto_tpu.runtime.errors import ServerOverloaded
+    from presto_tpu.runtime.memory import global_host_spill_budget
+    from presto_tpu.server.frontend import QueryServer
+
+    rng = random.Random(1906)
+    srv = QueryServer(
+        {"tpch": conn}, total_slots=2,
+        shed_queue_limit=4, shed_tenant_queue_limit=3,
+        properties={
+            "health_monitor": False,
+            "result_cache_enabled": False,
+            "retry_backoff_s": 0.0,
+        },
+    )
+    inj = faults.FaultInjector(seed=1906)
+    # the checkpoint site itself is stormed: a backend-shaped OOM at a
+    # cancel checkpoint must surface as the typed DeviceOutOfMemory
+    # (or be absorbed by the ladder), never as an untyped RuntimeError
+    inj.inject_oom("step.cancel_checkpoint", times=2, probability=0.5)
+    inj.inject("scan", error=TransientFailure, times=2, probability=0.5)
+    shed0 = _counter("overload.shed")
+    cancel0 = _counter("server.cancel_requests")
+    submitted, shed, cancelled = [], 0, []
+    # pin both slots during the burst so the queue builds
+    # deterministically past the shed ceilings (4x over capacity)
+    holds = [srv.scheduler.acquire("burst"), srv.scheduler.acquire("burst")]
+    try:
+        with faults.injected(inj):
+            for i in range(8):
+                qname = rng.choice(sorted(CHAOS_QUERIES))
+                tenant = rng.choice(["burst", "burst", "walkin"])
+                try:
+                    qid = srv.submit(CHAOS_QUERIES[qname], tenant=tenant)
+                except ServerOverloaded as e:
+                    shed += 1
+                    assert e.retryable and e.retry_after_s > 0
+                else:
+                    submitted.append((qid, qname))
+                    # admitted workers enqueue asynchronously; let each
+                    # reach the fair queue so the ceilings see the true
+                    # depth (the storm is about backlog, not racing the
+                    # thread scheduler)
+                    t0 = time.monotonic()
+                    while (srv.scheduler.queue_depth() < len(submitted)
+                           and time.monotonic() - t0 < 10.0):
+                        time.sleep(0.002)
+            # mid-run cancels: a sample of the burst dies on purpose
+            for qid, _ in rng.sample(submitted,
+                                     max(1, len(submitted) // 3)):
+                out = srv.cancel(qid, reason="storm cancel")
+                assert out["cancelled"] is True
+                cancelled.append(qid)
+            for h in holds:
+                srv.scheduler.release(h)
+            holds = []
+            for qid, _ in submitted:
+                assert srv._queries[qid]["done"].wait(HANG_BUDGET_S), (
+                    f"{qid} hung in the storm")
+        for qid, qname in submitted:
+            page = srv.poll(qid)
+            if page["state"] == "FINISHED":
+                assert frames_equal(srv._queries[qid]["df"],
+                                    oracle[qname]), (
+                    f"{qid}: WRONG ANSWER on {qname} under storm")
+            else:
+                assert page["state"] == "FAILED"
+                assert page["errorCode"] and page["errorCode"] != "INTERNAL", (
+                    f"{qid}: untyped failure {page.get('error')}")
+        cancelled_pages = [srv.poll(q) for q in cancelled]
+        assert any(p["state"] == "FAILED"
+                   and p["errorCode"] == "QUERY_CANCELLED"
+                   for p in cancelled_pages), (
+            "no mid-run cancel was observed as QUERY_CANCELLED")
+    finally:
+        for h in holds:
+            srv.scheduler.release(h)
+        srv.shutdown()
+    assert shed >= 1, "a 4x burst never tripped the shed ceilings"
+    assert _counter("overload.shed") - shed0 >= shed
+    assert _counter("server.cancel_requests") - cancel0 == len(cancelled)
+    # budgets drained: nothing outlives the storm
+    assert srv.session.pool().reserved_bytes == 0
+    assert srv.session.pool().queued_count == 0
+    assert global_host_spill_budget().reserved_bytes == 0
+    assert srv.scheduler.queue_depth() == 0

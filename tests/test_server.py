@@ -495,15 +495,16 @@ def test_gate_abandoned_member_does_not_strand_the_queue():
 
 
 def test_server_submit_limit_rejects_floods():
-    from presto_tpu.runtime.errors import UserError
+    from presto_tpu.runtime.errors import ServerOverloaded
 
     qs = QueryServer({"tpch": CONN}, submit_limit=1,
                      properties={"result_cache_enabled": False,
                                  "health_monitor": False})
     # saturate the single pending slot with a record stuck QUEUED
     qs._queries["stuck"] = {"state": "QUEUED"}
-    with pytest.raises(UserError):
+    with pytest.raises(ServerOverloaded) as ei:
         qs.submit("select 1 a")
+    assert ei.value.retryable and ei.value.retry_after_s > 0
     del qs._queries["stuck"]
     qid = qs.submit("select count(*) c from orders")
     assert int(qs.result(qid, timeout_s=60)["c"][0]) > 0
