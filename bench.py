@@ -669,6 +669,101 @@ def bench_q3_filters_ab(extra: dict) -> None:
     }
 
 
+def bench_skewed_join_ab(extra: dict) -> None:
+    """Adaptive-execution A/B (ISSUE 20): a zipfian repartition join —
+    one hot key owning ~85% of the probe — through the engine with
+    ``adaptive_execution`` on vs off. The adaptive session's recurring
+    runs trigger skew-salted repartitioning (plan/adaptive.py); both
+    sides must return IDENTICAL rows, and the record carries the warm
+    rows/s of each side plus whether salting actually fired. A
+    serving-tier coda measures the compile-budget warmer: after the
+    QueryServer background-warms the hot template, a warm-window of
+    serving runs must execute with ZERO cold compiles."""
+    import time as _t
+
+    import jax
+    import numpy as np
+    import pandas as pd
+
+    from presto_tpu.cache.exec_cache import trace_delta
+    from presto_tpu.parallel.mesh import make_mesh
+    from presto_tpu.runtime.metrics import REGISTRY
+    from presto_tpu.runtime.session import Session
+
+    devices = jax.devices()
+    n = min(8, len(devices))
+    if n < 2:
+        extra["skewed_join_ab"] = {"note": "skipped: single device "
+                                   "(no repartition exchange to salt)"}
+        return
+    rng = np.random.default_rng(7)
+    rows = 1 << 15
+    keys = np.where(rng.random(rows) < 0.85, 7,
+                    rng.integers(0, 64, rows))
+    skewed = pd.DataFrame({"k": keys.astype(np.int64),
+                           "v": rng.integers(0, 100, rows)})
+    dim = pd.DataFrame({"dk": np.arange(64, dtype=np.int64),
+                        "dv": np.arange(64, dtype=np.int64)})
+    q = ("select k, dv, count(*) c, sum(v) sv from skewed "
+         "join dim on k = dk group by k, dv order by k, dv")
+
+    def timed(adaptive: bool):
+        s = Session({}, mesh=make_mesh(n), properties={
+            "result_cache_enabled": False,
+            "broadcast_join_row_limit": 0,  # force the repartition join
+            "adaptive_execution": adaptive,
+        })
+        mem = s.catalog.connector("memory")
+        mem.create_table("skewed", skewed)
+        mem.create_table("dim", dim)
+        # three recurring runs build history (hints fire on runs >= 2)
+        # and let the salted variant compile; the timed run is warm
+        for _ in range(3):
+            s.execute(q)
+        t0 = _t.perf_counter()
+        df, _info = s.execute(q)
+        return s, _t.perf_counter() - t0, df
+
+    before = REGISTRY.snapshot().get("adaptive.salted", 0)
+    s_on, on_s, a = timed(True)
+    salted = REGISTRY.snapshot().get("adaptive.salted", 0) - before
+    _, off_s, b = timed(False)
+    assert a.equals(b), "adaptive on/off returned different rows"
+    rec = {
+        "on_rows_per_sec": round(rows / on_s),
+        "off_rows_per_sec": round(rows / off_s),
+        "speedup": round(off_s / on_s, 3),
+        "salted_runs": int(salted),
+        "workers": n,
+    }
+
+    # serving coda: the background warmer pays any adaptivity-induced
+    # cold compile OFF the serving path — a warm window of serving
+    # traffic must trace nothing new
+    try:
+        from presto_tpu.server.frontend import QueryServer
+
+        server = QueryServer(session=s_on, warm_top_k=2,
+                             warm_interval_s=0.2)
+        try:
+            server.execute(q)
+            server.execute(q)
+            deadline = _t.monotonic() + 10.0
+            while (not server._warmed
+                   and _t.monotonic() < deadline):
+                _t.sleep(0.1)
+            with trace_delta() as td:
+                for _ in range(3):
+                    server.execute(q)
+            rec["warm_serving_cold_compiles"] = int(td.traces)
+            rec["templates_warmed"] = len(server._warmed)
+        finally:
+            server.shutdown(drain_timeout_s=10.0)
+    except Exception as e:  # noqa: BLE001 — the A/B half still counts
+        rec["serving_note"] = f"{type(e).__name__}: {e}"[:160]
+    extra["skewed_join_ab"] = rec
+
+
 def bench_q3_grouped(extra: dict) -> None:
     """Grouped (ladder-rung) Q3 join throughput: the same Q3 through
     the SQL engine with a 1-byte join build budget, forcing EVERY join
@@ -1941,6 +2036,13 @@ def _run(sf: float, stream_mode: bool) -> None:
                     # across PRs so the degradation rung stays honest
                     _phase("extras: Q3 grouped (ladder-rung) join")
                     bench_q3_grouped(extra)
+                if _remaining() > 45:
+                    # adaptivity A/B (ISSUE 20): zipfian repartition
+                    # join with skew-salting on vs off (identical
+                    # rows), plus the serving-tier warm window's
+                    # cold-compile count
+                    _phase("extras: skewed-join adaptivity A/B")
+                    bench_skewed_join_ab(extra)
                 if li_batch is not None and _remaining() > 30:
                     # the one-dispatch whole-SF Q1 (tunnel-floor bound;
                     # the round-1..4 headline, kept for continuity)
@@ -2017,6 +2119,19 @@ def _run(sf: float, stream_mode: bool) -> None:
                 "vs_baseline": round(extra[m] / BASELINE_ROWS_PER_SEC, 3),
                 "kernel": extra.get("leaf_route_kernel"),
             })
+    if isinstance(extra.get("skewed_join_ab"), dict) and \
+            "on_rows_per_sec" in extra["skewed_join_ab"]:
+        ab = extra["skewed_join_ab"]
+        metrics.append({
+            "metric": "skewed_join_rows_per_sec",
+            "value": ab["on_rows_per_sec"],
+            "unit": "rows/s",
+            "adaptive_off": ab["off_rows_per_sec"],
+            "speedup": ab["speedup"],
+            "salted_runs": ab["salted_runs"],
+            "warm_serving_cold_compiles": ab.get(
+                "warm_serving_cold_compiles"),
+        })
     if "sustained_load" in extra:
         sl = extra["sustained_load"]
         metrics.append({

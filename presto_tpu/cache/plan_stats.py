@@ -140,3 +140,75 @@ class PlanStatsStore:
 
     def clear(self) -> None:
         self._entries.clear()
+
+    # ---- persistence (Session.export_plan_stats / import_plan_stats) ----
+    #: export format version — an import refuses a payload whose
+    #: format it cannot interpret (forward-compatibility contract)
+    EXPORT_VERSION = 1
+
+    def to_json(self) -> str:
+        """The whole history as a JSON document, oldest entry first —
+        the warm-restart half of adaptive execution: a restarted server
+        imports this so history-driven decisions don't start cold."""
+        import json
+
+        return json.dumps({
+            "format": self.EXPORT_VERSION,
+            "entries": [
+                {
+                    "fingerprint": e.fingerprint,
+                    "query_id": e.query_id,
+                    "versions": [[t, v] for t, v in e.versions],
+                    "records": e.records,
+                    "runs": e.runs,
+                }
+                for e in self._entries.values()
+            ],
+        })
+
+    def load_json(self, text: str, catalog=None) -> int:
+        """Merge an exported history document into this store,
+        returning the number of entries imported. Version-checked
+        twice: the document FORMAT must be one this build understands
+        (ValueError otherwise), and with a ``catalog`` each entry's
+        recorded (table, version) snapshot must match the CURRENT
+        table epochs — an entry recorded against data that has since
+        changed is silently skipped (``plan_stats.import_stale``), the
+        same staleness contract get() enforces. Existing in-memory
+        entries win over imported ones (they are newer by
+        construction)."""
+        import json
+
+        doc = json.loads(text)
+        if not isinstance(doc, dict) or doc.get("format") != \
+                self.EXPORT_VERSION:
+            raise ValueError(
+                "unsupported plan-stats export format: "
+                f"{doc.get('format') if isinstance(doc, dict) else doc!r}"
+            )
+        imported = 0
+        for raw in doc.get("entries", []):
+            fp = raw.get("fingerprint")
+            records = raw.get("records") or []
+            if not fp or not records or fp in self._entries:
+                continue
+            versions = tuple(
+                (str(t), int(v)) for t, v in raw.get("versions", [])
+            )
+            if catalog is not None and any(
+                catalog.version(t) != v for t, v in versions
+            ):
+                REGISTRY.counter("plan_stats.import_stale").add()
+                continue
+            self._entries[fp] = PlanStatsEntry(
+                fp, str(raw.get("query_id", "")), versions,
+                list(records), runs=max(1, int(raw.get("runs", 1))),
+            )
+            self._entries.move_to_end(fp, last=False)  # imported = oldest
+            imported += 1
+        while len(self._entries) > self.max_entries:
+            self._entries.popitem(last=False)
+            REGISTRY.counter("plan_stats.evicted").add()
+        if imported:
+            REGISTRY.counter("plan_stats.imported").add(imported)
+        return imported

@@ -102,6 +102,27 @@ SCHEMAS: dict[str, dict[str, DataType]] = {
         "skew": DOUBLE,
         "runs": BIGINT,
     },
+    # adaptive-execution decision log (plan/adaptive.py): one row per
+    # applied OR refused decision of this session's feedback
+    # controller — salted repartitions, history-corrected sizing,
+    # disabled fused routes, compile-budget refusals
+    "adaptive": {
+        "query_id": fixed_bytes(24),
+        "fingerprint": fixed_bytes(64),
+        "node_id": BIGINT,
+        # decision kind: salt | join_flip | bucket | route
+        "kind": fixed_bytes(16),
+        # what the decision did (e.g. "repartition=salted(4)")
+        "action": fixed_bytes(64),
+        # why it fired (telemetry trigger, e.g. "skew 6.8x hot=7")
+        "trigger": fixed_bytes(96),
+        "salt": BIGINT,
+        "hot_partition": BIGINT,
+        "est_bytes": BIGINT,
+        # 1 = applied; 0 = refused by the compile-budget gate
+        "applied": BIGINT,
+        "created_at": DOUBLE,
+    },
     # flight-recorder post-mortems (runtime/flight.py): one row per
     # retained record; the full evidence (plan render, spans, metric
     # delta) exports as JSON via Session.export_flight_record
@@ -343,6 +364,21 @@ class SystemConnector:
                     runs.append(e.runs)
             return (fps, qids, nids, ntypes, ests, acts, sels, strats,
                     mis, skews, runs)
+        if table == "adaptive":
+            evs = self._session.adaptive.rows()
+            return (
+                [str(e.get("query_id", "")) for e in evs],
+                [str(e.get("fingerprint", "")) for e in evs],
+                [int(e.get("node_id", -1)) for e in evs],
+                [str(e.get("kind", "")) for e in evs],
+                [str(e.get("action", "")) for e in evs],
+                [str(e.get("trigger", "")) for e in evs],
+                [int(e.get("salt", 0)) for e in evs],
+                [int(e.get("hot_partition", -1)) for e in evs],
+                [int(e.get("est_bytes", -1)) for e in evs],
+                [int(bool(e.get("applied", True))) for e in evs],
+                [float(e.get("created_at", 0.0)) for e in evs],
+            )
         if table == "flight_recorder":
             recs = self._session.flight.records()
 
@@ -532,6 +568,22 @@ class SystemConnector:
                 "misest": np.asarray(mis, np.float64),
                 "skew": np.asarray(skews, np.float64),
                 "runs": np.asarray(runs, np.int64),
+            }
+        elif table == "adaptive":
+            (qid, fps, nids, kinds, actions, trigs, salts, hots, ebytes,
+             applied, created) = rows
+            arrays = {
+                "query_id": _bytes_col(qid, 24),
+                "fingerprint": _bytes_col(fps, 64),
+                "node_id": np.asarray(nids, np.int64),
+                "kind": _bytes_col(kinds, 16),
+                "action": _bytes_col(actions, 64),
+                "trigger": _bytes_col(trigs, 96),
+                "salt": np.asarray(salts, np.int64),
+                "hot_partition": np.asarray(hots, np.int64),
+                "est_bytes": np.asarray(ebytes, np.int64),
+                "applied": np.asarray(applied, np.int64),
+                "created_at": np.asarray(created, np.float64),
             }
         elif table == "flight_recorder":
             (qid, state, sql, trig, ecode, rung, rungs, rungs_total,

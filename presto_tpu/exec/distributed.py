@@ -281,6 +281,12 @@ class DistributedExecutor(OomLadderMixin):
         #: flight recorder copies these into failure post-mortems, the
         #: lifecycle layer into planned_hybrid rung-history entries)
         self.spill_events: list = []
+        #: adaptive-execution decisions for the current query, wired by
+        #: the session (plan/adaptive.py: {id(node) -> {kind -> dec}})
+        self.adaptive: dict = {}
+        #: applied adaptive decisions of the LAST run (flight-record /
+        #: ``system.adaptive`` capture — the spill_events posture)
+        self.adaptive_events: list = []
 
     # ------------------------------------------------------------------
     def run(self, plan: N.PlanNode):
@@ -304,6 +310,7 @@ class DistributedExecutor(OomLadderMixin):
         self._skew_accum.clear()
         self.hot_partitions = []
         self.spill_events = []
+        self.adaptive_events = []
         scalars: dict[str, Any] = {}
         try:
             # concrete literal-slot values scope the whole run (eager
@@ -684,6 +691,15 @@ class DistributedExecutor(OomLadderMixin):
         from presto_tpu.runtime.memory import estimate_node_bytes
 
         est = estimate_node_bytes(node, self.catalog)
+        # history-corrected sizing (plan/adaptive.py): a recurring
+        # fingerprint whose recorded actuals refuted this estimate
+        # re-sizes the grouped tier (bucket counts, and whether the
+        # grouped tier runs at all) from MEASURED rows
+        bdec = self._adaptive_decision(node, "bucket")
+        if bdec is not None and bdec.est_bytes >= 0:
+            est = bdec.est_bytes
+            self._note_adaptive(node, bdec,
+                                action=f"agg est_bytes={est} from actuals")
         if est > self.join_build_budget or self.oom_rung > 0:
             decision = self._spill_decision(node, est)
             REGISTRY.counter("agg.strategy.partial").add()
@@ -1014,7 +1030,15 @@ class DistributedExecutor(OomLadderMixin):
             self._count_distribution("broadcast")
             return self._broadcast_join(node, left, right, lkey, rkey, verify)
         self._count_distribution("repartition")
-        return self._repartition_join(node, left, right, lkey, rkey, verify)
+        # adaptive skew salting (plan/adaptive.py): recurring-history
+        # hot destination -> spread probe rows / replicate build rows
+        salt = self._adaptive_decision(node, "salt")
+        if salt is not None and not (2 <= salt.salt <= self.nworkers
+                                     and salt.hot_partition >= 0
+                                     and node.kind != "full"):
+            salt = None  # stale decision for a changed mesh: ignore
+        return self._repartition_join(node, left, right, lkey, rkey, verify,
+                                      salt=salt)
 
     def _concat_sharded(self, d: DistBatch, extra: Batch) -> DistBatch:
         """Append an (unsharded) batch to a DistBatch: shard the extra
@@ -1122,12 +1146,21 @@ class DistributedExecutor(OomLadderMixin):
         return self._concat_sharded(DistBatch(out, left.sharded), tail)
 
     def _repartition_join(self, node, left: DistBatch, right: DistBatch,
-                          lkey, rkey, verify=()):
+                          lkey, rkey, verify=(), salt=None):
         """FIXED_HASH distribution: all_to_all both sides on the join
         key so matching rows colocate, then join device-locally. After
         the exchange every build row lives on exactly ONE device, so
         FULL OUTER's unmatched-build tail is computed and appended
-        device-locally inside the same compiled step."""
+        device-locally inside the same compiled step.
+
+        ``salt`` (an adaptive ``salt`` decision, or None) rewrites the
+        exchange for a history-proven hot destination: probe rows bound
+        for it spread round-robin over S partitions while the matching
+        build rows REPLICATE to all S, so every probe row still meets
+        every matching build row exactly once — bit-identical output,
+        ~1x delivered-row balance (EXPLAIN: ``repartition=salted(S)``).
+        FULL OUTER is excluded upstream: its unmatched-build tail would
+        emit one NULL-extended row per REPLICA."""
         from presto_tpu.expr import InputRef
 
         # runtime backstop mirroring LookupJoinOperator._check_probe_dict:
@@ -1163,6 +1196,14 @@ class DistributedExecutor(OomLadderMixin):
 
         from presto_tpu.cache.exec_cache import EXEC_CACHE
 
+        # the salt tuple is a compiled-in knob: it MUST ride the cache
+        # key (PT201) — a salted and an unsalted step are different
+        # XLA programs over identical signatures
+        salt_t = None
+        if salt is not None:
+            salt_t = (int(salt.salt), int(salt.hot_partition))
+            self._note_adaptive(node, salt,
+                                action=f"repartition=salted({salt.salt})")
         # skew-aware: wire quotas stay fixed (one round when balanced);
         # retries double the receive/build/output capacities only
         for _ in range(MAX_RETRIES):
@@ -1178,10 +1219,10 @@ class DistributedExecutor(OomLadderMixin):
                 EXEC_CACHE.key_of(
                     "dist_repart_join", lkey, rkey, tuple(verify),
                     tuple(node.output_right), node.kind, node.unique,
-                    caps, self._mesh_fp,
+                    caps, salt_t, self._mesh_fp,
                 ),
                 lambda: self._make_repartition_join_step(
-                    node, lkey, rkey, *caps, verify,
+                    node, lkey, rkey, *caps, verify, salt=salt_t,
                 ),
             )
             import time as _time
@@ -1238,7 +1279,7 @@ class DistributedExecutor(OomLadderMixin):
 
     def _make_repartition_join_step(
         self, node, lkey, rkey, lquota, rquota, lrecv, rrecv, out_cap,
-        verify=(),
+        verify=(), salt=None,
     ):
         from presto_tpu.exec.joins import (
             long_dup_runs_flag,
@@ -1280,12 +1321,50 @@ class DistributedExecutor(OomLadderMixin):
             rv = evaluate(rkey, rb)
             lpids = partition_ids([lv.data.astype(jnp.int64)], Pn)
             rpids = partition_ids([rv.data.astype(jnp.int64)], Pn)
+            if salt is not None:
+                # skew salting: probe rows bound for the hot
+                # destination spread round-robin over the S partitions
+                # (hot, hot+1, ..., hot+S-1) mod P. Equal keys keep
+                # equal pids on the BUILD side only via replication
+                # below, so every probe row still meets every matching
+                # build row exactly once — bit-identical output.
+                S, hot = salt
+                spread = ((hot + (jnp.arange(lb.capacity) % S)) % Pn
+                          ).astype(lpids.dtype)
+                lpids = jnp.where(lpids == hot, spread, lpids)
             le, ovf1, lrnd, ldest = exchange_multiround(
                 lb, lpids, Pn, lquota, lrecv, axes=axes, with_rounds=True,
                 with_stats=True)
-            re, ovf2, rrnd, rdest = exchange_multiround(
-                rb, rpids, Pn, rquota, rrecv, axes=axes, with_rounds=True,
-                with_stats=True)
+            if salt is None:
+                re, ovf2, rrnd, rdest = exchange_multiround(
+                    rb, rpids, Pn, rquota, rrecv, axes=axes,
+                    with_rounds=True, with_stats=True)
+            else:
+                # build replication: pass i sends the hot keys' rows to
+                # salt target (hot+i) mod P — pass 0 also carries every
+                # non-hot row on its normal route. Only LIVE rows ever
+                # travel (parallel/exchange.py), so passes 1..S-1 cost
+                # rounds only where hot rows exist. The received passes
+                # concatenate device-locally into one build side.
+                S, hot = salt
+                rhot = rpids == hot
+                parts = []
+                ovf2 = rrnd = rdest = None
+                for i in range(S):
+                    pids_i = jnp.where(
+                        rhot, jnp.int32((hot + i) % Pn), rpids)
+                    live_i = rb.live if i == 0 else rb.live & rhot
+                    re_i, o_i, r_i, d_i = exchange_multiround(
+                        rb.with_live(live_i), pids_i, Pn, rquota, rrecv,
+                        axes=axes, with_rounds=True, with_stats=True)
+                    parts.append(re_i)
+                    if i == 0:
+                        ovf2, rrnd, rdest = o_i, r_i, d_i
+                    else:
+                        ovf2 = ovf2 | o_i
+                        rrnd = rrnd + r_i
+                        rdest = rdest + d_i
+                re = concat_batches(parts)
             rounds = jnp.stack([lrnd, rrnd])
             # [2, P] per-destination delivered rows (probe, build) —
             # the skew telemetry's raw device histograms

@@ -135,3 +135,52 @@ class OomLadderMixin:
                 "device_headroom_bytes": (-1 if headroom is None
                                           else int(headroom)),
             })
+
+    # ---- adaptive execution (plan/adaptive.py) ---------------------------
+    #: decision kind -> counter family (every family documented in
+    #: runtime/metrics.METRIC_HELP — the completeness test enforces it)
+    _ADAPTIVE_COUNTER = {
+        "salt": "adaptive.salted",
+        "join_flip": "adaptive.join_flip",
+        "bucket": "adaptive.bucket_override",
+        "route": "adaptive.route_disabled",
+    }
+
+    def _adaptive_decision(self, node, kind: str):
+        """This node's adaptive decision of one kind, or None. The
+        ``adaptive`` map is wired per query by the session (the
+        ``plan_hints`` shape: {id(live node) -> {kind -> decision}});
+        executors missing the wiring simply see no decisions."""
+        decisions = getattr(self, "adaptive", None)
+        if not decisions:
+            return None
+        per_node = decisions.get(id(getattr(node, "plan_node", node)))
+        return per_node.get(kind) if per_node else None
+
+    def _note_adaptive(self, node, dec, action: str = "") -> None:
+        """Record one APPLIED adaptive decision end-to-end (the
+        ``_note_spill`` posture): ``adaptive.*`` counters plus the
+        ``adaptive_events`` summary list the flight recorder captures
+        and the session stitches into ``system.adaptive``."""
+        from presto_tpu.runtime.metrics import REGISTRY
+
+        REGISTRY.counter(self._ADAPTIVE_COUNTER[dec.kind]).add()
+        events = getattr(self, "adaptive_events", None)
+        if events is not None:
+            ev = dec.to_event(applied=True)
+            ev["node"] = type(getattr(node, "plan_node", node)).__name__
+            if action:
+                ev["action"] = action
+            events.append(ev)
+
+    def _note_route_fallback(self, node) -> None:
+        """A planner-chosen fused route fell back at runtime: mark the
+        node's stats so the fingerprint's history carries the lie
+        (stats.record_route_fallback — telemetry, never raises)."""
+        recorder = getattr(self, "recorder", None)
+        if recorder is None:
+            return
+        try:
+            recorder.record_route_fallback(getattr(node, "plan_node", node))
+        except Exception:  # noqa: BLE001 — telemetry never raises
+            pass

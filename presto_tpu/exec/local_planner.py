@@ -257,6 +257,12 @@ class LocalExecutor(OomLadderMixin):
         #: executed spill-decision summaries of the CURRENT run
         #: (exec/ladder._note_spill; the flight recorder captures them)
         self.spill_events: list = []
+        #: adaptive-execution decisions for the current query, wired by
+        #: the session (plan/adaptive.py: {id(node) -> {kind -> dec}})
+        self.adaptive: dict = {}
+        #: applied adaptive decisions of the CURRENT run
+        #: (exec/ladder._note_adaptive; flight-record capture)
+        self.adaptive_events: list = []
         #: live HostSpill stores of the current run — released (and
         #: their host-budget reservations returned) when run_batches
         #: finishes, success or not. Release cannot happen per-bucket
@@ -278,6 +284,7 @@ class LocalExecutor(OomLadderMixin):
         # executor): flight records and rung history read the LAST
         # run's spill decisions, not an accumulation across rungs
         self.spill_events = []
+        self.adaptive_events = []
         batches, names = self.run_batches(plan)
         if not batches:
             return pd.DataFrame(columns=names)
@@ -559,6 +566,15 @@ class LocalExecutor(OomLadderMixin):
             from presto_tpu.runtime.memory import estimate_node_bytes
 
             agg_est = estimate_node_bytes(node, self.catalog)
+            # history-corrected sizing (plan/adaptive.py): recorded
+            # actuals re-size the grouped tier's bucket counts (and
+            # whether it runs at all) for recurring fingerprints
+            bdec = self._adaptive_decision(node, "bucket")
+            if bdec is not None and bdec.est_bytes >= 0:
+                agg_est = bdec.est_bytes
+                self._note_adaptive(
+                    node, bdec,
+                    action=f"agg est_bytes={agg_est} from actuals")
             if agg_est > self.join_build_budget:
                 decision = self._spill_decision(node, agg_est)
                 hybrid = self._exec_hybrid_agg(node, child, keys, aggs,
@@ -1014,6 +1030,16 @@ class LocalExecutor(OomLadderMixin):
         from presto_tpu.runtime.memory import estimate_node_bytes
 
         est = estimate_node_bytes(node.right, self.catalog)
+        # history-corrected build sizing (plan/adaptive.py): a
+        # recurring fingerprint whose recorded build actuals refuted
+        # this estimate re-decides grouped-vs-in-memory from MEASURED
+        # rows — a misestimated build that actually fits flips back to
+        # the in-memory (broadcast-class) path, and vice versa
+        fdec = self._adaptive_decision(node, "join_flip")
+        if fdec is not None and fdec.est_bytes >= 0:
+            est = fdec.est_bytes
+            self._note_adaptive(node, fdec,
+                                action=f"build est_bytes={est} from actuals")
         # full outer joins take the in-memory path regardless of the
         # estimate: their build sides in this suite are pre-aggregated
         # subqueries (q51/q97 shapes), and the grouped tier has no
@@ -1060,10 +1086,18 @@ class LocalExecutor(OomLadderMixin):
         # strategy whenever stats bound the key domain inside the VMEM
         # table budget; dense/packed stay as the next rungs (and the
         # per-batch fallback targets) — hash-verified keys never route
-        spec = None if verify or node.kind == "full" else self._pallas_spec(
-            iv, tuple(node.output_right),
-            {f.name: f.dtype for f in node.right.fields},
-            node.unique, node.kind)
+        # history route guard (plan/adaptive.py): a fingerprint whose
+        # fused route already fell back at runtime (lying advisory
+        # stats) stops re-attempting it — no rebuilt tables that only
+        # get discarded again
+        rdec = self._adaptive_decision(node, "route")
+        if rdec is not None:
+            self._note_adaptive(node, rdec, action="pallas route disabled")
+        spec = (None if verify or node.kind == "full" or rdec is not None
+                else self._pallas_spec(
+                    iv, tuple(node.output_right),
+                    {f.name: f.dtype for f in node.right.fields},
+                    node.unique, node.kind))
         # dense/packed only help the UNIQUE probe; other probe kinds
         # would pay the advisory-stats refusal for no benefit
         build = JoinBuildOperator(
@@ -1073,6 +1107,11 @@ class LocalExecutor(OomLadderMixin):
             filter_bits=self._filter_bits(node.right) if fslot else 0,
             params=self.params)
         Pipeline(BatchSource(right), [build]).run()
+        if spec is not None and build.pallas is None:
+            # the planner's fused route fell back at build time
+            # (advisory stats violated): ride the history so adaptive
+            # execution stops re-attempting it for this fingerprint
+            self._note_route_fallback(node)
         self._fill_join_filter(fslot, build, node.right, rkey)
         outs = [BuildOutput(n, n) for n in node.output_right]
         if node.kind == "full":
@@ -1356,6 +1395,12 @@ class LocalExecutor(OomLadderMixin):
         from presto_tpu.runtime.memory import estimate_node_bytes
 
         est = estimate_node_bytes(node.right, self.catalog)
+        # history-corrected build sizing, same contract as _exec_join
+        fdec = self._adaptive_decision(node, "join_flip")
+        if fdec is not None and fdec.est_bytes >= 0:
+            est = fdec.est_bytes
+            self._note_adaptive(node, fdec,
+                                action=f"build est_bytes={est} from actuals")
         decision = self._spill_decision(node, est)
         if decision.mode != "resident":
             # grouped semi/anti: a probe key's existence is decided
@@ -1390,12 +1435,18 @@ class LocalExecutor(OomLadderMixin):
         # packed build would be dead weight (probe_exists has no
         # packed path)
         iv = self._build_key_interval(node.right, node.right_keys)
-        spec = self._pallas_spec(iv, (), {}, True, jt)
+        rdec = self._adaptive_decision(node, "route")
+        if rdec is not None:
+            self._note_adaptive(node, rdec, action="pallas route disabled")
+        spec = (None if rdec is not None
+                else self._pallas_spec(iv, (), {}, True, jt))
         build = JoinBuildOperator(
             rkey, dense_domain=self._dense_domain(iv, right), pallas=spec,
             filter_bits=self._filter_bits(node.right) if fslot else 0,
             params=self.params)
         Pipeline(BatchSource(right), [build]).run()
+        if spec is not None and build.pallas is None:
+            self._note_route_fallback(node)
         self._fill_join_filter(fslot, build, node.right, rkey)
         if (spec is not None and spec.mode == "sketch"
                 and build.pallas_side is not None):

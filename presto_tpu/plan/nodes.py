@@ -322,7 +322,7 @@ def scan_physical_types(node: "TableScan", catalog) -> dict:
 def plan_tree_str(node: PlanNode, indent: int = 0, catalog=None,
                   _filters=None, approx_join: bool = False,
                   plan_hints=None, agg_bypass: bool = True,
-                  join_build_budget=None) -> str:
+                  join_build_budget=None, adaptive=None) -> str:
     """EXPLAIN-style rendering (reference: PlanPrinter). With a
     ``catalog``, scan columns render their chosen PHYSICAL storage
     (``l_shipdate:date:int16``), joins render the stats-planned probe
@@ -372,6 +372,12 @@ def plan_tree_str(node: PlanNode, indent: int = 0, catalog=None,
     elif isinstance(node, (Join,)):
         detail = f" {node.kind}{' unique' if node.unique else ''}"
         detail += _strategy_str(node, catalog, approx_join, join_build_budget)
+        # adaptive skew-salting decision (plan/adaptive.py, keyed by
+        # id(live node) like plan_hints): the rewritten exchange is
+        # never silent in EXPLAIN
+        dec = (adaptive or {}).get(id(node), {}).get("salt")
+        if dec is not None:
+            detail += f" repartition=salted({dec.salt})"
     elif isinstance(node, Window):
         detail = f" funcs={[f.name for f in node.funcs]} frame={node.frame}"
     elif isinstance(node, SemiJoin):
@@ -390,7 +396,8 @@ def plan_tree_str(node: PlanNode, indent: int = 0, catalog=None,
         out += plan_tree_str(c, indent + 1, catalog=catalog,
                              _filters=_filters or {}, approx_join=approx_join,
                              plan_hints=plan_hints, agg_bypass=agg_bypass,
-                             join_build_budget=join_build_budget)
+                             join_build_budget=join_build_budget,
+                             adaptive=adaptive)
     return out
 
 

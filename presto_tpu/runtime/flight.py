@@ -115,6 +115,11 @@ class FlightRecord:
     #: partitions, resident/streamed counts, host bytes — ladder.py's
     #: ``_note_spill`` summaries)
     spill: list = field(default_factory=list)
+    #: applied adaptive-execution decisions of the LAST run (salt /
+    #: join_flip / bucket / route — ladder.py's ``_note_adaptive``
+    #: events): a post-mortem of a history-steered plan must show what
+    #: adaptivity changed
+    adaptive: list = field(default_factory=list)
     #: memory pool state at terminal time (reservation released —
     #: recording a post-mortem never holds pool capacity)
     pool: dict = field(default_factory=dict)
@@ -148,6 +153,7 @@ class FlightRecord:
             "exchangeSkew": _json_safe(self.exchange_skew),
             "hotPartitions": _json_safe(self.hot_partitions),
             "spill": _json_safe(self.spill),
+            "adaptive": _json_safe(self.adaptive),
             "pool": _json_safe(self.pool),
             "traceEnabled": self.trace_enabled,
         }
@@ -253,6 +259,7 @@ class FlightRecorder:
             hot_partitions=list(
                 getattr(executor, "hot_partitions", ()) or ()),
             spill=list(getattr(executor, "spill_events", ()) or ()),
+            adaptive=list(getattr(executor, "adaptive_events", ()) or ()),
             pool=pool,
             trace_enabled=tracer is not None,
         )

@@ -448,6 +448,30 @@ METRIC_HELP: dict[str, str] = {
     "memory.rejected": "pool reservations rejected outright",
     "memory.released": "pool reservations released",
     "memory.reserved": "pool reservations granted",
+    # ---- adaptive execution (plan/adaptive.py)
+    "adaptive.salted": (
+        "repartition joins rewritten with skew salting (hot "
+        "destination split across S salted partitions, matching "
+        "build rows replicated)"),
+    "adaptive.join_flip": (
+        "join builds re-sized from recorded actuals (grouped vs "
+        "in-memory re-decided from history, not the static estimate)"),
+    "adaptive.bucket_override": (
+        "grouped aggregations re-sized from recorded actuals "
+        "(bucket counts from history, not the static estimate)"),
+    "adaptive.route_disabled": (
+        "fused (Pallas) join routes disabled because the "
+        "fingerprint's route fell back at runtime (lying stats)"),
+    "adaptive.compile_budget_refused": (
+        "adaptive re-specializations refused because predicted "
+        "compile cost exceeded predicted win at the observed "
+        "recurrence rate"),
+    "adaptive.stand_down": (
+        "adaptive decision passes suppressed under an active fault "
+        "injector or success-capture recorder (baseline plans only)"),
+    "adaptive.warmed": (
+        "top-K templates background-warmed by the serving layer so "
+        "adaptivity never injects a cold compile into steady state"),
     # ---- plan stats
     "plan_stats.evicted": "plan-stats fingerprints evicted",
     "plan_stats.invalidated": (
@@ -455,6 +479,12 @@ METRIC_HELP: dict[str, str] = {
     "plan_stats.record_errors": (
         "plan-stats recording failures (isolated)"),
     "plan_stats.recorded": "plan-stats runs recorded",
+    "plan_stats.imported": (
+        "plan-stats entries imported from a previous run's export "
+        "(Session.import_plan_stats — adaptivity warm restart)"),
+    "plan_stats.import_stale": (
+        "imported plan-stats entries skipped because their recorded "
+        "table versions no longer match the catalog"),
     # ---- prepared statements / templates
     "prepare.coalesced": (
         "executions coalesced onto an identical in-flight run"),

@@ -337,6 +337,24 @@ SESSION_PROPERTIES: dict[str, PropertyDef] = {
             _positive,
         ),
         PropertyDef(
+            "adaptive_execution", bool, True,
+            "Let plan-stats history STEER recurring plans "
+            "(plan/adaptive.py): skew-salted repartitioning, "
+            "history-corrected join/aggregate sizing, fused-route "
+            "disable after a runtime fallback — all compile-budget "
+            "gated against the exec-cache ledger and logged to "
+            "system.adaptive. Off = telemetry only (the pre-adaptive "
+            "baseline, also the A/B control in bench.py).",
+        ),
+        PropertyDef(
+            "adaptive_salt_max", int, 8,
+            "Upper bound on the skew-salt partition count S "
+            "(plan/adaptive.salt_factor): a hot destination splits "
+            "across at most this many salted partitions; build-row "
+            "replication cost grows linearly in S.",
+            _positive,
+        ),
+        PropertyDef(
             "profile_annotations", bool, False,
             "Wrap every trace span in a jax.profiler.TraceAnnotation "
             "named '<span>#<trace_token>' so xprof/TensorBoard device "

@@ -162,6 +162,13 @@ class Session:
         from presto_tpu.cache.plan_stats import PlanStatsStore
 
         self.plan_stats = PlanStatsStore(self.prop("plan_stats_limit"))
+        #: adaptive-execution feedback controller (plan/adaptive.py):
+        #: turns plan-stats history into sticky per-(fingerprint, node)
+        #: plan decisions, budget-gated against the exec-cache ledger;
+        #: its decision ring is queryable as ``system.adaptive``
+        from presto_tpu.plan.adaptive import AdaptiveController
+
+        self.adaptive = AdaptiveController()
         self.catalog.add_invalidation_listener(
             self.plan_stats.invalidate_table
         )
@@ -366,12 +373,14 @@ class Session:
                 "DDL statements execute via Session.sql(), not plan()/explain()"
             )
         plan, bound = self._plan_binding(stmt)
+        hints = self._plan_hints(plan)
         out = plan_tree_str(plan, catalog=self.catalog,
                             approx_join=bool(self.prop("approx_join")),
-                            plan_hints=self._plan_hints(plan),
+                            plan_hints=hints,
                             agg_bypass=bool(self.prop("partial_agg_bypass")),
                             join_build_budget=self.prop(
-                                "join_build_budget_bytes"))
+                                "join_build_budget_bytes"),
+                            adaptive=self._explain_adaptive(plan, hints))
         if bound:
             rendered = ", ".join(
                 f"?{i}={dt}:{v!r}" for i, (dt, v) in enumerate(bound)
@@ -819,6 +828,11 @@ class Session:
             executor.recorder = recorder
             executor.plan_hints = hints
             executor.agg_bypass = bool(self.prop("partial_agg_bypass"))
+            # adaptive-execution decisions for THIS query (guarded:
+            # property, runs>=2 via hints, fault injector, success
+            # recorder, compile budget — plan/adaptive.py)
+            executor.adaptive = self._adaptive_decisions(
+                plan, fp, hints, executor)
             #: the literal binding as device scalars, threaded through
             #: every jitted step (plan/templates.py; expr.param_scope)
             executor.params = device_params(bound) if bound else ()
@@ -928,6 +942,14 @@ class Session:
                     ]
                     if info.state == "FINISHED":
                         self._record_plan_stats(plan, info, recorder, fp)
+                # stitch applied adaptive decisions into the session
+                # decision log (system.adaptive) — failed runs too: a
+                # post-mortem needs to know what adaptivity changed
+                ev = getattr(executor, "adaptive_events", None)
+                if ev:
+                    self.adaptive.note_applied(
+                        getattr(executor, "adaptive_fp", None) or fp or "",
+                        info.query_id, ev)
                 self.events.query_completed(info)
             finally:
                 uninstall_delta(token)
@@ -1067,11 +1089,74 @@ class Session:
                     walk(c)
 
             walk(plan)
+            # fresh copies, with the entry's recurrence count attached:
+            # consumers (adaptive controller, EXPLAIN) must never
+            # mutate — or observe mutation of — the store's records
             return {
-                id(by_id[r["node_id"]]): r
+                id(by_id[r["node_id"]]): {**r, "runs": entry.runs}
                 for r in entry.records if r["node_id"] in by_id
             }
         except Exception:  # noqa: BLE001 — advisory only
+            return {}
+
+    def _adaptive_decisions(self, plan, fp, hints, executor,
+                            for_render: bool = False) -> dict:
+        """Adaptive-execution decision pass for one query (or for an
+        EXPLAIN render): plan/adaptive.AdaptiveController over the
+        plan-hints history. Best-effort and guarded — the
+        ``adaptive_execution`` property, a missing fingerprint, or any
+        internal failure yields the baseline (empty) decision map."""
+        try:
+            if not hints:
+                return {}
+            if not bool(self.prop("adaptive_execution")):
+                return {}
+            if not fp:
+                # the caller ran without a binding fingerprint (result
+                # cache off / stats run): decisions still need the
+                # history key, so derive it the way _plan_hints does
+                from presto_tpu.cache.fingerprint import (
+                    plan_fingerprint,
+                    plan_is_deterministic,
+                )
+
+                if not plan_is_deterministic(plan, self.catalog):
+                    return {}
+                fp = plan_fingerprint(plan, self.catalog, self.properties,
+                                      self.mesh)
+            if not for_render:
+                # the stitch in _run_tracked_inner logs applied events
+                # under the same history key the decisions used
+                executor.adaptive_fp = fp
+            return self.adaptive.decide(
+                plan, hints, self.catalog, fingerprint=fp,
+                nworkers=getattr(executor, "nworkers", 1),
+                salt_max=int(self.prop("adaptive_salt_max")),
+                for_render=for_render,
+                recording=bool(self.prop("flight_record_successes")),
+            )
+        except Exception:  # noqa: BLE001 — adaptivity never fails a query
+            return {}
+
+    def _explain_adaptive(self, plan, hints) -> dict:
+        """WOULD-BE adaptive decisions for EXPLAIN rendering (no
+        logging, no stickiness, no runtime stand-down guards — the
+        steady-state plan a recurring query will get)."""
+        try:
+            if not hints:
+                return {}
+            from presto_tpu.cache.fingerprint import (
+                plan_fingerprint,
+                plan_is_deterministic,
+            )
+
+            if not plan_is_deterministic(plan, self.catalog):
+                return {}
+            fp = plan_fingerprint(plan, self.catalog, self.properties,
+                                  self.mesh)
+            return self._adaptive_decisions(plan, fp, hints, self.executor,
+                                            for_render=True)
+        except Exception:  # noqa: BLE001 — EXPLAIN renders partial plans
             return {}
 
     def _record_plan_stats(self, plan, info, recorder, fp) -> None:
@@ -1160,6 +1245,33 @@ class Session:
             with open(path, "w") as f:
                 f.write(text)
         return text
+
+    def export_plan_stats(self, path: Optional[str] = None) -> str:
+        """The plan-stats history (system.plan_stats) as JSON — the
+        warm-restart half of adaptive execution. A server about to
+        restart exports; its successor imports
+        (:meth:`import_plan_stats`) and history-driven decisions
+        resume at full recurrence counts instead of starting cold.
+        Returns the JSON text; with ``path``, also writes it there."""
+        text = self.plan_stats.to_json()
+        if path is not None:
+            with open(path, "w") as f:
+                f.write(text)
+        return text
+
+    def import_plan_stats(self, path: str) -> int:
+        """Merge a previously exported plan-stats history from
+        ``path``, returning the number of entries imported. Entries
+        are version-checked against the CURRENT catalog's table epochs
+        — history recorded against data that has since changed is
+        skipped (``plan_stats.import_stale``), and a document in an
+        unknown format is refused (UserError)."""
+        with open(path) as f:
+            text = f.read()
+        try:
+            return self.plan_stats.load_json(text, catalog=self.catalog)
+        except ValueError as e:
+            raise UserError(str(e)) from e
 
     def export_trace(self, path: str, query_id: Optional[str] = None) -> str:
         """Write retained span traces as Chrome ``trace_event`` JSON

@@ -143,6 +143,10 @@ class NodeStats:
     exchange_rows: int = 0
     #: hottest partition id of the worst-skew exchange (-1: none seen)
     hot_partition: int = -1
+    #: True when a planner-chosen fused (Pallas) route fell back at
+    #: runtime — advisory stats lied; adaptive execution reads this to
+    #: stop re-attempting the route for recurring fingerprints
+    route_fallback: bool = False
     #: executed out-of-core mode ("" = resident / no spill tier ran)
     spill_mode: str = ""
     #: spill partition count (0 outside the spill tier)
@@ -176,6 +180,7 @@ class NodeStats:
             "skew": round(self.skew, 3),
             "exchange_rows": self.exchange_rows,
             "hot_partition": self.hot_partition,
+            "route_fallback": self.route_fallback,
             "spill_mode": self.spill_mode,
             "spill_partitions": self.spill_partitions,
             "spill_resident": self.spill_resident,
@@ -319,6 +324,19 @@ class StatsRecorder:
         st.skew = max(st.skew, float(ratio))
         st.exchange_rows += int(rows)
 
+    def record_route_fallback(self, node) -> None:
+        """Mark a node whose planner-chosen fused (Pallas) route fell
+        back at runtime — the build's advisory stats were violated.
+        Rides the plan-stats history so adaptive execution stops
+        re-attempting the route for this fingerprint (the lying-stats
+        posture: degrade once, remember, stay on the generic tier)."""
+        key = self.ids.of(node)
+        st = self.nodes.get(key)
+        if st is None:
+            st = NodeStats(type(node).__name__, node_id=key)
+            self.nodes[key] = st
+        st.route_fallback = True
+
     def record_spill(self, node, mode: str, partitions: int,
                      resident: int, host_bytes: int) -> None:
         """Attach the executed out-of-core decision to a node (both
@@ -404,6 +422,13 @@ class StatsRecorder:
                 # hybrid resident set from measured skew
                 "hot_partition": -1 if st is None else st.hot_partition,
                 "spill_mode": "" if st is None else st.spill_mode,
+                # measured node wall + runtime route fallback ride the
+                # history for the adaptive controller: wall_s prices
+                # the compile-budget gate's predicted win, and a lying
+                # fused-route fragment stops being re-attempted
+                "wall_s": 0.0 if st is None else round(st.wall_s, 6),
+                "route_fallback": (False if st is None
+                                   else bool(st.route_fallback)),
             })
         return out
 

@@ -118,7 +118,9 @@ class QueryServer:
                  submit_timeout_s: float = 300.0,
                  shed_queue_limit: Optional[int] = None,
                  shed_tenant_queue_limit: Optional[int] = None,
-                 shed_drain_limit_s: Optional[float] = None):
+                 shed_drain_limit_s: Optional[float] = None,
+                 warm_top_k: int = 0,
+                 warm_interval_s: float = 1.0):
         from presto_tpu.runtime.health import HealthMonitor, SloTracker
         from presto_tpu.runtime.session import Session
         from presto_tpu.stream.subscriptions import SubscriptionManager
@@ -210,6 +212,70 @@ class QueryServer:
             self.health.start()
         #: the registry behind system.health (connectors/system.py)
         session.health = self.health
+        #: compile-budget warming (plan/adaptive.py tentpole (c)):
+        #: adaptivity re-specializes recurring templates (salt /
+        #: flip / route), and the FIRST run of a re-specialized
+        #: template pays a cold compile. With ``warm_top_k > 0`` a
+        #: background thread re-executes the top-K SELECT templates
+        #: by observed traffic once each, off the serving path, so
+        #: steady-state traffic only ever sees warm exec-cache hits.
+        self._traffic: "dict[str, int]" = {}
+        self._traffic_lock = threading.Lock()
+        self._warmed: "set[str]" = set()
+        self.warm_top_k = max(0, int(warm_top_k))
+        self.warm_interval_s = max(0.05, float(warm_interval_s))
+        self._warm_stop = threading.Event()
+        self._warm_thread = None
+        if self.warm_top_k > 0:
+            self._warm_thread = threading.Thread(
+                target=self._warm_loop, name="presto-warm", daemon=True)
+            self._warm_thread.start()
+
+    # ---- template warming ------------------------------------------------
+    def _note_traffic(self, sql: str) -> None:
+        """Count one arrival of ``sql`` toward warming priority.
+        Traffic shape, not success, drives warming — a template that
+        keeps arriving keeps deserving a warm cache."""
+        if self.warm_top_k <= 0:
+            return
+        with self._traffic_lock:
+            self._traffic[sql] = self._traffic.get(sql, 0) + 1
+
+    def _warm_candidates(self) -> "list[str]":
+        """Top-K recurring SELECT templates not yet warmed. Recurrence
+        >= 2 mirrors the adaptivity corridor (plan-hints fire on runs
+        >= 2): warming a one-shot statement buys nothing."""
+        with self._traffic_lock:
+            ranked = sorted(self._traffic.items(),
+                            key=lambda kv: -kv[1])
+        out = []
+        for sql, count in ranked:
+            if len(out) >= self.warm_top_k:
+                break
+            if count < 2 or sql in self._warmed:
+                continue
+            head = sql.lstrip().lower()
+            if not (head.startswith("select") or head.startswith("with")):
+                continue  # never re-execute DML/DDL in the background
+            out.append(sql)
+        return out
+
+    def _warm_loop(self) -> None:
+        """Daemon body: each interval, re-execute newly-hot templates
+        once, paying any adaptivity-induced cold compile HERE instead
+        of on a serving thread. Runs against the shared session (same
+        exec cache the serving path hits) but outside the fair
+        scheduler — warming must never consume a tenant's slot."""
+        while not self._warm_stop.wait(self.warm_interval_s):
+            for sql in self._warm_candidates():
+                if self._warm_stop.is_set() or not self._accepting:
+                    return
+                self._warmed.add(sql)
+                try:
+                    self.session.sql(sql)
+                    REGISTRY.counter("adaptive.warmed").add()
+                except Exception:  # noqa: BLE001 — warming is advisory
+                    pass
 
     # ---- lifecycle accounting -------------------------------------------
     def _enter(self, tenant: str):
@@ -285,6 +351,7 @@ class QueryServer:
 
         tenant = tenant or self.default_tenant
         sess, _ = self._route_session(tenant)
+        self._note_traffic(sql)
         self._enter(tenant)
         dl_token = (None if deadline_s is None else
                     REQUEST_DEADLINE.set(time.monotonic() + deadline_s))
@@ -319,6 +386,9 @@ class QueryServer:
                          timeout_s: Optional[float] = None):
         tenant = tenant or self.default_tenant
         key = self._prepared_key(tenant, name)
+        prep = self.session._prepared.get(key)
+        if prep is not None:
+            self._note_traffic(getattr(prep, "sql", "") or "")
         self._enter(tenant)
         try:
             return self._execute_admitted(
@@ -640,6 +710,9 @@ class QueryServer:
         health watchdog stops before anything it samples is torn
         down."""
         deadline = time.monotonic() + drain_timeout_s
+        self._warm_stop.set()
+        if self._warm_thread is not None:
+            self._warm_thread.join(timeout=drain_timeout_s)
         if self.health is not None:
             self.health.close()
         self.subscriptions.close()

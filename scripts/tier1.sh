@@ -90,13 +90,20 @@
 #      recovery must re-arm exact service; DELETE of a RUNNING query
 #      must free its reservations at the next cancel checkpoint; the
 #      global pool must drain (ISSUE-19 acceptance).
-#  16. Static-analysis gate (scripts/lint.sh): the engine-invariant
+#  16. Adaptivity smoke: a recurring zipf-skewed repartition join must
+#      be rewritten with skew salting from plan-stats history — rows
+#      bit-identical to the non-adaptive baseline on every run, EXPLAIN
+#      rendering `repartition=salted(S)`, measured post-adaptation
+#      exchange skew under 2x, the decision logged in system.adaptive;
+#      the serving warmer must keep a warm serving window at ZERO cold
+#      compiles; the global pool must drain (ISSUE-20 acceptance).
+#  17. Static-analysis gate (scripts/lint.sh): the engine-invariant
 #      linter (`python -m presto_tpu.analysis` — trace hygiene,
 #      cache-key completeness, lock discipline, global-state hygiene)
 #      must exit 0 on the repo, AND each rule family must flag its
 #      seeded known-bad fixture — proving the gate can actually fail
 #      (ISSUE-15 acceptance).
-#  17. The tier-1 pytest suite on the CPU backend (virtual-device
+#  18. The tier-1 pytest suite on the CPU backend (virtual-device
 #      distributed tests included; `slow` marks excluded), with the
 #      same flags and timeout the driver uses.
 #
@@ -1134,6 +1141,98 @@ print("overload smoke: storm goodput on=%d/off=%d (%d shed, typed), "
       "brown-out engaged -> approx flagged + shed tenant refused -> "
       "recovered, RUNNING cancel typed QUERY_CANCELLED, pool 0"
       % (good_on, good_off, shed_on_n))
+PY
+
+timeout -k 10 420 env JAX_ENABLE_X64=1 python - <<'PY' || exit $?
+# Gate 16: adaptivity smoke (ISSUE-20 acceptance) — a recurring
+# zipf-skewed repartition join is rewritten with skew salting from
+# plan-stats history (bit-identical rows, EXPLAIN renders the salted
+# exchange, the measured skew rebalances under 2x, the decision lands
+# in system.adaptive), and the serving warmer keeps a warm serving
+# window free of cold compiles.
+import re
+import sys
+import time
+
+import numpy as np
+import pandas as pd
+
+sys.path.insert(0, ".")
+from __graft_entry__ import _provision_virtual_mesh
+
+_provision_virtual_mesh(8)
+
+from presto_tpu.cache.exec_cache import trace_delta
+from presto_tpu.parallel.mesh import make_mesh
+from presto_tpu.runtime.memory import global_pool
+from presto_tpu.runtime.metrics import REGISTRY
+from presto_tpu.runtime.session import Session
+from presto_tpu.server.frontend import QueryServer
+
+rng = np.random.default_rng(20)
+rows = 4096
+keys = np.where(rng.random(rows) < 0.85, 7, rng.integers(0, 64, rows))
+skewed = pd.DataFrame({"k": keys.astype(np.int64),
+                       "v": rng.integers(0, 100, rows)})
+dim = pd.DataFrame({"dk": np.arange(64, dtype=np.int64),
+                    "dv": np.arange(64, dtype=np.int64)})
+q = ("select k, dv, count(*) c, sum(v) sv from skewed "
+     "join dim on k = dk group by k, dv order by k, dv")
+
+
+def mk(adaptive):
+    s = Session({}, mesh=make_mesh(8), properties={
+        "result_cache_enabled": False,
+        "broadcast_join_row_limit": 0,  # force the repartition join
+        "adaptive_execution": adaptive,
+    })
+    mem = s.catalog.connector("memory")
+    mem.create_table("skewed", skewed)
+    mem.create_table("dim", dim)
+    return s
+
+
+want, _ = mk(False).execute(q)
+
+before = REGISTRY.snapshot().get("adaptive.salted", 0)
+s = mk(True)
+for i in range(4):
+    got, _ = s.execute(q)
+    assert got.equals(want), f"adaptive run {i} diverged from baseline"
+salted = REGISTRY.snapshot().get("adaptive.salted", 0) - before
+assert salted >= 1, "recurring zipfian join never salted"
+rendered = s.explain(q)
+assert "repartition=salted(" in rendered, rendered
+ana = s.explain_analyze(q)
+m = re.search(r"Join .*skew ([\d.]+)x", ana)
+assert m, "no skew rendered on the Join:\n" + ana
+skew = float(m.group(1))
+assert skew < 2.0, f"post-adaptation skew {skew}x not rebalanced"
+logged = s.sql("select kind, applied from adaptive "
+               "where kind = 'salt' and applied = 1")
+assert len(logged) >= 1, "salt decision missing from system.adaptive"
+
+# serving warmer: recurring template warms in the background, then a
+# warm window of serving traffic must trace NOTHING new
+server = QueryServer(session=s, warm_top_k=2, warm_interval_s=0.1)
+try:
+    server.execute(q)
+    server.execute(q)
+    deadline = time.monotonic() + 15.0
+    while not server._warmed and time.monotonic() < deadline:
+        time.sleep(0.1)
+    assert server._warmed, "warmer never warmed the recurring template"
+    with trace_delta() as td:
+        for _ in range(3):
+            server.execute(q)
+    assert td.traces == 0, \
+        f"{td.traces} cold compile(s) in the warm serving window"
+finally:
+    server.shutdown(drain_timeout_s=10.0)
+assert global_pool().reserved_bytes == 0, "global pool reservation leak"
+print("adaptivity smoke: salted %d run(s), EXPLAIN salted, post-adapt "
+      "skew %.1fx, warm serving 0 cold compiles, pool 0"
+      % (salted, skew))
 PY
 
 timeout -k 10 180 env JAX_PLATFORMS=cpu bash scripts/lint.sh || exit $?
